@@ -1,0 +1,547 @@
+"""Physical operators over ColumnBatches — the chunk engine.
+
+Reference analog: `polardbx-executor/.../executor/operator` (SURVEY.md §2.6).  The shape of the
+engine mirrors the reference's push/pull hybrid (`Executor.nextChunk` / `ConsumerExecutor.
+consumeChunk`): streaming operators transform one batch at a time; blocking operators
+(`HashAggOp`, `HashJoinOp` build, `SortOp`) consume all input then produce.  What differs is the
+compute substrate: every hot loop is a jitted fixed-shape XLA program from
+`kernels/relational.py`, and dynamic cardinality is handled by capacity buckets + overflow-retry
+instead of growable hash maps (SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from galaxysql_tpu.chunk.batch import Column, ColumnBatch, Dictionary, concat_batches
+from galaxysql_tpu.expr import ir
+from galaxysql_tpu.expr.compiler import ExprCompiler, batch_env, _find_dictionary, \
+    _signed_div_round, _pow10
+from galaxysql_tpu.kernels import relational as K
+from galaxysql_tpu.types import datatype as dt
+
+MIN_BUCKET = 1024
+
+
+def bucket_capacity(n: int) -> int:
+    """Round up to a power of two (bounded recompile count, like chunk-size bucketing)."""
+    c = MIN_BUCKET
+    while c < n:
+        c *= 2
+    return c
+
+
+@dataclasses.dataclass
+class AggCall:
+    kind: str                    # sum | count | avg | min | max | count_star
+    arg: Optional[ir.Expr]       # None for count_star
+    name: str
+    distinct: bool = False
+
+    @property
+    def dtype(self) -> dt.DataType:
+        if self.kind in ("count", "count_star"):
+            return dt.BIGINT
+        at = self.arg.dtype
+        if self.kind == "sum":
+            if at.clazz == dt.TypeClass.DECIMAL:
+                return dt.decimal(18, at.scale)
+            if at.clazz == dt.TypeClass.FLOAT:
+                return dt.DOUBLE
+            return dt.BIGINT
+        if self.kind == "avg":
+            if at.clazz == dt.TypeClass.DECIMAL:
+                return dt.decimal(18, min(at.scale + 4, 8))
+            return dt.DOUBLE
+        return at  # min/max
+
+
+class Operator:
+    """Pull-model operator: iterate ColumnBatches."""
+
+    output_schema: Dict[str, dt.DataType]
+
+    def batches(self) -> Iterator[ColumnBatch]:
+        raise NotImplementedError
+
+
+class SourceOp(Operator):
+    def __init__(self, batches: Iterable[ColumnBatch]):
+        self._batches = batches
+
+    def batches(self) -> Iterator[ColumnBatch]:
+        yield from self._batches
+
+
+class FilterOp(Operator):
+    """WHERE: ANDs the predicate into the live mask (selection-vector style)."""
+
+    def __init__(self, child: Operator, predicate: ir.Expr):
+        self.child = child
+        self.predicate = predicate
+        self._jit = None
+
+    def _compiled(self):
+        if self._jit is None:
+            pred = ExprCompiler(jnp).compile_predicate(self.predicate)
+
+            def run(batch: ColumnBatch) -> ColumnBatch:
+                mask = pred(batch_env(batch))
+                return ColumnBatch(batch.columns, batch.live_mask() & mask)
+            self._jit = jax.jit(run)
+        return self._jit
+
+    def batches(self) -> Iterator[ColumnBatch]:
+        f = self._compiled()
+        for b in self.child.batches():
+            yield f(b)
+
+
+class ProjectOp(Operator):
+    """SELECT expressions; preserves the live mask."""
+
+    def __init__(self, child: Operator, exprs: Sequence[Tuple[str, ir.Expr]]):
+        self.child = child
+        self.exprs = list(exprs)
+        self._jit = None
+
+    def _compiled(self):
+        if self._jit is None:
+            comp = ExprCompiler(jnp)
+            fns = [(name, e, comp.compile(e)) for name, e in self.exprs]
+
+            def run(batch: ColumnBatch) -> ColumnBatch:
+                env = batch_env(batch)
+                cols = {}
+                n = batch.capacity
+                for name, e, f in fns:
+                    data, valid = f(env)
+                    # data and valid broadcast independently (e.g. col + NULL yields
+                    # full-length data with a scalar always-false valid)
+                    if not hasattr(data, "shape") or data.shape == ():
+                        data = jnp.broadcast_to(data, (n,))
+                    if valid is not None and (not hasattr(valid, "shape")
+                                              or valid.shape == ()):
+                        valid = jnp.broadcast_to(valid, (n,))
+                    cols[name] = Column(data, valid, e.dtype, _find_dictionary(e))
+                return ColumnBatch(cols, batch.live)
+            self._jit = jax.jit(run)
+        return self._jit
+
+    def batches(self) -> Iterator[ColumnBatch]:
+        f = self._compiled()
+        for b in self.child.batches():
+            yield f(b)
+
+
+class HashAggOp(Operator):
+    """Grouped/global aggregation with streaming partials + final merge.
+
+    Each input batch is partially aggregated on device (sort+segment kernels); partials are
+    concatenated and merged in a final pass — the same partial/final split the reference's
+    `HashAggExec` + MPP partial-agg rules use, which later doubles as the distributed merge.
+    """
+
+    def __init__(self, child: Operator, group_exprs: Sequence[Tuple[str, ir.Expr]],
+                 aggs: Sequence[AggCall], max_groups: int = 1 << 16):
+        self.child = child
+        self.group_exprs = list(group_exprs)
+        self.aggs = list(aggs)
+        self.max_groups = max_groups
+        self._partial_jit_cache: Dict[Tuple, Any] = {}
+
+    # -- kernel plumbing ---------------------------------------------------
+
+    def _partial_specs(self) -> Tuple[List[ir.Expr], List[Tuple[str, K.AggSpec]]]:
+        """Decompose SQL aggs into kernel specs (avg -> sum + count)."""
+        inputs: List[ir.Expr] = []
+        index: Dict[Tuple, int] = {}
+
+        def arg_ix(e: ir.Expr) -> int:
+            k = e.key()
+            if k not in index:
+                index[k] = len(inputs)
+                inputs.append(e)
+            return index[k]
+
+        lanes: List[Tuple[str, K.AggSpec]] = []
+        for a in self.aggs:
+            if a.kind == "count_star":
+                lanes.append((a.name, K.AggSpec("count_star", -1)))
+            elif a.kind == "count":
+                lanes.append((a.name, K.AggSpec("count", arg_ix(a.arg))))
+            elif a.kind == "sum":
+                lanes.append((a.name, K.AggSpec("sum", arg_ix(a.arg))))
+            elif a.kind == "avg":
+                lanes.append((a.name + "$sum", K.AggSpec("sum", arg_ix(a.arg))))
+                lanes.append((a.name + "$cnt", K.AggSpec("count", arg_ix(a.arg))))
+            elif a.kind in ("min", "max"):
+                lanes.append((a.name, K.AggSpec(a.kind, arg_ix(a.arg))))
+            else:
+                raise ValueError(a.kind)
+        return inputs, lanes
+
+    def _partial_fn(self, max_groups: int):
+        key = ("partial", max_groups)
+        if key not in self._partial_jit_cache:
+            comp = ExprCompiler(jnp)
+            gfns = [comp.compile(e) for _, e in self.group_exprs]
+            inputs, lanes = self._partial_specs()
+            ifns = [comp.compile(e) for e in inputs]
+            specs = tuple(s for _, s in lanes)
+
+            def run(batch: ColumnBatch):
+                env = batch_env(batch)
+                n = batch.capacity
+                def mat(v):
+                    d, va = v
+                    if not hasattr(d, "shape") or d.shape == ():
+                        d = jnp.broadcast_to(d, (n,))
+                    if va is not None and (not hasattr(va, "shape") or va.shape == ()):
+                        va = jnp.broadcast_to(va, (n,))
+                    return d, va
+                keys = [mat(f(env)) for f in gfns]
+                ins = [mat(f(env)) for f in ifns]
+                return K.sort_groupby(keys, ins, specs, batch.live_mask(), max_groups)
+            self._partial_jit_cache[key] = jax.jit(run)
+        return self._partial_jit_cache[key]
+
+    def _merge_fn(self, max_groups: int, n_keys: int, lane_names: Tuple[str, ...],
+                  merge_specs: Tuple[K.AggSpec, ...]):
+        key = ("merge", max_groups, n_keys, merge_specs)
+        if key not in self._partial_jit_cache:
+            def run(key_lanes, input_lanes, live):
+                return K.sort_groupby(key_lanes, input_lanes, merge_specs, live, max_groups)
+            self._partial_jit_cache[key] = jax.jit(run)
+        return self._partial_jit_cache[key]
+
+    # -- execution ---------------------------------------------------------
+
+    def batches(self) -> Iterator[ColumnBatch]:
+        inputs, lanes = self._partial_specs()
+        lane_names = tuple(name for name, _ in lanes)
+        partials: List[K.GroupByResult] = []
+        mg = self.max_groups
+        for b in self.child.batches():
+            f = self._partial_fn(mg)
+            r = f(b)
+            if bool(r.overflow):
+                raise RuntimeError("group cardinality exceeded max_groups; "
+                                   "raise HashAggOp.max_groups")
+            partials.append(jax.tree.map(np.asarray, r))
+
+        if not partials:
+            if self.group_exprs:
+                return
+            # global agg over empty input: one row of neutral values
+            partials = []
+
+        # concat partial key/agg lanes into one merge input
+        def cat(arrs):
+            return np.concatenate(arrs) if arrs else np.zeros(0)
+
+        if partials:
+            key_lanes = []
+            for i, (_, ge) in enumerate(self.group_exprs):
+                d = cat([p.keys[i][0] for p in partials])
+                vs = [p.keys[i][1] for p in partials]
+                v = None if all(x is None for x in vs) else \
+                    np.concatenate([x if x is not None else
+                                    np.ones(p.keys[i][0].shape[0], np.bool_)
+                                    for x, p in zip(vs, partials)])
+                key_lanes.append((jnp.asarray(d), None if v is None else jnp.asarray(v)))
+            live = jnp.asarray(cat([p.live for p in partials]).astype(np.bool_))
+            agg_lanes = []
+            for j in range(len(lane_names)):
+                d = cat([p.aggs[j][0] for p in partials])
+                vs = [p.aggs[j][1] for p in partials]
+                v = None if all(x is None for x in vs) else \
+                    np.concatenate([x if x is not None else
+                                    np.ones(p.aggs[j][0].shape[0], np.bool_)
+                                    for x, p in zip(vs, partials)])
+                agg_lanes.append((jnp.asarray(d), None if v is None else jnp.asarray(v)))
+        else:
+            key_lanes, agg_lanes, live = [], [], jnp.zeros(1, jnp.bool_)
+            for name, spec in lanes:
+                agg_lanes.append((jnp.zeros(1, jnp.int64), jnp.zeros(1, jnp.bool_)))
+
+        # merge semantics: sum/count partials re-sum; min/max re-min/max
+        merge_specs = []
+        for (name, spec) in lanes:
+            if spec.kind in ("count", "count_star"):
+                merge_specs.append(K.AggSpec("sum", len(merge_specs)))
+            elif spec.kind == "sum":
+                merge_specs.append(K.AggSpec("sum", len(merge_specs)))
+            else:
+                merge_specs.append(K.AggSpec(spec.kind, len(merge_specs)))
+        merge_specs = tuple(merge_specs)
+
+        f = self._merge_fn(mg, len(key_lanes), lane_names, merge_specs)
+        r = f(tuple(key_lanes), tuple(agg_lanes), live)
+        if bool(r.overflow):
+            raise RuntimeError("group cardinality exceeded max_groups in merge")
+        yield self._finalize(r, lane_names)
+
+    def _finalize(self, r: K.GroupByResult, lane_names: Tuple[str, ...]) -> ColumnBatch:
+        """Materialize final output batch; avg = sum/count with MySQL decimal scale."""
+        cols: Dict[str, Column] = {}
+        for i, (name, ge) in enumerate(self.group_exprs):
+            d, v = r.keys[i]
+            cols[name] = Column(d, v, ge.dtype, _find_dictionary(ge))
+        lanes = {n: r.aggs[j] for j, n in enumerate(lane_names)}
+        n_groups_live = r.live
+        if not self.group_exprs:
+            # global aggregation always yields exactly one row
+            n_groups_live = jnp.ones_like(r.live).at[1:].set(False) \
+                if r.live.shape[0] else r.live
+        for a in self.aggs:
+            if a.kind == "avg":
+                s, sv = lanes[a.name + "$sum"]
+                c, _ = lanes[a.name + "$cnt"]
+                at = a.arg.dtype
+                rt = a.dtype
+                s = np.asarray(s)
+                c = np.asarray(c)
+                safe = np.where(c == 0, 1, c)
+                if rt.clazz == dt.TypeClass.DECIMAL:
+                    shift = rt.scale - (at.scale if at.clazz == dt.TypeClass.DECIMAL else 0)
+                    num = s.astype(np.int64) * _pow10(max(shift, 0))
+                    q = _signed_div_round(np, num, safe)
+                    data = q
+                else:
+                    data = s.astype(np.float64) / safe
+                    data = data.astype(np.float32)
+                valid = (c > 0)
+                cols[a.name] = Column(jnp.asarray(data), jnp.asarray(valid), rt, None)
+            else:
+                d, v = lanes[a.name]
+                rt = a.dtype
+                if a.kind == "sum" and rt.clazz == dt.TypeClass.FLOAT:
+                    d = jnp.asarray(np.asarray(d, dtype=np.float32))
+                if a.kind in ("count", "count_star"):
+                    v = None  # COUNT over empty group is 0, not NULL
+                dict_ = _find_dictionary(a.arg) if (a.kind in ("min", "max") and
+                                                    a.arg is not None and
+                                                    a.arg.dtype.is_string) else None
+                cols[a.name] = Column(d, v, rt, dict_)
+        return ColumnBatch(cols, n_groups_live)
+
+
+class HashJoinOp(Operator):
+    """Equi hash join: build side fully materialized, probe side streamed.
+
+    join_type: inner | left | semi | anti (probe side is the outer/left side).
+    """
+
+    def __init__(self, build: Operator, probe: Operator,
+                 build_keys: Sequence[ir.Expr], probe_keys: Sequence[ir.Expr],
+                 join_type: str = "inner",
+                 residual: Optional[ir.Expr] = None):
+        assert join_type in ("inner", "left", "semi", "anti")
+        self.build, self.probe = build, probe
+        self.build_keys, self.probe_keys = list(build_keys), list(probe_keys)
+        self.join_type = join_type
+        self.residual = residual
+        self._pairs_jit: Dict[int, Any] = {}
+
+    def _key_compilers(self):
+        """Compile key pairs into a common lane domain.
+
+        String keys from different dictionaries are aligned by translating probe codes into
+        the build dictionary's code space (host-built table, applied as a device gather);
+        absent strings map to -1, which matches no build code.
+        """
+        comp = ExprCompiler(jnp)
+        bk, pk = [], []
+        for be, pe in zip(self.build_keys, self.probe_keys):
+            bf, pf = comp.compile(be), comp.compile(pe)
+            if be.dtype.is_string and pe.dtype.is_string:
+                db = _find_dictionary(be)
+                dp = _find_dictionary(pe)
+                if db is not None and dp is not None and db is not dp:
+                    trans = np.array([db.encode_one(v, add=False) for v in dp.values]
+                                     or [-1], dtype=np.int32)
+
+                    def translated(env, _pf=pf, _t=trans):
+                        d, v = _pf(env)
+                        return jnp.asarray(_t)[d], v
+                    pf = translated
+            bk.append(bf)
+            pk.append(pf)
+        return bk, pk
+
+    def _pairs_fn(self, cap: int):
+        if cap not in self._pairs_jit:
+            bk, pk = self._key_compilers()
+
+            def run(build: ColumnBatch, probe: ColumnBatch):
+                benv, penv = batch_env(build), batch_env(probe)
+                bkeys = [f(benv) for f in bk]
+                pkeys = [f(penv) for f in pk]
+                return K.hash_join_pairs(bkeys, pkeys, build.live_mask(),
+                                         probe.live_mask(), cap)
+            self._pairs_jit[cap] = jax.jit(run)
+        return self._pairs_jit[cap]
+
+    @staticmethod
+    def _gather(batch: ColumnBatch, idx, live) -> Dict[str, Column]:
+        cols = {}
+        for name, c in batch.columns.items():
+            data = c.data[idx]
+            valid = c.valid[idx] if c.valid is not None else None
+            cols[name] = Column(data, valid, c.dtype, c.dictionary)
+        return cols
+
+    def batches(self) -> Iterator[ColumnBatch]:
+        build_batch = concat_batches(list(self.build.batches()))
+        if build_batch.capacity == 0:
+            # empty build: inner/semi yield nothing; left/anti pass probe rows through
+            for pb in self.probe.batches():
+                if self.join_type == "inner" or self.join_type == "semi":
+                    continue
+                if self.join_type == "anti":
+                    yield pb
+                else:  # left: null-extend (no build columns known — handled by plan schema)
+                    yield pb
+            return
+        build_batch = build_batch.pad_to(bucket_capacity(build_batch.capacity))
+
+        residual_pred = (ExprCompiler(jnp).compile_predicate(self.residual)
+                         if self.residual is not None else None)
+
+        for pb in self.probe.batches():
+            n_live = pb.num_live()
+            cap = bucket_capacity(max(n_live * 2, MIN_BUCKET))
+            while True:
+                pairs = self._pairs_fn(cap)(build_batch, pb)
+                if not bool(pairs.overflow):
+                    break
+                cap *= 2
+            if residual_pred is None and self.join_type in ("semi", "anti"):
+                matched = pairs.probe_matched
+                live = pb.live_mask() & (matched if self.join_type == "semi" else ~matched)
+                yield ColumnBatch(pb.columns, live)
+                continue
+            bcols = self._gather(build_batch, pairs.build_idx, pairs.live)
+            pcols = self._gather(pb, pairs.probe_idx, pairs.live)
+            out = ColumnBatch({**bcols, **pcols}, pairs.live)
+            if residual_pred is not None:
+                mask = residual_pred(batch_env(out))
+                out = ColumnBatch(out.columns, out.live_mask() & mask)
+            if self.join_type in ("left", "semi", "anti"):
+                # matched flags must reflect pairs that ALSO passed the residual
+                matched = jax.ops.segment_sum(
+                    out.live_mask().astype(jnp.int32), pairs.probe_idx,
+                    num_segments=pb.capacity) > 0
+            if self.join_type in ("semi", "anti"):
+                live = pb.live_mask() & (matched if self.join_type == "semi" else ~matched)
+                yield ColumnBatch(pb.columns, live)
+                continue
+            yield out
+            if self.join_type == "left":
+                # null-extended unmatched probe rows
+                unmatched = pb.live_mask() & ~matched
+                ncols = {}
+                for name, c in build_batch.columns.items():
+                    z = jnp.zeros(pb.capacity, dtype=c.data.dtype)
+                    ncols[name] = Column(z, jnp.zeros(pb.capacity, jnp.bool_),
+                                         c.dtype, c.dictionary)
+                ncols.update(pb.columns)
+                yield ColumnBatch(ncols, unmatched)
+
+
+class SortOp(Operator):
+    """ORDER BY [LIMIT]: materializes input, sorts once."""
+
+    def __init__(self, child: Operator,
+                 keys: Sequence[Tuple[ir.Expr, bool]],  # (expr, descending)
+                 limit: Optional[int] = None, offset: int = 0):
+        self.child = child
+        self.keys = list(keys)
+        self.limit = limit
+        self.offset = offset
+        self._jit = None
+
+    def batches(self) -> Iterator[ColumnBatch]:
+        merged = concat_batches(list(self.child.batches()))
+        if merged.capacity == 0:
+            yield merged
+            return
+        padded = merged.pad_to(bucket_capacity(merged.capacity))
+        comp = ExprCompiler(jnp)
+        kfns = []
+        for e, desc in self.keys:
+            f = comp.compile(e)
+            if e.dtype.is_string:
+                # dictionary codes are assignment-ordered, not collation-ordered: sort by
+                # the host-computed rank of each code (code -> sorted position)
+                d_ = _find_dictionary(e)
+                if d_ is not None and len(d_) and not d_.is_sorted:
+                    rank = d_.rank_array()
+
+                    def ranked(env, _f=f, _r=rank):
+                        dta, vld = _f(env)
+                        return jnp.asarray(_r)[dta], vld
+                    f = ranked
+            kfns.append((f, desc))
+
+        def run(batch: ColumnBatch) -> ColumnBatch:
+            env = batch_env(batch)
+            keys = []
+            for f, desc in kfns:
+                d, v = f(env)
+                keys.append((d, v, desc, not desc))  # MySQL: NULLs first asc, last desc
+            order = K.sort_indices(keys, batch.live_mask())
+            cols = {}
+            for name, c in batch.columns.items():
+                cols[name] = Column(c.data[order],
+                                    c.valid[order] if c.valid is not None else None,
+                                    c.dtype, c.dictionary)
+            live = batch.live_mask()[order]
+            if self.limit is not None:
+                live = K.limit_mask(live, self.offset, self.limit)
+            elif self.offset:
+                live = K.limit_mask(live, self.offset, batch.capacity)
+            return ColumnBatch(cols, live)
+
+        if self._jit is None:
+            self._jit = jax.jit(run)
+        yield self._jit(padded)
+
+
+class LimitOp(Operator):
+    def __init__(self, child: Operator, limit: int, offset: int = 0):
+        self.child = child
+        self.limit = limit
+        self.offset = offset
+
+    def batches(self) -> Iterator[ColumnBatch]:
+        remaining_skip = self.offset
+        remaining = self.limit
+        for b in self.child.batches():
+            if remaining <= 0:
+                break
+            n = b.num_live()
+            if n == 0:
+                continue
+            take_mask = K.limit_mask(b.live_mask(), remaining_skip, remaining)
+            taken = min(max(n - remaining_skip, 0), remaining)
+            remaining_skip = max(remaining_skip - n, 0)
+            remaining -= taken
+            yield ColumnBatch(b.columns, take_mask)
+
+
+class DistinctOp(HashAggOp):
+    def __init__(self, child: Operator, exprs: Sequence[Tuple[str, ir.Expr]],
+                 max_groups: int = 1 << 16):
+        super().__init__(child, exprs, [], max_groups)
+
+
+def run_to_batch(op: Operator) -> ColumnBatch:
+    """Drain an operator tree into a single compacted host batch."""
+    return concat_batches(list(op.batches()))

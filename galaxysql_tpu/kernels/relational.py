@@ -1,0 +1,309 @@
+"""Core relational kernels over fixed-shape device arrays.
+
+These replace the reference's operator hot loops (SURVEY.md §3.3: hash-table build/probe in
+`ParallelHashJoinExec.java:131-226`, agg-map updates in `AggOpenHashMap`, sorts) with
+TPU-friendly primitives:
+
+- **group-by = lexicographic sort + segmented reduction.**  No pointer-chasing hash map: rows
+  are lexsorted on the key lanes (exact — dictionary codes make string keys integer), group
+  boundaries are detected by comparing adjacent rows, and aggregates are `jax.ops.segment_*`
+  reductions.  The reference's sort-based fallback for huge-NDV aggs (`SpillableAggHashMap`)
+  is here the *primary* strategy because sort is what the hardware does well.
+- **hash join = hash + sort + searchsorted probe.**  The build side is sorted by a 64-bit key
+  hash; probes binary-search the sorted hash lane; every candidate pair is then verified
+  against the actual key columns, so hash collisions cost duplicates-filtered work, never
+  correctness.  This is the flat-array open-addressing idea of `ConcurrentRawHashTable`
+  (Appendix A) re-expressed without scatter contention.
+
+All kernels are fixed-shape: output capacity is a static argument and kernels report
+`overflow` so the host can re-bucket and retry (the dynamic-shape escape hatch, SURVEY.md
+§7.3).  Dead rows are carried via `live` masks, never compacted implicitly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# hashing
+# ---------------------------------------------------------------------------
+
+_M1 = np.uint64(0xff51afd7ed558ccd)
+_M2 = np.uint64(0xc4ceb9fe1a85ec53)
+_GOLDEN = np.uint64(0x9e3779b97f4a7c15)
+
+
+def _mix64(h):
+    h = h ^ (h >> 33)
+    h = h * _M1
+    h = h ^ (h >> 33)
+    h = h * _M2
+    h = h ^ (h >> 33)
+    return h
+
+
+def hash_columns(cols: Sequence[Tuple[Any, Optional[Any]]]) -> Any:
+    """Combine key columns (data, valid) into one uint64 hash lane.
+
+    NULL contributes a distinct tag so NULL keys group together but a verify pass still
+    decides join-match semantics (SQL: NULL never equals NULL in joins).
+    """
+    h = None
+    for data, valid in cols:
+        lane = _mix64(data.astype(jnp.uint64))
+        if valid is not None:
+            lane = jnp.where(valid, lane, jnp.uint64(0xdeadbeefcafebabe))
+        h = lane if h is None else _mix64(h * np.uint64(31) + lane + _GOLDEN)
+    assert h is not None
+    return h
+
+
+# ---------------------------------------------------------------------------
+# group-by
+# ---------------------------------------------------------------------------
+
+class AggSpec(NamedTuple):
+    kind: str  # 'sum' | 'count' | 'count_star' | 'min' | 'max' | 'sum_float'
+    # operand index into the inputs list (-1 for count_star)
+    arg: int
+
+
+class GroupByResult(NamedTuple):
+    keys: Tuple[Tuple[Any, Any], ...]  # per key: (data [max_groups], valid-or-None)
+    aggs: Tuple[Tuple[Any, Any], ...]  # per agg: (data [max_groups], valid-or-None)
+    live: Any                      # [max_groups] bool — which output slots are real groups
+    num_groups: Any                # scalar int32
+    overflow: Any                  # scalar bool
+
+
+def sort_groupby(keys: Sequence[Tuple[Any, Optional[Any]]],
+                 inputs: Sequence[Tuple[Any, Optional[Any]]],
+                 specs: Sequence[AggSpec],
+                 live: Any,
+                 max_groups: int) -> GroupByResult:
+    """Grouped aggregation.  `keys`/`inputs` are (data, valid) lanes of equal length n."""
+    n = keys[0][0].shape[0] if keys else live.shape[0]
+    dead = ~live
+
+    # null flag participates in grouping (SQL GROUP BY: NULLs form one group)
+    key_lanes: List[Any] = []
+    for data, valid in keys:
+        if valid is not None:
+            key_lanes.append(~valid)  # nulls group separately, after non-null? order irrelevant
+            key_lanes.append(jnp.where(valid, data, jnp.zeros_like(data)))
+        else:
+            key_lanes.append(data)
+
+    # lexsort: last key is primary => (minor..major); dead rows pushed to the end
+    order = jnp.lexsort(tuple(reversed([dead.astype(jnp.int8)] + key_lanes))) \
+        if key_lanes else jnp.argsort(dead.astype(jnp.int8), stable=True)
+    live_s = live[order]
+    sorted_lanes = [k[order] for k in key_lanes]
+
+    if sorted_lanes:
+        prev_differs = jnp.zeros(n, dtype=jnp.bool_)
+        for lane in sorted_lanes:
+            prev_differs = prev_differs | jnp.concatenate(
+                [jnp.ones(1, dtype=jnp.bool_), lane[1:] != lane[:-1]])
+        new_group = prev_differs & live_s
+        new_group = new_group.at[0].set(live_s[0])
+    else:
+        new_group = jnp.zeros(n, dtype=jnp.bool_).at[0].set(live_s[0])
+
+    seg = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+    num_groups = seg[-1] + 1 if n else jnp.int32(0)
+    num_groups = jnp.where(live_s.any(), num_groups, 0) if n else jnp.int32(0)
+    overflow = num_groups > max_groups
+    # dead rows and overflowing groups land in a trash segment
+    seg = jnp.where(live_s, jnp.minimum(seg, max_groups), max_groups)
+    nseg = max_groups + 1
+
+    # representative row per group for key materialization
+    first_row = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int32), seg,
+                                    num_segments=nseg)[:max_groups]
+    first_row = jnp.clip(first_row, 0, max(n - 1, 0))
+
+    out_keys = []
+    for data, valid in keys:
+        d_s = data[order]
+        out_keys.append(d_s[first_row])
+    out_key_valid = []
+    for data, valid in keys:
+        if valid is None:
+            out_key_valid.append(None)
+        else:
+            out_key_valid.append(valid[order][first_row])
+
+    out_aggs: List[Tuple[Any, Any]] = []
+    for spec in specs:
+        if spec.kind == "count_star":
+            cnt = jax.ops.segment_sum(live_s.astype(jnp.int64), seg,
+                                      num_segments=nseg)[:max_groups]
+            out_aggs.append((cnt, None))
+            continue
+        data, valid = inputs[spec.arg]
+        d_s = data[order]
+        v_s = valid[order] if valid is not None else None
+        present = live_s if v_s is None else (live_s & v_s)
+        if spec.kind == "count":
+            cnt = jax.ops.segment_sum(present.astype(jnp.int64), seg,
+                                      num_segments=nseg)[:max_groups]
+            out_aggs.append((cnt, None))
+        elif spec.kind in ("sum", "sum_float"):
+            if spec.kind == "sum_float" or jnp.issubdtype(d_s.dtype, jnp.floating):
+                zero = jnp.zeros((), dtype=d_s.dtype)
+                masked = jnp.where(present, d_s, zero)
+            else:
+                masked = jnp.where(present, d_s.astype(jnp.int64), 0)
+            s = jax.ops.segment_sum(masked, seg, num_segments=nseg)[:max_groups]
+            nonempty = jax.ops.segment_sum(present.astype(jnp.int32), seg,
+                                           num_segments=nseg)[:max_groups] > 0
+            out_aggs.append((s, nonempty))
+        elif spec.kind in ("min", "max"):
+            if jnp.issubdtype(d_s.dtype, jnp.floating):
+                neutral = jnp.array(np.inf if spec.kind == "min" else -np.inf, d_s.dtype)
+            else:
+                info = jnp.iinfo(d_s.dtype)
+                neutral = jnp.array(info.max if spec.kind == "min" else info.min, d_s.dtype)
+            masked = jnp.where(present, d_s, neutral)
+            f = jax.ops.segment_min if spec.kind == "min" else jax.ops.segment_max
+            m = f(masked, seg, num_segments=nseg)[:max_groups]
+            nonempty = jax.ops.segment_sum(present.astype(jnp.int32), seg,
+                                           num_segments=nseg)[:max_groups] > 0
+            out_aggs.append((m, nonempty))
+        else:
+            raise ValueError(f"unknown agg kind {spec.kind}")
+
+    out_live = jnp.arange(max_groups, dtype=jnp.int32) < jnp.minimum(num_groups, max_groups)
+    return GroupByResult(tuple(zip(out_keys, out_key_valid)), tuple(out_aggs), out_live,
+                         jnp.minimum(num_groups, max_groups).astype(jnp.int32), overflow)
+
+
+# ---------------------------------------------------------------------------
+# join
+# ---------------------------------------------------------------------------
+
+class JoinPairs(NamedTuple):
+    build_idx: Any     # [cap] int32 indices into build arrays
+    probe_idx: Any     # [cap] int32 indices into probe arrays
+    live: Any          # [cap] bool — verified pairs
+    probe_matched: Any  # [n_probe] bool — probe rows with >=1 verified match
+    build_matched: Any  # [n_build] bool — build rows with >=1 verified match
+    overflow: Any      # scalar bool
+
+
+def hash_join_pairs(build_keys: Sequence[Tuple[Any, Optional[Any]]],
+                    probe_keys: Sequence[Tuple[Any, Optional[Any]]],
+                    build_live: Any,
+                    probe_live: Any,
+                    cap: int) -> JoinPairs:
+    """Equi-join match enumeration: returns verified (build, probe) index pairs.
+
+    NULL join keys never match (SQL semantics): rows with any NULL key are masked out of
+    both sides before hashing.
+    """
+    def effective_live(keys, live):
+        m = live
+        for _, valid in keys:
+            if valid is not None:
+                m = m & valid
+        return m
+
+    b_live = effective_live(build_keys, build_live)
+    p_live = effective_live(probe_keys, probe_live)
+    nb = build_keys[0][0].shape[0]
+    npr = probe_keys[0][0].shape[0]
+
+    h_b = hash_columns(build_keys)
+    # dead build rows get a sentinel hash sorted to the end and never matched
+    h_b = jnp.where(b_live, h_b, jnp.uint64(0xffffffffffffffff))
+    perm = jnp.argsort(h_b)
+    h_sorted = h_b[perm]
+
+    h_p = hash_columns(probe_keys)
+    left = jnp.searchsorted(h_sorted, h_p, side="left")
+    right = jnp.searchsorted(h_sorted, h_p, side="right")
+    counts = jnp.where(p_live, (right - left).astype(jnp.int64), 0)
+
+    offsets = jnp.cumsum(counts)
+    total = offsets[-1] if npr else jnp.int64(0)
+    overflow = total > cap
+    starts = offsets - counts
+
+    # ragged expansion: slot j -> probe row p, k-th candidate
+    slots = jnp.arange(cap, dtype=jnp.int64)
+    p_of = jnp.searchsorted(offsets, slots, side="right").astype(jnp.int32)
+    p_of = jnp.clip(p_of, 0, max(npr - 1, 0))
+    k = slots - starts[p_of]
+    pair_live = slots < jnp.minimum(total, cap)
+    bpos = jnp.clip(left[p_of] + k.astype(jnp.int32), 0, max(nb - 1, 0))
+    b_of = perm[bpos].astype(jnp.int32)
+
+    # verify candidate pairs on the actual key lanes (hash collisions filtered here)
+    verified = pair_live
+    for (bd, bv), (pd, pv) in zip(build_keys, probe_keys):
+        eq = bd[b_of] == pd[p_of]
+        verified = verified & eq
+    verified = verified & b_live[b_of] & p_live[p_of]
+
+    # segment_sum, not segment_max: empty segments must yield False (segment_max's
+    # identity is INT_MIN, which would cast to True)
+    probe_matched = (jax.ops.segment_sum(
+        verified.astype(jnp.int32), p_of, num_segments=npr) > 0) \
+        if npr else jnp.zeros(0, jnp.bool_)
+    build_matched = (jax.ops.segment_sum(
+        verified.astype(jnp.int32), b_of, num_segments=nb) > 0) \
+        if nb else jnp.zeros(0, jnp.bool_)
+
+    return JoinPairs(b_of, p_of, verified, probe_matched, build_matched, overflow)
+
+
+# ---------------------------------------------------------------------------
+# sort / topn
+# ---------------------------------------------------------------------------
+
+def sort_indices(keys: Sequence[Tuple[Any, Optional[Any], bool, bool]],
+                 live: Any) -> Any:
+    """Stable multi-key sort.  Each key: (data, valid, descending, nulls_first).
+
+    Returns a permutation with live rows first in the requested order.
+    MySQL default: NULLs sort first ascending, last descending.
+    """
+    lanes: List[Any] = []
+    for data, valid, desc, nulls_first in keys:
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            lane = -data if desc else data
+        elif data.dtype == jnp.bool_:
+            lane = (~data if desc else data).astype(jnp.int8)
+        else:
+            lane = -data.astype(jnp.int64) if desc else data.astype(jnp.int64)
+        if valid is not None:
+            non_null_rank = jnp.asarray(1 if nulls_first else 0, dtype=jnp.int8)
+            null_rank = jnp.asarray(0 if nulls_first else 1, dtype=jnp.int8)
+            lanes.append(jnp.where(valid, non_null_rank, null_rank))
+            zero = jnp.zeros((), dtype=lane.dtype)
+            lane = jnp.where(valid, lane, zero)
+        lanes.append(lane)
+    dead = (~live).astype(jnp.int8)
+    order = jnp.lexsort(tuple(reversed([dead] + lanes)))
+    return order
+
+
+# ---------------------------------------------------------------------------
+# compaction / misc
+# ---------------------------------------------------------------------------
+
+def compaction_order(live: Any) -> Tuple[Any, Any]:
+    """Stable permutation putting live rows first; returns (order, num_live)."""
+    order = jnp.argsort(~live, stable=True)
+    return order, jnp.sum(live.astype(jnp.int32))
+
+
+def limit_mask(live: Any, offset: int, count: int) -> Any:
+    """LIMIT offset, count over live rows (order = physical order)."""
+    rank = jnp.cumsum(live.astype(jnp.int64)) - 1
+    return live & (rank >= offset) & (rank < offset + count)

@@ -1,0 +1,18 @@
+"""Test harness: force an 8-virtual-device CPU backend before JAX initializes.
+
+Mirrors the reference's strategy of testing cluster behavior without a cluster
+(SURVEY.md §4: LocalServer / mock connections): shard_map/pjit paths run on
+xla_force_host_platform_device_count=8 virtual devices.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,208 @@
+"""Expression engine: JAX lowering vs numpy golden backend, MySQL null semantics."""
+
+import numpy as np
+import pytest
+
+from galaxysql_tpu.chunk.batch import (ColumnBatch, Dictionary, batch_from_pydict,
+                                       column_from_pylist)
+from galaxysql_tpu.expr import ir
+from galaxysql_tpu.expr.compiler import ExprCompiler, batch_env
+from galaxysql_tpu.types import datatype as dt
+from galaxysql_tpu.types import temporal
+
+
+def _env(batch):
+    return {n: (c.np_data(), c.valid if c.valid is None else c.np_valid())
+            for n, c in batch.columns.items()}
+
+
+def both_backends(expr, batch):
+    import jax.numpy as jnp
+    jf = ExprCompiler(jnp).compile(expr)
+    nf = ExprCompiler(np).compile(expr)
+    jd, jv = jf(batch_env(batch))
+    nd, nv = nf(_env(batch))
+    jd = np.asarray(jd)
+    nd = np.asarray(nd)
+    jvm = np.ones(jd.shape, bool) if jv is None else np.asarray(jv)
+    nvm = np.ones(nd.shape, bool) if nv is None else np.asarray(nv)
+    np.testing.assert_array_equal(jvm, nvm)
+    if jd.dtype.kind == "f":
+        np.testing.assert_allclose(jd[jvm], nd[nvm], rtol=1e-5)
+    else:
+        np.testing.assert_array_equal(jd[jvm], nd[nvm])
+    return jd, jvm
+
+
+def make_batch():
+    schema = {
+        "a": dt.BIGINT, "b": dt.INT, "p": dt.decimal(15, 2), "q": dt.decimal(15, 2),
+        "f": dt.DOUBLE, "s": dt.VARCHAR, "d": dt.DATE,
+    }
+    return batch_from_pydict({
+        "a": [1, 2, None, 4, 5],
+        "b": [10, None, 30, 40, 50],
+        "p": [1.50, 2.25, 3.00, None, 10.10],
+        "q": [2.00, 0.50, None, 4.00, 0.00],
+        "f": [0.5, 1.5, 2.5, None, 4.5],
+        "s": ["apple", "banana", None, "cherry", "apple"],
+        "d": ["1994-01-01", "1994-06-15", "1995-12-31", None, "1996-02-29"],
+    }, schema)
+
+
+def col(batch, name):
+    c = batch.columns[name]
+    return ir.ColRef(name, c.dtype, c.dictionary)
+
+
+class TestArithmetic:
+    def test_int_add_nulls(self):
+        b = make_batch()
+        e = ir.call("add", col(b, "a"), col(b, "b"))
+        d, v = both_backends(e, b)
+        assert v.tolist() == [True, False, False, True, True]
+        assert d[0] == 11 and d[3] == 44
+
+    def test_decimal_mul(self):
+        b = make_batch()
+        e = ir.call("mul", col(b, "p"), col(b, "q"))
+        assert e.dtype.clazz == dt.TypeClass.DECIMAL
+        d, v = both_backends(e, b)
+        # 1.50*2.00=3.00 at scale 4 -> 30000
+        assert d[0] == 30000
+        assert v.tolist() == [True, True, False, False, True]
+
+    def test_decimal_add_rescale(self):
+        b = make_batch()
+        e = ir.call("add", col(b, "p"), ir.lit(1))
+        d, v = both_backends(e, b)
+        assert d[0] == 250  # 2.50 at scale 2
+
+    def test_division_by_zero_is_null(self):
+        b = make_batch()
+        e = ir.call("div", col(b, "p"), col(b, "q"))
+        d, v = both_backends(e, b)
+        assert not v[4]  # q=0.00
+        # 1.50/2.00 = 0.75 at scale 6 (2+4)
+        assert e.dtype.scale == 6
+        assert d[0] == 750000
+
+    def test_int_div_is_float(self):
+        b = make_batch()
+        e = ir.call("div", col(b, "a"), col(b, "b"))
+        assert e.dtype.clazz == dt.TypeClass.FLOAT
+        d, v = both_backends(e, b)
+        np.testing.assert_allclose(d[0], 0.1, rtol=1e-6)
+
+    def test_q1_style_expression(self):
+        # l_extendedprice * (1 - l_discount) * (1 + l_tax)
+        b = make_batch()
+        one = ir.lit(1)
+        e = ir.call("mul", ir.call("mul", col(b, "p"),
+                                   ir.call("sub", one, col(b, "q"))),
+                    ir.call("add", one, col(b, "q")))
+        both_backends(e, b)
+
+
+class TestComparisonsAndLogic:
+    def test_cmp_null_propagates(self):
+        b = make_batch()
+        e = ir.call("gt", col(b, "a"), ir.lit(2))
+        d, v = both_backends(e, b)
+        assert d[3] and d[4] and not d[0]
+        assert not v[2]
+
+    def test_kleene_and_or(self):
+        b = make_batch()
+        t = ir.call("gt", col(b, "a"), ir.lit(0))   # T T N T T
+        f = ir.call("lt", col(b, "b"), ir.lit(0))   # F N F F F
+        e = ir.call("and", t, f)
+        d, v = both_backends(e, b)
+        # T&F=F, T&N=N, N&F=F, T&F=F, T&F=F
+        assert v.tolist() == [True, False, True, True, True]
+        assert not d[0]
+        e2 = ir.call("or", t, f)
+        d2, v2 = both_backends(e2, b)
+        # T|F=T, T|N=T, N|F=N, ...
+        assert v2.tolist() == [True, True, False, True, True]
+
+    def test_between_dates(self):
+        b = make_batch()
+        e = ir.call("between", col(b, "d"), ir.lit("1994-01-01"), ir.lit("1994-12-31"))
+        d, v = both_backends(e, b)
+        assert d[0] and d[1] and not d[2]
+        assert not v[3]
+
+    def test_is_null(self):
+        b = make_batch()
+        e = ir.call("is_null", col(b, "a"))
+        d, v = both_backends(e, b)
+        assert d.tolist() == [False, False, True, False, False]
+        assert v.all()
+
+
+class TestStrings:
+    def test_eq_literal(self):
+        b = make_batch()
+        e = ir.call("eq", col(b, "s"), ir.lit("apple"))
+        d, v = both_backends(e, b)
+        assert d.tolist()[0] and d.tolist()[4] and not d.tolist()[1]
+        assert not v[2]
+
+    def test_in_list(self):
+        b = make_batch()
+        e = ir.InList(col(b, "s"), ("apple", "cherry", "missing"), False)
+        d, v = both_backends(e, b)
+        assert d[0] and not d[1] and d[3] and d[4]
+        assert not v[2]
+
+    def test_like(self):
+        b = make_batch()
+        e = ir.call("like", col(b, "s"), ir.lit("%an%"))
+        d, v = both_backends(e, b)
+        assert d.tolist() == [False, True, False, False, False]
+
+    def test_ordering_via_ranks(self):
+        b = make_batch()
+        e = ir.call("lt", col(b, "s"), ir.lit("banana"))
+        d, v = both_backends(e, b)
+        assert d.tolist()[0] and not d.tolist()[1] and not d.tolist()[3]
+
+
+class TestTemporal:
+    def test_year_extract(self):
+        b = make_batch()
+        e = ir.call("year", col(b, "d"))
+        d, v = both_backends(e, b)
+        assert d.tolist()[:3] == [1994, 1994, 1995]
+
+    def test_civil_roundtrip(self):
+        for s in ["1970-01-01", "1992-02-29", "1999-12-31", "2024-03-01", "1900-01-01"]:
+            days = temporal.parse_date(s)
+            assert temporal.format_date(days) == s
+
+    def test_date_add_months_clamps(self):
+        d = temporal.parse_date("1994-01-31")
+        assert temporal.format_date(temporal.add_interval_months(d, 1)) == "1994-02-28"
+
+    def test_date_plus_days(self):
+        b = make_batch()
+        e = ir.call("date_add_days", col(b, "d"), ir.lit(90))
+        d, v = both_backends(e, b)
+        assert temporal.format_date(d[0]) == "1994-04-01"
+
+
+class TestCase:
+    def test_case_when(self):
+        b = make_batch()
+        c1 = ir.call("gt", col(b, "a"), ir.lit(3))
+        e = ir.Case([(c1, ir.lit(100))], ir.lit(0), dt.BIGINT)
+        d, v = both_backends(e, b)
+        assert d.tolist()[0] == 0 and d.tolist()[3] == 100
+
+    def test_coalesce(self):
+        b = make_batch()
+        e = ir.call("coalesce", col(b, "a"), col(b, "b"))
+        d, v = both_backends(e, b)
+        assert d[2] == 30
+        assert v.all()

@@ -1,0 +1,117 @@
+"""Regressions for code-review findings on the core engine."""
+
+import numpy as np
+
+from galaxysql_tpu.chunk.batch import batch_from_pydict
+from galaxysql_tpu.exec.operators import (AggCall, FilterOp, HashAggOp, HashJoinOp,
+                                          ProjectOp, SourceOp, run_to_batch)
+from galaxysql_tpu.expr import ir
+from galaxysql_tpu.expr.compiler import ExprCompiler, batch_env
+from galaxysql_tpu.types import datatype as dt
+
+
+def col(batch, name):
+    c = batch.columns[name]
+    return ir.ColRef(name, c.dtype, c.dictionary)
+
+
+def _eval(expr, batch):
+    import jax.numpy as jnp
+    d, v = ExprCompiler(jnp).compile(expr)(batch_env(batch))
+    vm = None if v is None else np.asarray(v)
+    return np.asarray(d), vm
+
+
+class TestJoinResidual:
+    def make(self):
+        build = batch_from_pydict({"o_key": [1], "o_val": [100]},
+                                  {"o_key": dt.BIGINT, "o_val": dt.BIGINT})
+        probe = batch_from_pydict({"l_okey": [1, 2], "l_qty": [5, 6]},
+                                  {"l_okey": dt.BIGINT, "l_qty": dt.BIGINT})
+        return build, probe
+
+    def test_left_join_residual_null_extends(self):
+        build, probe = self.make()
+        residual = ir.call("gt", ir.ColRef("o_val", dt.BIGINT), ir.lit(1000))
+        op = HashJoinOp(SourceOp([build]), SourceOp([probe]),
+                        [ir.ColRef("o_key", dt.BIGINT)], [ir.ColRef("l_okey", dt.BIGINT)],
+                        "left", residual=residual)
+        out = run_to_batch(op).to_pydict()
+        rows = sorted(zip(out["l_qty"], out["o_val"]))
+        # all matches fail the residual -> BOTH probe rows null-extended
+        assert rows == [(5, None), (6, None)]
+
+    def test_semi_join_residual(self):
+        build, probe = self.make()
+        residual = ir.call("gt", ir.ColRef("o_val", dt.BIGINT), ir.lit(1000))
+        op = HashJoinOp(SourceOp([build]), SourceOp([probe]),
+                        [ir.ColRef("o_key", dt.BIGINT)], [ir.ColRef("l_okey", dt.BIGINT)],
+                        "semi", residual=residual)
+        assert run_to_batch(op).to_pylist() == []
+
+    def test_anti_join_residual(self):
+        build, probe = self.make()
+        residual = ir.call("gt", ir.ColRef("o_val", dt.BIGINT), ir.lit(1000))
+        op = HashJoinOp(SourceOp([build]), SourceOp([probe]),
+                        [ir.ColRef("o_key", dt.BIGINT)], [ir.ColRef("l_okey", dt.BIGINT)],
+                        "anti", residual=residual)
+        out = run_to_batch(op).to_pydict()
+        assert sorted(out["l_qty"]) == [5, 6]
+
+
+class TestStringOrderingBoundary:
+    def test_absent_literal_le_gt(self):
+        b = batch_from_pydict({"s": ["a", "c"]}, {"s": dt.VARCHAR})
+        d, v = _eval(ir.call("le", col(b, "s"), ir.lit("b")), b)
+        assert d.tolist() == [True, False]
+        d, v = _eval(ir.call("gt", col(b, "s"), ir.lit("b")), b)
+        assert d.tolist() == [False, True]
+        d, v = _eval(ir.call("lt", col(b, "s"), ir.lit("b")), b)
+        assert d.tolist() == [True, False]
+        d, v = _eval(ir.call("ge", col(b, "s"), ir.lit("b")), b)
+        assert d.tolist() == [False, True]
+
+    def test_literal_on_left(self):
+        b = batch_from_pydict({"s": ["a", "c"]}, {"s": dt.VARCHAR})
+        # 'b' <= s  ==  s >= 'b'
+        d, v = _eval(ir.call("le", ir.lit("b"), col(b, "s")), b)
+        assert d.tolist() == [False, True]
+
+
+class TestModSemantics:
+    def test_mod_sign_of_dividend(self):
+        b = batch_from_pydict({"a": [-5, 5, -5, 5], "b": [3, -3, -3, 3]},
+                              {"a": dt.BIGINT, "b": dt.BIGINT})
+        d, v = _eval(ir.call("mod", col(b, "a"), col(b, "b")), b)
+        assert d.tolist() == [-2, 2, -2, 2]
+
+    def test_decimal_mod(self):
+        b = batch_from_pydict({"a": [-5.5], "b": [3.0]},
+                              {"a": dt.decimal(10, 2), "b": dt.decimal(10, 2)})
+        d, v = _eval(ir.call("mod", col(b, "a"), col(b, "b")), b)
+        assert d.tolist() == [-250]  # -2.50
+
+
+class TestDatetimeMonths:
+    def test_add_months_keeps_time(self):
+        b = batch_from_pydict({"t": ["2020-01-15 10:30:00"]}, {"t": dt.DATETIME})
+        e = ir.call("date_add_months", col(b, "t"), ir.lit(1))
+        d, v = _eval(e, b)
+        from galaxysql_tpu.types import temporal
+        assert temporal.format_datetime(int(d[0])) == "2020-02-15 10:30:00"
+
+
+class TestNullLiteralProject:
+    def test_add_null_literal(self):
+        b = batch_from_pydict({"a": [1, 2, 3]}, {"a": dt.BIGINT})
+        e = ir.call("add", col(b, "a"), ir.lit(None, dt.BIGINT))
+        op = ProjectOp(SourceOp([b]), [("x", e)])
+        out = run_to_batch(op).to_pydict()
+        assert out["x"] == [None, None, None]
+
+    def test_in_list_with_null(self):
+        b = batch_from_pydict({"a": [1, 2, 3]}, {"a": dt.BIGINT})
+        e = ir.InList(col(b, "a"), (1, None), False)
+        d, v = _eval(e, b)
+        assert d[0] and v[0]          # 1 IN (1, NULL) -> TRUE
+        assert not v[1] and not v[2]  # 2 IN (1, NULL) -> NULL
