@@ -87,6 +87,20 @@ class Dictionary:
         dictionary, then become device-side set membership (SURVEY.md §7 'strings' stance)."""
         return np.array([i for i, v in enumerate(self.values) if pred(v)], dtype=np.int32)
 
+    def sorted_order(self) -> np.ndarray:
+        """order[rank] = code whose string sorts at position `rank` (inverse of
+        rank_array)."""
+        return np.argsort(np.array(self.values, dtype=object), kind="stable").astype(np.int32)
+
+
+def dictionary_translation(target: Dictionary, source: Dictionary) -> np.ndarray:
+    """trans[source_code] = target_code (or -1 when the string is absent from target).
+
+    Single home for cross-dictionary alignment, used by both the expression compiler
+    (column-vs-column string compare) and the hash join (key domain normalization)."""
+    return np.array([target.encode_one(v, add=False) for v in source.values] or [-1],
+                    dtype=np.int32)
+
 
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass

@@ -18,7 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from galaxysql_tpu.chunk.batch import Column, ColumnBatch, Dictionary, concat_batches
+from galaxysql_tpu.chunk.batch import (Column, ColumnBatch, Dictionary, concat_batches,
+                                       dictionary_translation)
 from galaxysql_tpu.expr import ir
 from galaxysql_tpu.expr.compiler import ExprCompiler, batch_env, _find_dictionary, \
     _signed_div_round, _pow10
@@ -34,6 +35,18 @@ def bucket_capacity(n: int) -> int:
     while c < n:
         c *= 2
     return c
+
+
+def broadcast_value(n: int, data, valid):
+    """Materialize a compiled (data, valid) pair to full row length.
+
+    Scalars appear when an expression is constant (literals, NULL); data and valid
+    broadcast independently — e.g. `col + NULL` has full-length data but scalar valid."""
+    if not hasattr(data, "shape") or data.shape == ():
+        data = jnp.broadcast_to(data, (n,))
+    if valid is not None and (not hasattr(valid, "shape") or valid.shape == ()):
+        valid = jnp.broadcast_to(valid, (n,))
+    return data, valid
 
 
 @dataclasses.dataclass
@@ -120,14 +133,7 @@ class ProjectOp(Operator):
                 cols = {}
                 n = batch.capacity
                 for name, e, f in fns:
-                    data, valid = f(env)
-                    # data and valid broadcast independently (e.g. col + NULL yields
-                    # full-length data with a scalar always-false valid)
-                    if not hasattr(data, "shape") or data.shape == ():
-                        data = jnp.broadcast_to(data, (n,))
-                    if valid is not None and (not hasattr(valid, "shape")
-                                              or valid.shape == ()):
-                        valid = jnp.broadcast_to(valid, (n,))
+                    data, valid = broadcast_value(n, *f(env))
                     cols[name] = Column(data, valid, e.dtype, _find_dictionary(e))
                 return ColumnBatch(cols, batch.live)
             self._jit = jax.jit(run)
@@ -192,21 +198,27 @@ class HashAggOp(Operator):
             comp = ExprCompiler(jnp)
             gfns = [comp.compile(e) for _, e in self.group_exprs]
             inputs, lanes = self._partial_specs()
-            ifns = [comp.compile(e) for e in inputs]
+            ifns = []
+            for e in inputs:
+                f = comp.compile(e)
+                # MIN/MAX on dictionary strings must compare collation ranks, not codes;
+                # _finalize maps ranks back to codes (count is rank-insensitive)
+                d_ = _find_dictionary(e) if e.dtype.is_string else None
+                if d_ is not None and len(d_) and not d_.is_sorted:
+                    rank = d_.rank_array()
+
+                    def ranked(env, _f=f, _r=rank):
+                        dd, vv = _f(env)
+                        return jnp.asarray(_r)[dd], vv
+                    f = ranked
+                ifns.append(f)
             specs = tuple(s for _, s in lanes)
 
             def run(batch: ColumnBatch):
                 env = batch_env(batch)
                 n = batch.capacity
-                def mat(v):
-                    d, va = v
-                    if not hasattr(d, "shape") or d.shape == ():
-                        d = jnp.broadcast_to(d, (n,))
-                    if va is not None and (not hasattr(va, "shape") or va.shape == ()):
-                        va = jnp.broadcast_to(va, (n,))
-                    return d, va
-                keys = [mat(f(env)) for f in gfns]
-                ins = [mat(f(env)) for f in ifns]
+                keys = [broadcast_value(n, *f(env)) for f in gfns]
+                ins = [broadcast_value(n, *f(env)) for f in ifns]
                 return K.sort_groupby(keys, ins, specs, batch.live_mask(), max_groups)
             self._partial_jit_cache[key] = jax.jit(run)
         return self._partial_jit_cache[key]
@@ -328,6 +340,11 @@ class HashAggOp(Operator):
                 dict_ = _find_dictionary(a.arg) if (a.kind in ("min", "max") and
                                                     a.arg is not None and
                                                     a.arg.dtype.is_string) else None
+                if dict_ is not None and len(dict_) and not dict_.is_sorted:
+                    # min/max ran on collation ranks; map winners back to codes
+                    order = dict_.sorted_order()
+                    ranks = np.clip(np.asarray(d), 0, len(order) - 1)
+                    d = jnp.asarray(order[ranks])
                 cols[a.name] = Column(d, v, rt, dict_)
         return ColumnBatch(cols, n_groups_live)
 
@@ -341,12 +358,17 @@ class HashJoinOp(Operator):
     def __init__(self, build: Operator, probe: Operator,
                  build_keys: Sequence[ir.Expr], probe_keys: Sequence[ir.Expr],
                  join_type: str = "inner",
-                 residual: Optional[ir.Expr] = None):
+                 residual: Optional[ir.Expr] = None,
+                 build_schema: Optional[Dict[str, Tuple[dt.DataType,
+                                                        Optional[Dictionary]]]] = None):
         assert join_type in ("inner", "left", "semi", "anti")
         self.build, self.probe = build, probe
         self.build_keys, self.probe_keys = list(build_keys), list(probe_keys)
         self.join_type = join_type
         self.residual = residual
+        # build-side output schema, needed to null-extend when the build side is EMPTY
+        # (otherwise the left-join output would be missing the build columns entirely)
+        self.build_schema = build_schema
         self._pairs_jit: Dict[int, Any] = {}
 
     def _key_compilers(self):
@@ -364,8 +386,7 @@ class HashJoinOp(Operator):
                 db = _find_dictionary(be)
                 dp = _find_dictionary(pe)
                 if db is not None and dp is not None and db is not dp:
-                    trans = np.array([db.encode_one(v, add=False) for v in dp.values]
-                                     or [-1], dtype=np.int32)
+                    trans = dictionary_translation(db, dp)
 
                     def translated(env, _pf=pf, _t=trans):
                         d, v = _pf(env)
@@ -400,14 +421,20 @@ class HashJoinOp(Operator):
     def batches(self) -> Iterator[ColumnBatch]:
         build_batch = concat_batches(list(self.build.batches()))
         if build_batch.capacity == 0:
-            # empty build: inner/semi yield nothing; left/anti pass probe rows through
+            # empty build: inner/semi yield nothing; anti passes probe rows through;
+            # left null-extends using the declared build schema
             for pb in self.probe.batches():
-                if self.join_type == "inner" or self.join_type == "semi":
+                if self.join_type in ("inner", "semi"):
                     continue
                 if self.join_type == "anti":
                     yield pb
-                else:  # left: null-extend (no build columns known — handled by plan schema)
-                    yield pb
+                    continue
+                ncols: Dict[str, Column] = {}
+                for name, (typ, d_) in (self.build_schema or {}).items():
+                    z = jnp.zeros(pb.capacity, dtype=typ.lane)
+                    ncols[name] = Column(z, jnp.zeros(pb.capacity, jnp.bool_), typ, d_)
+                ncols.update(pb.columns)
+                yield ColumnBatch(ncols, pb.live)
             return
         build_batch = build_batch.pad_to(bucket_capacity(build_batch.capacity))
 

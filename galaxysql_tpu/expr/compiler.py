@@ -201,6 +201,9 @@ class ExprCompiler:
         if tt.is_integer:
             if ft.clazz == dt.TypeClass.DECIMAL:
                 return _signed_div_round(self.xp, data, _pow10(ft.scale)).astype(tt.lane)
+            if ft.clazz == dt.TypeClass.FLOAT:
+                # MySQL rounds half away from zero on float->int cast
+                return xp.where(data >= 0, data + 0.5, data - 0.5).astype(tt.lane)
             return data.astype(tt.lane)
         if tt.clazz == dt.TypeClass.DATETIME and ft.clazz == dt.TypeClass.DATE:
             return data.astype(xp.int64) * temporal.MICROS_PER_DAY
@@ -466,8 +469,8 @@ class ExprCompiler:
                 return lambda env: (lambda dv: (xp.asarray(ranks)[dv[0]], dv[1]))(f(env))
             return wrapr(ca), wrapr(cb), dt.VARCHAR
         # different dictionaries: translate b's codes into a's code space
-        trans = np.array([da.encode_one(v, add=False) for v in db_.values] or [-1],
-                         dtype=np.int32)
+        from galaxysql_tpu.chunk.batch import dictionary_translation
+        trans = dictionary_translation(da, db_)
 
         def wrapb(f):
             return lambda env: (lambda dv: (xp.asarray(trans)[dv[0]], dv[1]))(f(env))
@@ -571,15 +574,16 @@ class ExprCompiler:
                     return r, valid
                 return run_mod
         fa, fb, common = self._binary_operands(e)
-        as_float = rt.clazz == dt.TypeClass.FLOAT
+        # _binary_operands already lowered both sides to float lanes when the common type
+        # is FLOAT; only convert here when the result is float but operands are still in
+        # an integer/decimal lane (e.g. int/int division)
+        as_float = rt.clazz == dt.TypeClass.FLOAT and common.clazz != dt.TypeClass.FLOAT
 
         def run(env: Env) -> Value:
             (ad, av), (bd, bv) = fa(env), fb(env)
             if as_float:
-                ad = _to_float(xp, ad, common if common.clazz == dt.TypeClass.DECIMAL
-                               else a.dtype)
-                bd = _to_float(xp, bd, common if common.clazz == dt.TypeClass.DECIMAL
-                               else b.dtype)
+                ad = _to_float(xp, ad, common)
+                bd = _to_float(xp, bd, common)
             valid = _and_valid(xp, av, bv)
             if op == "add":
                 return ad + bd, valid
@@ -712,10 +716,13 @@ class ExprCompiler:
 
         def run(env: Env) -> Value:
             out_d, out_v = fs[-1](env)
+            # right-to-left accumulation: each earlier (higher-priority) argument
+            # overwrites the accumulated result where it is non-null
             for f in reversed(fs[:-1]):
                 d, v = f(env)
                 if v is None:
-                    return d, None
+                    out_d, out_v = d, None
+                    continue
                 out_d = xp.where(v, d, out_d)
                 ov = out_v if out_v is not None else xp.ones_like(v)
                 out_v = v | ov

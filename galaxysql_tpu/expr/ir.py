@@ -172,10 +172,14 @@ def call(op: str, *args: Expr) -> Expr:
         return Call(op, args, dt.BOOL)
     if op == "add" or op == "sub":
         a, b = _coerce_temporal_literal(args[0], args[1])
+        if op == "add" and b.dtype.is_temporal and not a.dtype.is_temporal:
+            a, b = b, a  # N + date == date + N; keeps the temporal operand first
         if a.dtype.is_temporal and not b.dtype.is_temporal:
             return Call(op, [a, b], a.dtype)  # date +/- interval
         if op == "sub" and a.dtype.is_temporal and b.dtype.is_temporal:
             return Call("datediff", [a, b], dt.BIGINT)
+        if op == "sub" and b.dtype.is_temporal:
+            raise ValueError("numeric - temporal is not supported")
         return Call(op, [a, b], dt.add_result_type(a.dtype, b.dtype))
     if op == "mul":
         return Call(op, args, dt.mul_result_type(args[0].dtype, args[1].dtype))

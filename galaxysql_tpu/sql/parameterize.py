@@ -1,0 +1,82 @@
+"""Literal parameterization for plan-cache keying.
+
+Reference analog: `SqlParameterized` (SURVEY.md §2.3) — literals become `?` so that
+`SELECT ... WHERE a = 5` and `... a = 7` share one cached plan (`PlanCache.java:80`, keyed at
+`Planner.java:255,270`).  Works at token level: no parse needed on the cache-hit path, which
+is exactly why the reference does it this way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Tuple
+
+from galaxysql_tpu.sql.lexer import T, Token, tokenize
+
+
+@dataclasses.dataclass
+class ParameterizedSql:
+    sql: str                 # original SQL
+    parameterized: str       # literals replaced by ?
+    params: List[Any]        # extracted literal values (str | int | float)
+
+    @property
+    def cache_key(self) -> str:
+        return self.parameterized
+
+
+# keywords after which a literal is structural, not a data value (don't parameterize)
+_KEEP_BEFORE = {"LIMIT", "OFFSET", "PARTITIONS", "TBPARTITIONS", "INTERVAL", "TOP"}
+_KEEP_STMT_PREFIX = {"CREATE", "ALTER", "DROP", "SET", "SHOW", "USE", "KILL", "ANALYZE",
+                     "TRUNCATE", "DESC", "DESCRIBE", "EXPLAIN", "BEGIN", "COMMIT",
+                     "ROLLBACK", "START", "GRANT", "REVOKE"}
+
+
+def parameterize(sql: str) -> ParameterizedSql:
+    toks = tokenize(sql)
+    first = next((t for t in toks if t.kind != T.OP or not t.text.startswith("/*")), toks[-1])
+    if first.kind == T.IDENT and first.upper in _KEEP_STMT_PREFIX:
+        # DDL/utility statements aren't plan-cached; EXPLAIN shares the inner statement's
+        # literals but is cheap enough to skip too.
+        return ParameterizedSql(sql, sql, [])
+
+    out: List[str] = []
+    params: List[Any] = []
+    pos = 0
+    prev_sig: Token | None = None
+    for i, t in enumerate(toks):
+        if t.kind not in (T.NUMBER, T.STRING, T.HEX):
+            if t.kind != T.EOF:
+                prev_sig = t
+            continue
+        if prev_sig is not None:
+            if prev_sig.kind == T.IDENT and not prev_sig.quoted and \
+                    prev_sig.upper in _KEEP_BEFORE:
+                prev_sig = t
+                continue
+            # DATE '...' style keyword literals: keep the keyword, parameterize the string
+            # (they're data values).  INTERVAL '90' DAY: the value is structural for plan
+            # shape in our planner (constant folding), keep it.
+        # LIMIT 10, 20 — second literal after comma still under LIMIT
+        if prev_sig is not None and prev_sig.kind == T.OP and prev_sig.text == "," and i >= 2:
+            # find the significant token before the comma's left operand
+            k = i - 2
+            while k >= 0 and toks[k].kind in (T.NUMBER, T.STRING, T.HEX):
+                k -= 1
+                break
+            if k >= 0 and toks[k].kind == T.IDENT and toks[k].upper in _KEEP_BEFORE:
+                prev_sig = t
+                continue
+        out.append(sql[pos:t.start])
+        out.append("?")
+        pos = t.end
+        if t.kind == T.NUMBER:
+            params.append(float(t.text) if "." in t.text or "e" in t.text.lower()
+                          else int(t.text))
+        elif t.kind == T.HEX:
+            params.append(int(t.text, 16))
+        else:
+            params.append(t.text)
+        prev_sig = t
+    out.append(sql[pos:])
+    return ParameterizedSql(sql, "".join(out), params)

@@ -1,0 +1,88 @@
+"""Error taxonomy with MySQL error codes.
+
+Reference analog: `polardbx-common/.../exception/code` (SURVEY.md §2.8).  Frontend-visible
+errors carry (mysql_errno, sqlstate) so the wire layer can emit proper ERR packets.
+"""
+
+from __future__ import annotations
+
+
+class TddlError(Exception):
+    """Base framework error (named after the reference's TddlRuntimeException lineage)."""
+
+    errno = 1105          # ER_UNKNOWN_ERROR
+    sqlstate = "HY000"
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class SqlSyntaxError(TddlError):
+    errno = 1064          # ER_PARSE_ERROR
+    sqlstate = "42000"
+
+    def __init__(self, message: str, sql: str = "", pos: int = -1):
+        if pos >= 0:
+            line = sql.count("\n", 0, pos) + 1
+            near = sql[pos:pos + 32]
+            message = f"{message} near '{near}' at line {line}"
+        super().__init__(message)
+        self.sql = sql
+        self.pos = pos
+
+
+class UnknownDatabaseError(TddlError):
+    errno = 1049
+    sqlstate = "42000"
+
+
+class UnknownTableError(TddlError):
+    errno = 1146
+    sqlstate = "42S02"
+
+
+class UnknownColumnError(TddlError):
+    errno = 1054
+    sqlstate = "42S22"
+
+
+class TableExistsError(TddlError):
+    errno = 1050
+    sqlstate = "42S01"
+
+
+class AmbiguousColumnError(TddlError):
+    errno = 1052
+    sqlstate = "23000"
+
+
+class NotSupportedError(TddlError):
+    errno = 1235          # ER_NOT_SUPPORTED_YET
+    sqlstate = "42000"
+
+
+class DuplicateKeyError(TddlError):
+    errno = 1062
+    sqlstate = "23000"
+
+
+class TransactionError(TddlError):
+    errno = 1205
+    sqlstate = "HY000"
+
+
+class DeadlockError(TddlError):
+    errno = 1213
+    sqlstate = "40001"
+
+
+class AccessDeniedError(TddlError):
+    errno = 1045
+    sqlstate = "28000"
+
+
+class CclRejectError(TddlError):
+    """Query rejected/queued-timeout by concurrency control (CCL analog)."""
+    errno = 3168
+    sqlstate = "HY000"

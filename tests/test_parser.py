@@ -1,0 +1,183 @@
+"""Parser corpus tests — the MysqlTest analog (SURVEY.md §4 parser corpus)."""
+
+import pytest
+
+from galaxysql_tpu.sql import ast
+from galaxysql_tpu.sql.lexer import split_statements, tokenize
+from galaxysql_tpu.sql.parameterize import parameterize
+from galaxysql_tpu.sql.parser import parse
+from galaxysql_tpu.storage.tpch_queries import QUERIES
+from galaxysql_tpu.utils.errors import SqlSyntaxError
+
+
+class TestTpchCorpus:
+    @pytest.mark.parametrize("qid", sorted(QUERIES))
+    def test_parses(self, qid):
+        stmt = parse(QUERIES[qid])
+        assert isinstance(stmt, ast.Select)
+
+    def test_q1_shape(self):
+        s = parse(QUERIES[1])
+        assert len(s.items) == 10
+        assert s.items[2].alias == "sum_qty"
+        assert len(s.group_by) == 2
+        assert len(s.order_by) == 2
+        assert isinstance(s.where, ast.Binary)
+
+    def test_q3_joins_and_limit(self):
+        s = parse(QUERIES[3])
+        assert isinstance(s.from_, ast.Join)
+        assert s.limit.value == 10
+
+    def test_q7_derived_table_and_alias(self):
+        s = parse(QUERIES[7])
+        assert isinstance(s.from_, ast.SubqueryRef)
+        assert s.from_.alias == "shipping"
+
+    def test_q13_left_join_with_extra_on(self):
+        s = parse(QUERIES[13])
+        inner = s.from_.select.from_
+        assert isinstance(inner, ast.Join)
+        assert inner.kind == "left"
+
+    def test_q16_not_in_subquery(self):
+        s = parse(QUERIES[16])
+        # find the NOT IN subquery in the where conjunction
+        found = []
+        def walk(e):
+            if isinstance(e, ast.InExpr):
+                found.append(e)
+            for f in e.__dataclass_fields__:
+                v = getattr(e, f)
+                if isinstance(v, ast.ExprNode):
+                    walk(v)
+                elif isinstance(v, list):
+                    for x in v:
+                        if isinstance(x, ast.ExprNode):
+                            walk(x)
+        walk(s.where)
+        assert any(e.negated and e.select is not None for e in found)
+        assert any(e.items is not None and len(e.items) == 8 for e in found)
+
+    def test_q21_exists_not_exists(self):
+        s = parse(QUERIES[21])
+        assert isinstance(s, ast.Select)
+
+
+class TestStatements:
+    def test_create_table_partitioned(self):
+        s = parse("""
+            CREATE TABLE IF NOT EXISTS t1 (
+                id BIGINT NOT NULL AUTO_INCREMENT,
+                name VARCHAR(30) DEFAULT 'x' COMMENT 'the name',
+                amount DECIMAL(15,2) NOT NULL,
+                created DATE,
+                PRIMARY KEY (id),
+                KEY idx_name (name),
+                GLOBAL INDEX g_i (amount) COVERING (name) PARTITION BY HASH(amount) PARTITIONS 4
+            ) ENGINE=InnoDB DEFAULT CHARSET=utf8mb4 COMMENT='demo'
+              PARTITION BY HASH(id) PARTITIONS 16
+        """)
+        assert isinstance(s, ast.CreateTable)
+        assert s.if_not_exists
+        assert [c.name for c in s.columns] == ["id", "name", "amount", "created"]
+        assert s.columns[0].auto_increment and not s.columns[0].nullable
+        assert s.columns[2].type_name == "DECIMAL" and s.columns[2].scale == 2
+        assert s.primary_key == ["id"]
+        assert s.partition.method == "hash" and s.partition.count == 16
+        gsi = [i for i in s.indexes if i.global_index]
+        assert gsi and gsi[0].covering == ["name"] and gsi[0].partition.count == 4
+        assert s.comment == "demo"
+
+    def test_create_table_range_partitions(self):
+        s = parse("""
+            CREATE TABLE t (a INT, b DATE) PARTITION BY RANGE COLUMNS(b) (
+                PARTITION p0 VALUES LESS THAN ('2000-01-01'),
+                PARTITION p1 VALUES LESS THAN (MAXVALUE)
+            )
+        """)
+        assert s.partition.method == "range_columns"
+        assert len(s.partition.boundaries) == 2
+        assert s.partition.boundaries[1][1][0].parts == ["MAXVALUE"]
+
+    def test_broadcast_single(self):
+        assert parse("CREATE TABLE r (a INT) BROADCAST").broadcast
+        assert parse("CREATE TABLE r (a INT) SINGLE").single
+
+    def test_insert_forms(self):
+        s = parse("INSERT INTO t (a, b) VALUES (1, 'x'), (2, 'y')")
+        assert len(s.rows) == 2
+        s = parse("INSERT INTO t SELECT a, b FROM u WHERE a > 3")
+        assert s.select is not None
+        s = parse("INSERT INTO t SET a = 1, b = 'z'")
+        assert s.columns == ["a", "b"]
+        s = parse("INSERT INTO t (a) VALUES (1) ON DUPLICATE KEY UPDATE a = a + 1")
+        assert s.on_dup_update is not None
+
+    def test_update_delete(self):
+        s = parse("UPDATE t SET a = a + 1, b = 2 WHERE c < 5 LIMIT 10")
+        assert len(s.sets) == 2 and s.limit is not None
+        s = parse("DELETE FROM t WHERE a IN (1,2,3)")
+        assert isinstance(s.where, ast.InExpr)
+
+    def test_set_show_use(self):
+        s = parse("SET autocommit = 1, @@session.sql_mode = 'STRICT', @u = 5")
+        assert [a[0] for a in s.assignments] == ["session", "session", "user"]
+        s = parse("SET GLOBAL max_connections = 100")
+        assert s.assignments[0][0] == "global"
+        s = parse("SHOW FULL COLUMNS FROM t1")
+        assert s.kind == "columns" and s.full
+        s = parse("SHOW TABLES LIKE 'li%'")
+        assert s.like == "li%"
+        assert isinstance(parse("USE mydb"), ast.UseDb)
+
+    def test_explain_txn(self):
+        s = parse("EXPLAIN ANALYZE SELECT 1")
+        assert s.analyze and isinstance(s.stmt, ast.Select)
+        assert isinstance(parse("BEGIN"), ast.Begin)
+        assert isinstance(parse("START TRANSACTION"), ast.Begin)
+        assert isinstance(parse("COMMIT"), ast.Commit)
+
+    def test_union(self):
+        s = parse("SELECT a FROM t UNION ALL SELECT b FROM u ORDER BY 1 LIMIT 5")
+        assert isinstance(s, ast.SetOpSelect) and s.op == "union_all"
+
+    def test_errors(self):
+        with pytest.raises(SqlSyntaxError):
+            parse("SELECT FROM t")
+        with pytest.raises(SqlSyntaxError):
+            parse("SELEC 1")
+        with pytest.raises(SqlSyntaxError):
+            parse("SELECT 1 FROM t WHERE")
+
+    def test_multi_statement_split(self):
+        parts = split_statements("SELECT 1; SELECT 'a;b'; -- c;\nSELECT 2")
+        assert len(parts) == 3
+
+    def test_prepared_params(self):
+        s = parse("SELECT * FROM t WHERE a = ? AND b > ?")
+        # two ParamRef with increasing indexes
+        w = s.where
+        assert isinstance(w.left.right, ast.ParamRef) and w.left.right.index == 0
+        assert w.right.right.index == 1
+
+
+class TestParameterize:
+    def test_basic(self):
+        p = parameterize("SELECT * FROM t WHERE a = 5 AND s = 'x' LIMIT 10")
+        assert p.parameterized == "SELECT * FROM t WHERE a = ? AND s = ? LIMIT 10"
+        assert p.params == [5, "x"]
+
+    def test_same_key_different_values(self):
+        a = parameterize("SELECT * FROM t WHERE a = 5")
+        b = parameterize("SELECT * FROM t WHERE a = 99")
+        assert a.cache_key == b.cache_key
+
+    def test_interval_kept(self):
+        p = parameterize("SELECT * FROM t WHERE d < date '1994-01-01' + interval '1' year")
+        assert "interval '1' year" in p.parameterized
+        assert p.params == ["1994-01-01"]
+
+    def test_ddl_untouched(self):
+        sql = "CREATE TABLE t (a INT DEFAULT 5)"
+        assert parameterize(sql).parameterized == sql

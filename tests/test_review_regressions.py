@@ -115,3 +115,50 @@ class TestNullLiteralProject:
         d, v = _eval(e, b)
         assert d[0] and v[0]          # 1 IN (1, NULL) -> TRUE
         assert not v[1] and not v[2]  # 2 IN (1, NULL) -> NULL
+
+
+class TestRound2Findings:
+    def test_decimal_times_float_literal(self):
+        b = batch_from_pydict({"p": [1.50, 2.25]}, {"p": dt.decimal(15, 2)})
+        e = ir.call("mul", col(b, "p"), ir.lit(2.0))
+        d, v = _eval(e, b)
+        np.testing.assert_allclose(d, [3.0, 4.5], rtol=1e-6)
+
+    def test_min_max_string_collation(self):
+        b = batch_from_pydict({"g": [1, 1, 1], "s": ["zebra", "apple", "mango"]},
+                              {"g": dt.BIGINT, "s": dt.VARCHAR})
+        op = HashAggOp(SourceOp([b]), [("g", col(b, "g"))],
+                       [AggCall("min", col(b, "s"), "mn"),
+                        AggCall("max", col(b, "s"), "mx")])
+        out = run_to_batch(op).to_pydict()
+        assert out["mn"] == ["apple"] and out["mx"] == ["zebra"]
+
+    def test_coalesce_priority(self):
+        b = batch_from_pydict({"a": [None, 10], "x": [1, 2]},
+                              {"a": dt.BIGINT, "x": dt.BIGINT})
+        e = ir.call("coalesce", col(b, "a"), col(b, "x"), ir.lit(0))
+        d, v = _eval(e, b)
+        assert d.tolist() == [1, 10]
+
+    def test_numeric_plus_datetime(self):
+        b = batch_from_pydict({"t": ["2024-01-01 00:00:00"]}, {"t": dt.DATETIME})
+        e = ir.call("add", ir.lit(3), col(b, "t"))
+        d, v = _eval(e, b)
+        from galaxysql_tpu.types import temporal
+        assert temporal.format_datetime(int(d[0])) == "2024-01-04 00:00:00"
+
+    def test_cast_float_to_int_rounds(self):
+        from galaxysql_tpu.expr.ir import Cast
+        b = batch_from_pydict({"f": [1.7, -1.7, 1.2]}, {"f": dt.DOUBLE})
+        d, v = _eval(Cast(col(b, "f"), dt.BIGINT), b)
+        assert d.tolist() == [2, -2, 1]
+
+    def test_left_join_empty_build_keeps_schema(self):
+        build = batch_from_pydict({"k": [], "v": []}, {"k": dt.BIGINT, "v": dt.BIGINT})
+        probe = batch_from_pydict({"pk": [1, 2]}, {"pk": dt.BIGINT})
+        op = HashJoinOp(SourceOp([build]), SourceOp([probe]),
+                        [ir.ColRef("k", dt.BIGINT)], [ir.ColRef("pk", dt.BIGINT)], "left",
+                        build_schema={"k": (dt.BIGINT, None), "v": (dt.BIGINT, None)})
+        out = run_to_batch(op).to_pydict()
+        assert sorted(out.keys()) == ["k", "pk", "v"]
+        assert out["v"] == [None, None] and sorted(out["pk"]) == [1, 2]
