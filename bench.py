@@ -1,0 +1,113 @@
+"""Benchmark driver: TPC-H on the TPU engine vs a measured pandas host baseline.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md), so the baseline is measured on the
+same machine and data: pandas (C-vectorized host columnar execution) standing in for
+the reference's vectorized Java executor.  Metric: TPC-H Q1 rows/sec/chip, steady
+state (plan cache + HBM-resident columns), best of N runs.
+
+Env knobs: BENCH_SF (scale factor, default 0.2), BENCH_RUNS (default 3),
+BENCH_QUERY (default 1).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+try:
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.expanduser("~/.galaxysql_tpu_jax_cache"))
+except Exception:
+    pass
+
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.storage import tpch
+from galaxysql_tpu.storage.tpch_queries import QUERIES
+from galaxysql_tpu.types import temporal
+
+
+def load(sf: float):
+    data = tpch.generate(sf)
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE tpch")
+    s.execute("USE tpch")
+    for t in tpch.TABLE_ORDER:
+        s.execute(tpch.TPCH_DDL[t])
+        inst.store("tpch", t).insert_arrays(data[t], inst.tso.next_timestamp())
+    s.execute("ANALYZE TABLE " + ", ".join(tpch.TABLE_ORDER))
+    return inst, s, data
+
+
+def pandas_q1(data):
+    """Host baseline: pandas implementation of Q1 (vectorized C loops)."""
+    import pandas as pd
+    li = data["lineitem"]
+    cutoff = temporal.parse_date("1998-12-01") - 90
+    df = pd.DataFrame({
+        "flag": li["l_returnflag"], "status": li["l_linestatus"],
+        "qty": li["l_quantity"], "price": li["l_extendedprice"],
+        "disc": li["l_discount"], "tax": li["l_tax"], "ship": li["l_shipdate"],
+    })
+    t0 = time.perf_counter()
+    f = df[df.ship <= cutoff]
+    disc_price = f.price * (1 - f.disc)
+    charge = disc_price * (1 + f.tax)
+    g = f.assign(disc_price=disc_price, charge=charge).groupby(
+        ["flag", "status"], sort=True).agg(
+        sum_qty=("qty", "sum"), sum_base=("price", "sum"),
+        sum_disc=("disc_price", "sum"), sum_charge=("charge", "sum"),
+        avg_qty=("qty", "mean"), avg_price=("price", "mean"),
+        avg_disc=("disc", "mean"), cnt=("qty", "size"))
+    g = g.reset_index()
+    return time.perf_counter() - t0, g
+
+
+def main():
+    sf = float(os.environ.get("BENCH_SF", "0.2"))
+    runs = int(os.environ.get("BENCH_RUNS", "3"))
+    qid = int(os.environ.get("BENCH_QUERY", "1"))
+
+    inst, s, data = load(sf)
+    n_rows = len(data["lineitem"]["l_orderkey"])
+    q = QUERIES[qid]
+
+    # warmup: compile + populate device cache
+    s.execute(q)
+    times = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        s.execute(q)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+
+    # measured host baseline (pandas, same data, best of same run count)
+    base_times = []
+    for _ in range(runs):
+        bt, _g = pandas_q1(data)
+        base_times.append(bt)
+    base_best = min(base_times)
+
+    rows_per_sec = n_rows / best
+    base_rows_per_sec = n_rows / base_best
+    out = {
+        "metric": f"tpch_q{qid}_sf{sf:g}_rows_per_sec_per_chip",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(rows_per_sec / base_rows_per_sec, 3),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,7 @@ metadata and travels in the pytree aux data.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -36,13 +37,18 @@ class Dictionary:
     version).
     """
 
-    __slots__ = ("values", "index", "sorted_codes", "_is_sorted")
+    __slots__ = ("values", "index", "sorted_codes", "_is_sorted", "uid")
+
+    _next_uid = itertools.count(1)
 
     def __init__(self, values: Sequence[str] = ()):  # code i -> values[i]
         self.values: List[str] = list(values)
         self.index: Dict[str, int] = {v: i for i, v in enumerate(self.values)}
         self.sorted_codes: Optional[np.ndarray] = None
         self._is_sorted: Optional[bool] = None
+        # process-unique, never-reused identity (id() can be recycled after GC, which
+        # would alias compiled-kernel cache keys)
+        self.uid = next(Dictionary._next_uid)
 
     def __len__(self) -> int:
         return len(self.values)

@@ -1,0 +1,109 @@
+"""Typed configuration parameters.
+
+Reference analog: `ConnectionParams` — 456 typed params with instance/schema/session
+scopes funneled through `ParamManager` (SURVEY.md §5.6).  Same three-scope resolution:
+session value > instance value > default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamDef:
+    name: str
+    default: Any
+    kind: type
+    doc: str = ""
+
+
+_REGISTRY: Dict[str, ParamDef] = {}
+
+
+def _p(name: str, default: Any, doc: str = "") -> ParamDef:
+    d = ParamDef(name, default, type(default), doc)
+    _REGISTRY[name.upper()] = d
+    return d
+
+
+# --- engine -----------------------------------------------------------------
+ENABLE_TPU_ENGINE = _p("ENABLE_TPU_ENGINE", True, "use device kernels for AP queries")
+AP_ROW_THRESHOLD = _p("AP_ROW_THRESHOLD", 50_000,
+                      "scanned-row estimate above which a query is AP workload")
+BATCH_ROWS = _p("BATCH_ROWS", 1 << 20, "scan batch size (rows)")
+MAX_GROUPS = _p("MAX_GROUPS", 1 << 22, "hash-agg output capacity ceiling")
+JOIN_OUTPUT_FACTOR = _p("JOIN_OUTPUT_FACTOR", 2, "initial join output capacity factor")
+PARALLELISM = _p("PARALLELISM", 0, "local parallel drivers (0 = auto)")
+
+# --- plan cache / optimizer --------------------------------------------------
+PLAN_CACHE = _p("PLAN_CACHE", True, "enable parameterized plan cache")
+PLAN_CACHE_SIZE = _p("PLAN_CACHE_SIZE", 4096, "plan cache entries")
+ENABLE_JOIN_REORDER = _p("ENABLE_JOIN_REORDER", True, "greedy join ordering")
+ENABLE_PARTITION_PRUNING = _p("ENABLE_PARTITION_PRUNING", True, "")
+
+# --- transactions -------------------------------------------------------------
+TRANSACTION_POLICY = _p("TRANSACTION_POLICY", "TSO", "TSO | XA | AUTO_COMMIT")
+SHARE_READ_VIEW = _p("SHARE_READ_VIEW", True, "")
+GET_TSO_TIMEOUT = _p("GET_TSO_TIMEOUT", 5000, "ms")
+DEADLOCK_DETECT_INTERVAL = _p("DEADLOCK_DETECT_INTERVAL", 1000, "ms")
+
+# --- DML ----------------------------------------------------------------------
+DML_BATCH_SIZE = _p("DML_BATCH_SIZE", 10_000, "insert batch size")
+
+# --- MPP ----------------------------------------------------------------------
+MPP_PARALLELISM = _p("MPP_PARALLELISM", 8, "devices per query")
+MPP_MIN_AP_ROWS = _p("MPP_MIN_AP_ROWS", 1 << 22, "rows before cluster MPP kicks in")
+
+# --- CCL ----------------------------------------------------------------------
+CCL_MAX_CONCURRENCY = _p("CCL_MAX_CONCURRENCY", 0, "0 = unlimited")
+CCL_WAIT_QUEUE_SIZE = _p("CCL_WAIT_QUEUE_SIZE", 64, "")
+CCL_WAIT_TIMEOUT = _p("CCL_WAIT_TIMEOUT", 10_000, "ms")
+
+# --- misc ---------------------------------------------------------------------
+SQL_SELECT_LIMIT = _p("SQL_SELECT_LIMIT", -1, "-1 = unlimited")
+SLOW_SQL_MS = _p("SLOW_SQL_MS", 1000, "slow query log threshold")
+ENABLE_TRACE = _p("ENABLE_TRACE", False, "SQL TRACE recording")
+FAILPOINT_ENABLE = _p("FAILPOINT_ENABLE", False, "fail-point injection master switch")
+
+
+class ConfigParams:
+    """Instance-scope values + per-session overlays."""
+
+    def __init__(self):
+        self._instance: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self.version = 0
+
+    @staticmethod
+    def registry() -> Dict[str, ParamDef]:
+        return dict(_REGISTRY)
+
+    def set_instance(self, name: str, value: Any):
+        d = _REGISTRY.get(name.upper())
+        with self._lock:
+            self._instance[name.upper()] = _coerce(d, value)
+            self.version += 1
+
+    def get(self, name: str, session_overlay: Optional[Dict[str, Any]] = None) -> Any:
+        key = name.upper()
+        if session_overlay and key in session_overlay:
+            return session_overlay[key]
+        if key in self._instance:
+            return self._instance[key]
+        d = _REGISTRY.get(key)
+        return d.default if d else None
+
+
+def _coerce(d: Optional[ParamDef], value: Any) -> Any:
+    if d is None:
+        return value
+    if d.kind is bool:
+        if isinstance(value, str):
+            return value.strip().lower() in ("1", "true", "on", "yes")
+        return bool(value)
+    if d.kind is int:
+        return int(value)
+    return value

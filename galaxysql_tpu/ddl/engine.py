@@ -1,0 +1,44 @@
+"""DDL job engine (minimal entry; full DAG engine in ddl/jobs.py as it lands).
+
+Reference analog: the declarative DDL job framework — jobs = DAG of idempotent tasks
+with persisted state and resume/rollback (`DdlEngineDagExecutor.java:102`, SURVEY.md
+§3.5).  CREATE/DROP INDEX route here so the online-GSI state machine
+(CREATING -> DELETE_ONLY -> WRITE_ONLY -> PUBLIC, Appendix D) has a single home.
+"""
+
+from __future__ import annotations
+
+from galaxysql_tpu.meta.catalog import IndexMeta
+from galaxysql_tpu.sql import ast
+from galaxysql_tpu.utils import errors
+
+
+def run_index_ddl(session, stmt):
+    from galaxysql_tpu.server.session import ok
+    schema = session._require_schema()
+    if isinstance(stmt, ast.CreateIndex):
+        tm = session.instance.catalog.table(stmt.table.schema or schema,
+                                            stmt.table.table)
+        idx = stmt.index
+        for c in idx.columns:
+            tm.column(c)  # validate
+        meta = IndexMeta(idx.name or f"i_{len(tm.indexes)}", idx.columns, idx.unique,
+                         idx.global_index, idx.covering)
+        # online build states collapse instantly for the in-memory store; the GSI
+        # backfill path (ddl/backfill.py) takes over once GSI tables materialize
+        meta.status = "PUBLIC"
+        tm.indexes.append(meta)
+        tm.bump_version()
+        session.instance.catalog.version += 1
+        return ok()
+    if isinstance(stmt, ast.DropIndex):
+        tm = session.instance.catalog.table(stmt.table.schema or schema,
+                                            stmt.table.table)
+        before = len(tm.indexes)
+        tm.indexes = [i for i in tm.indexes if i.name.lower() != stmt.name.lower()]
+        if len(tm.indexes) == before:
+            raise errors.TddlError(f"index {stmt.name} does not exist")
+        tm.bump_version()
+        session.instance.catalog.version += 1
+        return ok()
+    raise errors.NotSupportedError(type(stmt).__name__)

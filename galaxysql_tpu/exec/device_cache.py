@@ -1,0 +1,58 @@
+"""Device residency cache: hot table columns pinned in HBM.
+
+The TPU-first answer to the reference's buffer/scan caching: instead of pumping rows
+over JDBC per query (`TableScanClient`, SURVEY.md §2.6), whole column lanes live in
+device memory keyed by (table, partition, column, table-version).  A version bump (DML,
+DDL) invalidates; eviction is LRU by byte budget.  Scans hit HBM, so steady-state AP
+queries read at HBM bandwidth instead of PCIe/host bandwidth.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Key = Tuple[int, int, str, int, int]  # (id(store), pid, column, version, row_count)
+
+
+class DeviceCache:
+    def __init__(self, budget_bytes: int = 8 << 30):
+        self.budget = budget_bytes
+        self._map: "collections.OrderedDict[Key, Any]" = collections.OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get_lane(self, store, pid: int, column: str, version: int,
+                 host_data: np.ndarray) -> Any:
+        key = (id(store), pid, column, version, int(host_data.shape[0]))
+        with self._lock:
+            got = self._map.get(key)
+            if got is not None:
+                self._map.move_to_end(key)
+                self.hits += 1
+                return got
+            self.misses += 1
+        dev = jnp.asarray(host_data)
+        nbytes = host_data.nbytes
+        with self._lock:
+            self._map[key] = dev
+            self._bytes += nbytes
+            while self._bytes > self.budget and len(self._map) > 1:
+                _, old = self._map.popitem(last=False)
+                self._bytes -= old.nbytes if hasattr(old, "nbytes") else 0
+        return dev
+
+    def clear(self):
+        with self._lock:
+            self._map.clear()
+            self._bytes = 0
+
+
+GLOBAL_DEVICE_CACHE = DeviceCache()

@@ -37,6 +37,46 @@ def bucket_capacity(n: int) -> int:
     return c
 
 
+_JIT_CACHE: Dict[Tuple, Any] = {}
+_JIT_CACHE_LOCK = __import__("threading").Lock()
+_JIT_CACHE_LIMIT = 4096
+
+
+def global_jit(key: Tuple, builder):
+    """Process-wide cache of jitted operator kernels.
+
+    Operator instances are rebuilt per execution (plans are immutable, contexts are
+    not), but the compiled XLA programs must survive across executions — otherwise a
+    plan-cache hit still pays a full retrace+recompile.  Keys are semantic: expression
+    tree keys plus the identity AND size of every dictionary whose contents are baked
+    into the closure (a grown dictionary invalidates)."""
+    with _JIT_CACHE_LOCK:
+        f = _JIT_CACHE.get(key)
+        if f is not None:
+            return f
+    f = builder()
+    with _JIT_CACHE_LOCK:
+        if len(_JIT_CACHE) >= _JIT_CACHE_LIMIT:
+            _JIT_CACHE.clear()
+        _JIT_CACHE[key] = f
+    return f
+
+
+def _dict_sig(e: ir.Expr) -> Tuple:
+    """(uid, len) of every dictionary reachable from the expression.  uid is
+    never reused (unlike id()), so a GC'd dictionary cannot alias a cache entry."""
+    out = []
+    for n in ir.walk(e):
+        d = getattr(n, "dictionary", None)
+        if d is not None:
+            out.append((d.uid, len(d)))
+    return tuple(out)
+
+
+def expr_cache_key(e: ir.Expr) -> Tuple:
+    return (e.key(), _dict_sig(e))
+
+
 def broadcast_value(n: int, data, valid):
     """Materialize a compiled (data, valid) pair to full row length.
 
@@ -85,7 +125,10 @@ class Operator:
 
 class SourceOp(Operator):
     def __init__(self, batches: Iterable[ColumnBatch]):
-        self._batches = batches
+        # materialize one-shot iterators: blocking operators (agg overflow retry)
+        # re-iterate their children
+        self._batches = batches if isinstance(batches, (list, tuple)) \
+            else list(batches)
 
     def batches(self) -> Iterator[ColumnBatch]:
         yield from self._batches
@@ -97,17 +140,16 @@ class FilterOp(Operator):
     def __init__(self, child: Operator, predicate: ir.Expr):
         self.child = child
         self.predicate = predicate
-        self._jit = None
 
     def _compiled(self):
-        if self._jit is None:
+        def build():
             pred = ExprCompiler(jnp).compile_predicate(self.predicate)
 
             def run(batch: ColumnBatch) -> ColumnBatch:
                 mask = pred(batch_env(batch))
                 return ColumnBatch(batch.columns, batch.live_mask() & mask)
-            self._jit = jax.jit(run)
-        return self._jit
+            return jax.jit(run)
+        return global_jit(("filter", expr_cache_key(self.predicate)), build)
 
     def batches(self) -> Iterator[ColumnBatch]:
         f = self._compiled()
@@ -121,10 +163,9 @@ class ProjectOp(Operator):
     def __init__(self, child: Operator, exprs: Sequence[Tuple[str, ir.Expr]]):
         self.child = child
         self.exprs = list(exprs)
-        self._jit = None
 
     def _compiled(self):
-        if self._jit is None:
+        def build():
             comp = ExprCompiler(jnp)
             fns = [(name, e, comp.compile(e)) for name, e in self.exprs]
 
@@ -136,8 +177,9 @@ class ProjectOp(Operator):
                     data, valid = broadcast_value(n, *f(env))
                     cols[name] = Column(data, valid, e.dtype, _find_dictionary(e))
                 return ColumnBatch(cols, batch.live)
-            self._jit = jax.jit(run)
-        return self._jit
+            return jax.jit(run)
+        key = ("project", tuple((n, expr_cache_key(e)) for n, e in self.exprs))
+        return global_jit(key, build)
 
     def batches(self) -> Iterator[ColumnBatch]:
         f = self._compiled()
@@ -159,7 +201,6 @@ class HashAggOp(Operator):
         self.group_exprs = list(group_exprs)
         self.aggs = list(aggs)
         self.max_groups = max_groups
-        self._partial_jit_cache: Dict[Tuple, Any] = {}
 
     # -- kernel plumbing ---------------------------------------------------
 
@@ -192,9 +233,16 @@ class HashAggOp(Operator):
                 raise ValueError(a.kind)
         return inputs, lanes
 
+    def _cache_key(self) -> Tuple:
+        return (tuple((n, expr_cache_key(e)) for n, e in self.group_exprs),
+                tuple((a.kind, a.name,
+                       expr_cache_key(a.arg) if a.arg is not None else None)
+                      for a in self.aggs))
+
     def _partial_fn(self, max_groups: int):
-        key = ("partial", max_groups)
-        if key not in self._partial_jit_cache:
+        key = ("agg_partial", self._cache_key(), max_groups)
+
+        def build():
             comp = ExprCompiler(jnp)
             gfns = [comp.compile(e) for _, e in self.group_exprs]
             inputs, lanes = self._partial_specs()
@@ -220,32 +268,47 @@ class HashAggOp(Operator):
                 keys = [broadcast_value(n, *f(env)) for f in gfns]
                 ins = [broadcast_value(n, *f(env)) for f in ifns]
                 return K.sort_groupby(keys, ins, specs, batch.live_mask(), max_groups)
-            self._partial_jit_cache[key] = jax.jit(run)
-        return self._partial_jit_cache[key]
+            return jax.jit(run)
+        return global_jit(key, build)
 
     def _merge_fn(self, max_groups: int, n_keys: int, lane_names: Tuple[str, ...],
                   merge_specs: Tuple[K.AggSpec, ...]):
-        key = ("merge", max_groups, n_keys, merge_specs)
-        if key not in self._partial_jit_cache:
+        # shared across ALL aggregations: behavior depends only on the merge specs and
+        # capacity (key/agg lane dtypes are part of jit's own trace signature)
+        key = ("agg_merge", max_groups, n_keys, merge_specs)
+
+        def build():
             def run(key_lanes, input_lanes, live):
-                return K.sort_groupby(key_lanes, input_lanes, merge_specs, live, max_groups)
-            self._partial_jit_cache[key] = jax.jit(run)
-        return self._partial_jit_cache[key]
+                return K.sort_groupby(key_lanes, input_lanes, merge_specs, live,
+                                      max_groups)
+            return jax.jit(run)
+        return global_jit(key, build)
 
     # -- execution ---------------------------------------------------------
+
+    MAX_GROUPS_CEILING = 1 << 24
 
     def batches(self) -> Iterator[ColumnBatch]:
         inputs, lanes = self._partial_specs()
         lane_names = tuple(name for name, _ in lanes)
-        partials: List[K.GroupByResult] = []
         mg = self.max_groups
-        for b in self.child.batches():
-            f = self._partial_fn(mg)
-            r = f(b)
-            if bool(r.overflow):
-                raise RuntimeError("group cardinality exceeded max_groups; "
-                                   "raise HashAggOp.max_groups")
-            partials.append(jax.tree.map(np.asarray, r))
+        # capacity under-estimates retry the whole aggregation with doubled output
+        # capacity (children re-iterate; scans re-read from the store)
+        while True:
+            partials: List[K.GroupByResult] = []
+            overflowed = False
+            for b in self.child.batches():
+                f = self._partial_fn(mg)
+                r = f(b)
+                if bool(r.overflow):
+                    overflowed = True
+                    break
+                partials.append(jax.tree.map(np.asarray, r))
+            if not overflowed:
+                break
+            mg *= 2
+            if mg > self.MAX_GROUPS_CEILING:
+                raise RuntimeError("group cardinality exceeds engine ceiling")
 
         if not partials:
             if self.group_exprs:
@@ -293,10 +356,14 @@ class HashAggOp(Operator):
                 merge_specs.append(K.AggSpec(spec.kind, len(merge_specs)))
         merge_specs = tuple(merge_specs)
 
-        f = self._merge_fn(mg, len(key_lanes), lane_names, merge_specs)
-        r = f(tuple(key_lanes), tuple(agg_lanes), live)
-        if bool(r.overflow):
-            raise RuntimeError("group cardinality exceeded max_groups in merge")
+        while True:
+            f = self._merge_fn(mg, len(key_lanes), lane_names, merge_specs)
+            r = f(tuple(key_lanes), tuple(agg_lanes), live)
+            if not bool(r.overflow):
+                break
+            mg *= 2  # distinct groups across partials can exceed any one partial's cap
+            if mg > self.MAX_GROUPS_CEILING:
+                raise RuntimeError("group cardinality exceeds engine ceiling")
         yield self._finalize(r, lane_names)
 
     def _finalize(self, r: K.GroupByResult, lane_names: Tuple[str, ...]) -> ColumnBatch:
@@ -369,7 +436,6 @@ class HashJoinOp(Operator):
         # build-side output schema, needed to null-extend when the build side is EMPTY
         # (otherwise the left-join output would be missing the build columns entirely)
         self.build_schema = build_schema
-        self._pairs_jit: Dict[int, Any] = {}
 
     def _key_compilers(self):
         """Compile key pairs into a common lane domain.
@@ -397,7 +463,11 @@ class HashJoinOp(Operator):
         return bk, pk
 
     def _pairs_fn(self, cap: int):
-        if cap not in self._pairs_jit:
+        key = ("join_pairs", cap,
+               tuple(expr_cache_key(e) for e in self.build_keys),
+               tuple(expr_cache_key(e) for e in self.probe_keys))
+
+        def build_fn():
             bk, pk = self._key_compilers()
 
             def run(build: ColumnBatch, probe: ColumnBatch):
@@ -406,8 +476,8 @@ class HashJoinOp(Operator):
                 pkeys = [f(penv) for f in pk]
                 return K.hash_join_pairs(bkeys, pkeys, build.live_mask(),
                                          probe.live_mask(), cap)
-            self._pairs_jit[cap] = jax.jit(run)
-        return self._pairs_jit[cap]
+            return jax.jit(run)
+        return global_jit(key, build_fn)
 
     @staticmethod
     def _gather(batch: ColumnBatch, idx, live) -> Dict[str, Column]:
@@ -462,9 +532,8 @@ class HashJoinOp(Operator):
                 out = ColumnBatch(out.columns, out.live_mask() & mask)
             if self.join_type in ("left", "semi", "anti"):
                 # matched flags must reflect pairs that ALSO passed the residual
-                matched = jax.ops.segment_sum(
-                    out.live_mask().astype(jnp.int32), pairs.probe_idx,
-                    num_segments=pb.capacity) > 0
+                matched = K.probe_matched_from(out.live_mask(), pairs.probe_starts,
+                                               pairs.probe_offsets)
             if self.join_type in ("semi", "anti"):
                 live = pb.live_mask() & (matched if self.join_type == "semi" else ~matched)
                 yield ColumnBatch(pb.columns, live)
@@ -482,6 +551,53 @@ class HashJoinOp(Operator):
                 yield ColumnBatch(ncols, unmatched)
 
 
+class CrossJoinOp(Operator):
+    """Cartesian product with a SMALL materialized build side.
+
+    Exists for the uncorrelated-scalar-subquery pattern (1-row aggregate cross-joined
+    into the outer query, SURVEY.md Q11/Q15/Q22 shapes); guarded against large builds.
+    """
+
+    MAX_CELLS = 1 << 26
+
+    def __init__(self, build: Operator, probe: Operator):
+        self.build = build
+        self.probe = probe
+
+    def batches(self) -> Iterator[ColumnBatch]:
+        build = concat_batches(list(self.build.batches()))
+        nb = build.capacity
+        for pb in self.probe.batches():
+            if nb == 0:
+                return  # empty build: cross join is empty
+            if nb == 1:
+                cols = {}
+                for name, c in build.columns.items():
+                    data = jnp.broadcast_to(c.data[0], (pb.capacity,))
+                    valid = (jnp.broadcast_to(c.valid[0], (pb.capacity,))
+                             if c.valid is not None else None)
+                    cols[name] = Column(data, valid, c.dtype, c.dictionary)
+                cols.update(pb.columns)
+                yield ColumnBatch(cols, pb.live)
+                continue
+            if nb * pb.capacity > self.MAX_CELLS:
+                raise RuntimeError("cross join too large")
+            # expand: probe rows repeated nb times each
+            pidx = jnp.repeat(jnp.arange(pb.capacity), nb)
+            bidx = jnp.tile(jnp.arange(nb), pb.capacity)
+            cols = {}
+            for name, c in build.columns.items():
+                cols[name] = Column(c.data[bidx],
+                                    c.valid[bidx] if c.valid is not None else None,
+                                    c.dtype, c.dictionary)
+            for name, c in pb.columns.items():
+                cols[name] = Column(c.data[pidx],
+                                    c.valid[pidx] if c.valid is not None else None,
+                                    c.dtype, c.dictionary)
+            live = pb.live_mask()[pidx] & build.live_mask()[bidx]
+            yield ColumnBatch(cols, live)
+
+
 class SortOp(Operator):
     """ORDER BY [LIMIT]: materializes input, sorts once."""
 
@@ -492,7 +608,52 @@ class SortOp(Operator):
         self.keys = list(keys)
         self.limit = limit
         self.offset = offset
-        self._jit = None
+
+    def _compiled(self):
+        key = ("sort", tuple((expr_cache_key(e), desc) for e, desc in self.keys),
+               self.limit, self.offset)
+
+        def build():
+            # bind to locals: the cached closure must NOT capture self (it would pin
+            # the whole child operator tree in the process-global kernel cache)
+            limit, offset = self.limit, self.offset
+            comp = ExprCompiler(jnp)
+            kfns = []
+            for e, desc in self.keys:
+                f = comp.compile(e)
+                if e.dtype.is_string:
+                    # dictionary codes are assignment-ordered, not collation-ordered:
+                    # sort by the host-computed rank of each code
+                    d_ = _find_dictionary(e)
+                    if d_ is not None and len(d_) and not d_.is_sorted:
+                        rank = d_.rank_array()
+
+                        def ranked(env, _f=f, _r=rank):
+                            dta, vld = _f(env)
+                            return jnp.asarray(_r)[dta], vld
+                        f = ranked
+                kfns.append((f, desc))
+
+            def run(batch: ColumnBatch) -> ColumnBatch:
+                env = batch_env(batch)
+                keys = []
+                for f, desc in kfns:
+                    d, v = f(env)
+                    keys.append((d, v, desc, not desc))  # NULLs first asc, last desc
+                order = K.sort_indices(keys, batch.live_mask())
+                cols = {}
+                for name, c in batch.columns.items():
+                    cols[name] = Column(c.data[order],
+                                        c.valid[order] if c.valid is not None else None,
+                                        c.dtype, c.dictionary)
+                live = batch.live_mask()[order]
+                if limit is not None:
+                    live = K.limit_mask(live, offset, limit)
+                elif offset:
+                    live = K.limit_mask(live, offset, batch.capacity)
+                return ColumnBatch(cols, live)
+            return jax.jit(run)
+        return global_jit(key, build)
 
     def batches(self) -> Iterator[ColumnBatch]:
         merged = concat_batches(list(self.child.batches()))
@@ -500,45 +661,7 @@ class SortOp(Operator):
             yield merged
             return
         padded = merged.pad_to(bucket_capacity(merged.capacity))
-        comp = ExprCompiler(jnp)
-        kfns = []
-        for e, desc in self.keys:
-            f = comp.compile(e)
-            if e.dtype.is_string:
-                # dictionary codes are assignment-ordered, not collation-ordered: sort by
-                # the host-computed rank of each code (code -> sorted position)
-                d_ = _find_dictionary(e)
-                if d_ is not None and len(d_) and not d_.is_sorted:
-                    rank = d_.rank_array()
-
-                    def ranked(env, _f=f, _r=rank):
-                        dta, vld = _f(env)
-                        return jnp.asarray(_r)[dta], vld
-                    f = ranked
-            kfns.append((f, desc))
-
-        def run(batch: ColumnBatch) -> ColumnBatch:
-            env = batch_env(batch)
-            keys = []
-            for f, desc in kfns:
-                d, v = f(env)
-                keys.append((d, v, desc, not desc))  # MySQL: NULLs first asc, last desc
-            order = K.sort_indices(keys, batch.live_mask())
-            cols = {}
-            for name, c in batch.columns.items():
-                cols[name] = Column(c.data[order],
-                                    c.valid[order] if c.valid is not None else None,
-                                    c.dtype, c.dictionary)
-            live = batch.live_mask()[order]
-            if self.limit is not None:
-                live = K.limit_mask(live, self.offset, self.limit)
-            elif self.offset:
-                live = K.limit_mask(live, self.offset, batch.capacity)
-            return ColumnBatch(cols, live)
-
-        if self._jit is None:
-            self._jit = jax.jit(run)
-        yield self._jit(padded)
+        yield self._compiled()(padded)
 
 
 class LimitOp(Operator):

@@ -327,6 +327,17 @@ class ExprCompiler:
             return self._compile(c)
         if op in ("least", "greatest"):
             return self._least_greatest(e)
+        if op == "dict_transform":
+            # string->string function precomputed on the host dictionary at bind time;
+            # on device it is a single code-translation gather (SURVEY.md §7.1 stance)
+            f = self._compile(e.args[0])
+            trans = e.meta[0]
+            xp = self.xp
+
+            def run_dt(env: Env) -> Value:
+                d, v = f(env)
+                return xp.asarray(trans)[d], v
+            return run_dt
         raise ValueError(f"no lowering for op {op!r}")
 
     def _kleene(self, e: ir.Call) -> Compiled:
@@ -549,10 +560,24 @@ class ExprCompiler:
 
                 def run_d(env: Env) -> Value:
                     (ad, av), (bd, bv) = fa(env), fb(env)
-                    num = ad * _pow10(max(shift, 0))
                     if shift < 0:
-                        num = _signed_div_round(xp, ad, _pow10(-shift))
-                    q = _signed_div_round(xp, num, xp.where(bd == 0, 1, bd))
+                        ad = _signed_div_round(xp, ad, _pow10(-shift))
+                    safe = xp.where(bd == 0, 1, bd)
+                    if shift > 0:
+                        # long division keeps intermediates <= |b| * 10^shift instead
+                        # of |a| * 10^shift (a is often a large aggregate)
+                        P = _pow10(shift)
+                        an = ad < 0
+                        bn = bd < 0
+                        aa = xp.where(an, -ad, ad)
+                        ab = xp.where(bn, -safe, safe)
+                        qi = aa // ab
+                        rem = aa - qi * ab
+                        frac = (rem * P + ab // 2) // ab
+                        q = qi * P + frac
+                        q = xp.where(an != bn, -q, q)
+                    else:
+                        q = _signed_div_round(xp, ad, safe)
                     valid = _and_valid(xp, av, bv)
                     nz = bd != 0
                     valid = nz if valid is None else (valid & nz)
@@ -745,9 +770,20 @@ class ExprCompiler:
 
 
 def _find_dictionary(e: ir.Expr) -> Optional[Dictionary]:
-    for n in ir.walk(e):
-        if isinstance(n, ir.ColRef) and n.dictionary is not None:
-            return n.dictionary
+    """Dictionary governing a string-typed expression's code lane.
+
+    A string-producing Call (substr/upper/...) owns a derived dictionary; otherwise the
+    nearest ColRef's dictionary governs.  Only string-typed subtrees are considered, so a
+    numeric expression over string inputs (e.g. LENGTH) reports none."""
+    if isinstance(e, ir.Call) and e.dictionary is not None:
+        return e.dictionary
+    if isinstance(e, ir.ColRef):
+        return e.dictionary
+    for c in e.children():
+        if c.dtype.is_string:
+            d = _find_dictionary(c)
+            if d is not None:
+                return d
     return None
 
 

@@ -65,12 +65,28 @@ class Call(Expr):
     op: str
     args: List[Expr]
     dtype: dt.DataType
+    # string-producing calls (substr/upper/...) carry a derived host dictionary; the
+    # device lowering is then a code-translation gather (see compiler._dict_transform)
+    dictionary: Optional[Dictionary] = None
+    # host-side metadata for the dict transform (e.g. translation table)
+    meta: Optional[Tuple] = None
 
     def children(self):
         return self.args
 
     def key(self):
-        return ("call", self.op) + tuple(a.key() for a in self.args)
+        base = ("call", self.op) + tuple(a.key() for a in self.args)
+        if self.meta is None and self.dictionary is None:
+            return base
+        # dict_transform semantics live in the translation table + derived dictionary,
+        # not the op name: UPPER(c) and SUBSTR(c,1,2) must not compare equal
+        meta_digest = None
+        if self.meta is not None:
+            meta_digest = tuple(hash(m.tobytes()) if hasattr(m, "tobytes") else m
+                                for m in self.meta)
+        dict_uid = (self.dictionary.uid, len(self.dictionary)) \
+            if self.dictionary is not None else None
+        return base + (meta_digest, dict_uid)
 
     def __repr__(self):
         return f"{self.op}({', '.join(map(repr, self.args))})"

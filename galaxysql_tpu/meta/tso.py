@@ -1,0 +1,40 @@
+"""Timestamp oracle: monotonic TSO for MVCC snapshots and commit ordering.
+
+Reference analog: `ClusterTimestampOracle` fetching `GET_TSO` from GMS (SURVEY.md §3.4).
+Same layout as the reference's TSO: physical millis << 22 | logical counter, so
+timestamps are globally ordered yet roughly wall-clock-meaningful.  In-process here; the
+multi-host deployment fronts this with the gRPC metadata service (meta/gms.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+LOGICAL_BITS = 22
+
+
+class TimestampOracle:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._last_physical = 0
+        self._logical = 0
+
+    def next_timestamp(self) -> int:
+        with self._lock:
+            phys = int(time.time() * 1000)
+            if phys <= self._last_physical:
+                phys = self._last_physical
+                self._logical += 1
+                if self._logical >= (1 << LOGICAL_BITS):
+                    phys += 1
+                    self._logical = 0
+            else:
+                self._logical = 0
+            self._last_physical = phys
+            return (phys << LOGICAL_BITS) | self._logical
+
+    def next_timestamps(self, n: int) -> list:
+        """Batched fetch (the reference batches TSO requests, ClusterTimestampOracle
+        taskQueue)."""
+        return [self.next_timestamp() for _ in range(n)]

@@ -1,0 +1,205 @@
+"""Physical planning: logical plan -> operator tree.
+
+Reference analog: the physical convention step (`DrdsConvention`, SURVEY.md §2.5) +
+`LocalExecutionPlanner` building operator pipelines (§2.7).  Decisions made here:
+
+- hash join sides: build = smaller estimated input (the probe side streams);
+  left/semi/anti joins fix the probe side to the preserved/output side.
+- aggregates use estimated group counts to size the fixed-shape kernel output.
+- scans rename storage columns to plan field ids and carry pruned partition lists.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from galaxysql_tpu.chunk.batch import Column, ColumnBatch
+from galaxysql_tpu.exec import operators as ops
+from galaxysql_tpu.expr import ir
+from galaxysql_tpu.expr.compiler import _find_dictionary
+from galaxysql_tpu.plan import logical as L
+from galaxysql_tpu.plan.rules import estimate_rows
+from galaxysql_tpu.storage.table_store import TableStore
+from galaxysql_tpu.types import datatype as dt
+from galaxysql_tpu.utils import errors
+
+
+class ExecContext:
+    """Per-execution context (ExecutionContext analog, SURVEY.md §2.5 misc)."""
+
+    def __init__(self, stores: Dict[str, TableStore], snapshot_ts: Optional[int] = None,
+                 params: Optional[list] = None, batch_rows: int = 1 << 20,
+                 device_cache=None, txn_id: int = 0):
+        self.stores = stores          # "schema.table" -> TableStore
+        self.snapshot_ts = snapshot_ts
+        self.params = params or []
+        self.batch_rows = batch_rows
+        self.device_cache = device_cache  # DeviceCache or None (host-batch scans)
+        self.txn_id = txn_id          # owning txn for MVCC visibility (0 = none)
+        self.trace: List[str] = []
+
+
+class ScanSource(ops.Operator):
+    """Storage scan renamed into plan field-id space."""
+
+    def __init__(self, node: L.Scan, ctx: ExecContext):
+        self.node = node
+        self.ctx = ctx
+
+    def batches(self) -> Iterator[ColumnBatch]:
+        t = self.node.table
+        store = self.ctx.stores[f"{t.schema.lower()}.{t.name.lower()}"]
+        storage_cols = [c for _, c in self.node.columns]
+        rename = {c: oid for oid, c in self.node.columns}
+        self.ctx.trace.append(
+            f"scan {t.name} partitions={self.node.partitions or 'all'}")
+        from galaxysql_tpu.exec.operators import bucket_capacity
+        cache = self.ctx.device_cache
+        if cache is None:
+            for b in store.scan(storage_cols, self.node.partitions,
+                                self.ctx.snapshot_ts, txn_id=self.ctx.txn_id):
+                # pad to power-of-two buckets: partitions of different sizes must not
+                # each compile their own kernel shapes
+                yield b.pad_to(bucket_capacity(b.capacity)).rename(rename)
+            return
+        # device-resident path: whole column lanes pinned in HBM keyed by table
+        # version; MVCC visibility computed on device from cached ts lanes
+        import jax.numpy as jnp
+        pids = (range(len(store.partitions)) if self.node.partitions is None
+                else self.node.partitions)
+        ts = self.ctx.snapshot_ts
+        for pid in pids:
+            p = store.partitions[pid]
+            if p.num_rows == 0:
+                continue
+            cap = bucket_capacity(p.num_rows)
+
+            def padded(arr, fill=0):
+                if arr.shape[0] == cap:
+                    return arr
+                return np.concatenate(
+                    [arr, np.full(cap - arr.shape[0], fill, dtype=arr.dtype)])
+
+            cols = {}
+            for oid, cname in self.node.columns:
+                cm = t.column(cname)
+                data = cache.get_lane(store, pid, cname, t.version,
+                                      padded(p.lanes[cname]))
+                valid = None
+                if not bool(p.valid[cname].all()):
+                    valid = cache.get_lane(store, pid, f"valid::{cname}", t.version,
+                                           padded(p.valid[cname], False))
+                cols[oid] = Column(data, valid, cm.dtype,
+                                   t.dictionaries.get(cname.lower()))
+            pad_live = jnp.arange(cap) < p.num_rows if cap != p.num_rows else None
+            all_current = bool((p.end_ts == np.iinfo(np.int64).max).all()) and \
+                bool((p.begin_ts >= 0).all())
+            max_begin = int(p.begin_ts.max()) if p.num_rows else 0
+            if all_current and (ts is None or max_begin <= ts):
+                live = pad_live
+            else:
+                begin = cache.get_lane(store, pid, "::begin_ts", t.version,
+                                       padded(p.begin_ts))
+                end = cache.get_lane(store, pid, "::end_ts", t.version,
+                                     padded(p.end_ts, -1))
+                txn_id = self.ctx.txn_id
+                ins_ok = (begin >= 0) & (begin <= ts)
+                dele = (end >= 0) & (end <= ts)
+                if txn_id:
+                    ins_ok = ins_ok | (begin == -txn_id)
+                    dele = dele | (end == -txn_id)
+                live = ins_ok & ~dele
+                if pad_live is not None:
+                    live = live & pad_live
+            yield ColumnBatch(cols, live)
+
+
+class ValuesSource(ops.Operator):
+    def __init__(self, node: L.Values):
+        self.node = node
+
+    def batches(self) -> Iterator[ColumnBatch]:
+        from galaxysql_tpu.chunk.batch import batch_from_pydict
+        rows = self.node.rows
+        if not self.node.schema:
+            # SELECT without FROM: one anonymous row
+            yield batch_from_pydict({"__one": [1] * max(len(rows), 1)},
+                                    {"__one": dt.BIGINT})
+            return
+        data = {fid: [r[i] for r in rows] for i, (fid, _, _) in
+                enumerate(self.node.schema)}
+        schema = {fid: typ for fid, typ, _ in self.node.schema}
+        dicts = {fid: d for fid, typ, d in self.node.schema if d is not None}
+        yield batch_from_pydict(data, schema, dicts)
+
+
+def build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
+    if isinstance(node, L.Scan):
+        return ScanSource(node, ctx)
+    if isinstance(node, L.Values):
+        return ValuesSource(node)
+    if isinstance(node, L.Filter):
+        return ops.FilterOp(build_operator(node.child, ctx), node.cond)
+    if isinstance(node, L.Project):
+        return ops.ProjectOp(build_operator(node.child, ctx), node.exprs)
+    if isinstance(node, L.Aggregate):
+        est = estimate_rows(node)
+        max_groups = 1 << max(int(est * 2).bit_length(), 10)
+        max_groups = min(max_groups, 1 << 22)
+        calls = [ops.AggCall(a.kind, a.arg, a.out_id) for a in node.aggs]
+        return ops.HashAggOp(build_operator(node.child, ctx),
+                             node.groups, calls, max_groups=max_groups)
+    if isinstance(node, L.Join):
+        return _build_join(node, ctx)
+    if isinstance(node, L.Sort):
+        return ops.SortOp(build_operator(node.child, ctx), node.keys,
+                          node.limit, node.offset)
+    if isinstance(node, L.Limit):
+        return ops.LimitOp(build_operator(node.child, ctx), node.limit, node.offset)
+    if isinstance(node, L.Union):
+        children = [build_operator(c, ctx) for c in node.children]
+        # align column ids across inputs: rename every child to the first child's ids
+        first_ids = node.children[0].field_ids()
+
+        class UnionOp(ops.Operator):
+            def __init__(self, children, id_lists):
+                self.children_ops = children
+                self.id_lists = id_lists
+
+            def batches(self):
+                for op, ids in zip(self.children_ops, self.id_lists):
+                    rename = dict(zip(ids, first_ids))
+                    for b in op.batches():
+                        yield b.rename(rename)
+
+        u = UnionOp(children, [c.field_ids() for c in node.children])
+        if node.all:
+            return u
+        return ops.DistinctOp(u, [(fid, ir.ColRef(fid, typ, d))
+                                  for fid, typ, d in node.fields()])
+    raise errors.NotSupportedError(f"no physical operator for {type(node).__name__}")
+
+
+def _build_join(node: L.Join, ctx: ExecContext) -> ops.Operator:
+    left = build_operator(node.left, ctx)
+    right = build_operator(node.right, ctx)
+    if node.kind == "cross":
+        return ops.CrossJoinOp(right, left)  # build = right side (small by construction)
+    lkeys = [a for a, _ in node.equi]
+    rkeys = [b for _, b in node.equi]
+    right_schema = {fid: (typ, d) for fid, typ, d in node.right.fields()}
+    if node.kind in ("left", "semi", "anti"):
+        # probe side MUST be the preserved/output (left) side
+        return ops.HashJoinOp(right, left, rkeys, lkeys, node.kind,
+                              residual=node.residual, build_schema=right_schema)
+    # inner: build the smaller estimated side
+    l_est = estimate_rows(node.left)
+    r_est = estimate_rows(node.right)
+    if r_est <= l_est:
+        return ops.HashJoinOp(right, left, rkeys, lkeys, "inner",
+                              residual=node.residual, build_schema=right_schema)
+    left_schema = {fid: (typ, d) for fid, typ, d in node.left.fields()}
+    return ops.HashJoinOp(left, right, lkeys, rkeys, "inner",
+                          residual=node.residual, build_schema=left_schema)

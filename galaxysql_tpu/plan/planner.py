@@ -1,0 +1,170 @@
+"""Planner driver + plan cache.
+
+Reference analog: `Planner.plan(sql, ec) -> ExecutionPlan` (SURVEY.md §2.5):
+parameterize -> plan-cache probe -> parse -> bind/validate -> RBO -> (physical at
+execution).  The cache key is (schema, parameterized SQL); entries are invalidated by
+catalog version, mirroring `PlanCache.java:80`'s metadata-version keying.
+
+Workload classification (TP vs AP) follows `WorkloadUtil.determineWorkloadType`
+(§2.5): estimated scanned rows under threshold -> TP; over -> AP.  The executor uses
+this to pick the engine (host path for latency-bound point queries, device kernels for
+scans), mirroring `ExecutorHelper.selectExecutorMode`.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from galaxysql_tpu.meta.catalog import Catalog
+from galaxysql_tpu.plan import logical as L
+from galaxysql_tpu.plan.binder import Binder
+from galaxysql_tpu.plan.rules import estimate_rows, optimize
+from galaxysql_tpu.sql import ast
+from galaxysql_tpu.sql.parameterize import parameterize
+from galaxysql_tpu.sql.parser import parse
+
+
+class ExecutionPlan:
+    def __init__(self, rel: L.RelNode, display_names: List[str],
+                 statement: ast.Statement, catalog_version: int,
+                 param_count: int):
+        self.rel = rel
+        self.display_names = display_names
+        self.statement = statement
+        self.catalog_version = catalog_version
+        self.param_count = param_count
+        self.workload = classify_workload(rel)
+
+    def fields(self) -> List[L.Field]:
+        return self.rel.fields()
+
+    def explain(self) -> str:
+        return L.explain(self.rel)
+
+
+AP_ROW_THRESHOLD = 50_000
+
+
+def classify_workload(rel: L.RelNode) -> str:
+    """TP = small row footprint (host engine); AP = large (device engine)."""
+    total = 0.0
+    for n in L.walk(rel):
+        if isinstance(n, L.Scan):
+            frac = 1.0
+            if n.partitions is not None and n.table.partition.num_partitions > 0:
+                frac = len(n.partitions) / n.table.partition.num_partitions
+            total += n.table.stats.row_count * frac
+    return "AP" if total >= AP_ROW_THRESHOLD else "TP"
+
+
+class PlanCache:
+    """Guava-cache analog: bounded LRU keyed by (schema, parameterized SQL)."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self._map: "collections.OrderedDict[Tuple[str, str], ExecutionPlan]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: Tuple[str, str], catalog_version: int) -> Optional[ExecutionPlan]:
+        with self._lock:
+            plan = self._map.get(key)
+            if plan is None or plan.catalog_version != catalog_version:
+                if plan is not None:
+                    del self._map[key]
+                self.misses += 1
+                return None
+            self._map.move_to_end(key)
+            self.hits += 1
+            return plan
+
+    def put(self, key: Tuple[str, str], plan: ExecutionPlan):
+        with self._lock:
+            self._map[key] = plan
+            self._map.move_to_end(key)
+            while len(self._map) > self.capacity:
+                self._map.popitem(last=False)
+
+    def invalidate_all(self):
+        with self._lock:
+            self._map.clear()
+
+
+class Planner:
+    def __init__(self, catalog: Catalog):
+        self.catalog = catalog
+        self.cache = PlanCache()
+
+    def plan_select(self, sql: str, schema: str,
+                    params: Optional[list] = None) -> ExecutionPlan:
+        """Plan a SELECT (or EXPLAIN-able) statement with caching."""
+        p = parameterize(sql)
+        key = (schema.lower(), p.cache_key)
+        effective_params = list(p.params)
+        if params:
+            # explicit protocol params replace ?s the client sent; literal
+            # parameterization only applies when the SQL carried inline literals
+            effective_params = params
+            key = (schema.lower(), sql)
+        cached = self.cache.get(key, self.catalog.version)
+        if cached is not None and cached.param_count == len(effective_params) and \
+                _params_compatible(cached, effective_params):
+            return self._rebind_if_needed(cached, sql, schema, effective_params)
+        stmt = parse(sql)
+        plan = self.bind_statement(stmt, schema, effective_params)
+        self.cache.put(key, plan)
+        return plan
+
+    def _rebind_if_needed(self, cached: ExecutionPlan, sql: str, schema: str,
+                          params: list) -> ExecutionPlan:
+        # Plans bake literal values into compiled expressions (partition pruning and
+        # dictionary resolution are value-dependent, like PostPlanner re-pruning per
+        # execution).  Same values -> reuse as-is; different values -> re-bind from the
+        # cached AST (skips parsing, the expensive part for big statements).
+        if cached.bound_params == params:
+            return cached
+        plan = self.bind_statement(cached.statement, schema, params)
+        return plan
+
+    def bind_statement(self, stmt: ast.Statement, schema: str,
+                       params: list) -> ExecutionPlan:
+        binder = Binder(self.catalog, schema, params)
+        if isinstance(stmt, ast.Select):
+            rel, names, _ = binder.bind_select(stmt)
+        elif isinstance(stmt, ast.SetOpSelect):
+            rel, names = self._bind_setop(binder, stmt)
+        else:
+            raise ValueError(f"not a plannable statement: {type(stmt).__name__}")
+        rel = optimize(rel)
+        plan = ExecutionPlan(rel, names, stmt, self.catalog.version, len(params))
+        plan.bound_params = list(params)
+        return plan
+
+    def _bind_setop(self, binder: Binder, stmt: ast.SetOpSelect):
+        parts: List[Tuple[L.RelNode, List[str]]] = []
+
+        def flatten(s):
+            if isinstance(s, ast.SetOpSelect):
+                if s.op != stmt.op:
+                    rel, names = self._bind_setop(binder, s)
+                    parts.append((rel, names))
+                    return
+                flatten(s.left)
+                flatten(s.right)
+            else:
+                rel, names, _ = binder.bind_select(s)
+                parts.append((rel, names))
+        flatten(stmt.left)
+        flatten(stmt.right)
+        rels = [r for r, _ in parts]
+        names = parts[0][1]
+        union = L.Union(rels, stmt.op == "union_all")
+        return union, names
+
+
+def _params_compatible(plan: ExecutionPlan, params: list) -> bool:
+    return getattr(plan, "bound_params", None) is not None

@@ -1,0 +1,401 @@
+"""Rule-based rewrites + greedy cost-guided join ordering.
+
+Reference analog: the RBO push-down rule set + CBO join reorder of `core/planner/rule`
+(SURVEY.md §2.5).  Kept deliberately small: the four rewrites below shape all of TPC-H.
+
+1. factor_or_conjuncts — Q19 pattern: (A and X) or (B and X) -> X and (A or B), so the
+   shared equi predicate becomes a join key.
+2. build_join_tree — flatten cross-join forests + the WHERE conjunction into a join
+   graph; greedily order joins smallest-estimated-first (broadcast/filtered dimensions
+   join early), emitting equi joins with residuals.
+3. push_filters / prune_columns — classic pushdown; scans read only referenced columns.
+4. prune_partitions — point/range predicates on partition columns shrink scanned shards
+   (`PartitionPruner` analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from galaxysql_tpu.expr import ir
+from galaxysql_tpu.meta.catalog import PartitionRouter
+from galaxysql_tpu.plan import logical as L
+from galaxysql_tpu.types import datatype as dt
+
+# ---------------------------------------------------------------------------
+# expression helpers
+# ---------------------------------------------------------------------------
+
+
+def conjuncts(e: Optional[ir.Expr]) -> List[ir.Expr]:
+    if e is None:
+        return []
+    if isinstance(e, ir.Call) and e.op == "and":
+        return conjuncts(e.args[0]) + conjuncts(e.args[1])
+    return [e]
+
+
+def disjuncts(e: ir.Expr) -> List[ir.Expr]:
+    if isinstance(e, ir.Call) and e.op == "or":
+        return disjuncts(e.args[0]) + disjuncts(e.args[1])
+    return [e]
+
+
+def factor_or_conjuncts(e: ir.Expr) -> ir.Expr:
+    """(A ∧ X ∧ ...) ∨ (B ∧ X ∧ ...) -> X ∧ ((A ∧ ...) ∨ (B ∧ ...))."""
+    ds = disjuncts(e)
+    if len(ds) < 2:
+        return e
+    sets = [{c.key(): c for c in conjuncts(d)} for d in ds]
+    common_keys = set(sets[0])
+    for s in sets[1:]:
+        common_keys &= set(s)
+    if not common_keys:
+        return e
+    common = [sets[0][k] for k in common_keys]
+    rest = []
+    for d, s in zip(ds, sets):
+        remaining = [c for c in conjuncts(d) if c.key() not in common_keys]
+        rest.append(ir.and_(*remaining) if remaining else ir.lit(True, dt.BOOL))
+    return ir.and_(*(common + [ir.or_(*rest)]))
+
+
+# ---------------------------------------------------------------------------
+# join tree construction
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _Rel:
+    node: L.RelNode
+    ids: Set[str]
+    est_rows: float
+
+
+def estimate_rows(node: L.RelNode) -> float:
+    """Cheap cardinality estimate for ordering decisions (stats-backed at scans)."""
+    if isinstance(node, L.Scan):
+        return max(float(node.table.stats.row_count), 1.0)
+    if isinstance(node, L.Filter):
+        sel = 1.0
+        for c in conjuncts(node.cond):
+            sel *= _selectivity(c)
+        return max(estimate_rows(node.child) * sel, 1.0)
+    if isinstance(node, L.Project):
+        return estimate_rows(node.child)
+    if isinstance(node, L.Aggregate):
+        base = estimate_rows(node.child)
+        if not node.groups:
+            return 1.0
+        return max(base ** 0.7, 1.0)
+    if isinstance(node, L.Join):
+        l = estimate_rows(node.left)
+        r = estimate_rows(node.right)
+        if node.kind == "cross":
+            return l * r
+        if node.kind in ("semi", "anti"):
+            return l * 0.5
+        return max(l, r)  # FK-join heuristic
+    if isinstance(node, L.Sort):
+        n = estimate_rows(node.child)
+        return min(n, node.limit) if node.limit else n
+    if isinstance(node, L.Limit):
+        return float(node.limit)
+    if isinstance(node, L.Union):
+        return sum(estimate_rows(c) for c in node.children)
+    if isinstance(node, L.Values):
+        return float(len(node.rows))
+    return 1000.0
+
+
+def _selectivity(c: ir.Expr) -> float:
+    if isinstance(c, ir.Call):
+        if c.op == "eq":
+            return 0.05
+        if c.op in ("lt", "le", "gt", "ge"):
+            return 0.3
+        if c.op == "between":
+            return 0.25
+        if c.op in ("like",):
+            return 0.1
+        if c.op == "or":
+            return min(sum(_selectivity(d) for d in disjuncts(c)), 1.0)
+        if c.op == "ne":
+            return 0.9
+    if isinstance(c, ir.InList):
+        return min(0.05 * max(len(c.values), 1), 1.0)
+    return 0.5
+
+
+def build_join_tree(node: L.RelNode) -> L.RelNode:
+    """Rewrite Filter-over-cross-join forests into ordered equi-join trees."""
+    node = _rewrite_children(node, build_join_tree)
+    preds: List[ir.Expr] = []
+    base = node
+    if isinstance(node, L.Filter):
+        preds = [factor_or_conjuncts(c) for c in conjuncts(node.cond)]
+        # factoring may expose new conjuncts
+        preds = [c2 for p in preds for c2 in conjuncts(p)]
+        base = node.child
+    rels = _flatten_crosses(base)
+    if len(rels) <= 1 and not isinstance(base, L.Join):
+        return L.Filter(base, ir.and_(*preds)) if preds else base
+    if not any(isinstance(r, L.Join) and r.kind == "cross" for r in [base]) and \
+            len(rels) == 1:
+        return L.Filter(base, ir.and_(*preds)) if preds else base
+
+    relinfos = [_Rel(r, set(r.field_ids()), 0.0) for r in rels]
+
+    # split predicates: single-rel -> push down; two-rel equi -> join edges; rest -> later
+    edges: List[Tuple[int, int, ir.Expr, ir.Expr]] = []
+    residual_preds: List[ir.Expr] = []
+    local: Dict[int, List[ir.Expr]] = {i: [] for i in range(len(relinfos))}
+    for p in preds:
+        refs = set(ir.referenced_columns(p))
+        owners = [i for i, ri in enumerate(relinfos) if refs & ri.ids]
+        if len(owners) == 0:
+            residual_preds.append(p)  # constant predicate
+        elif len(owners) == 1:
+            local[owners[0]].append(p)
+        elif len(owners) == 2 and isinstance(p, ir.Call) and p.op == "eq":
+            a, b = p.args
+            ra, rb = set(ir.referenced_columns(a)), set(ir.referenced_columns(b))
+            i, j = owners
+            if ra <= relinfos[i].ids and rb <= relinfos[j].ids:
+                edges.append((i, j, a, b))
+            elif ra <= relinfos[j].ids and rb <= relinfos[i].ids:
+                edges.append((j, i, a, b))
+            else:
+                residual_preds.append(p)
+        else:
+            residual_preds.append(p)
+
+    for i, ps in local.items():
+        if ps:
+            relinfos[i] = _Rel(L.Filter(relinfos[i].node, ir.and_(*ps)),
+                               relinfos[i].ids, 0.0)
+    for ri in relinfos:
+        ri.est_rows = estimate_rows(ri.node)
+
+    # greedy: start at the smallest relation, repeatedly join the connected relation
+    # with the smallest estimate; unconnected relations fall back to cross joins last
+    remaining = set(range(len(relinfos)))
+    start = min(remaining, key=lambda i: relinfos[i].est_rows)
+    current = relinfos[start]
+    remaining.discard(start)
+    current_members = {start}
+    used_edges: Set[int] = set()
+
+    def connected(i: int) -> bool:
+        return any((a in current_members and b == i) or (b in current_members and a == i)
+                   for a, b, _, _ in edges)
+
+    while remaining:
+        candidates = [i for i in remaining if connected(i)]
+        if not candidates:
+            nxt = min(remaining, key=lambda i: relinfos[i].est_rows)
+            current = _Rel(L.Join(current.node, relinfos[nxt].node, "cross", []),
+                           current.ids | relinfos[nxt].ids,
+                           current.est_rows * relinfos[nxt].est_rows)
+            current_members.add(nxt)
+            remaining.discard(nxt)
+            continue
+        nxt = min(candidates, key=lambda i: relinfos[i].est_rows)
+        eq_pairs: List[Tuple[ir.Expr, ir.Expr]] = []
+        for k, (a, b, ea, eb) in enumerate(edges):
+            if k in used_edges:
+                continue
+            if a in current_members and b == nxt:
+                eq_pairs.append((ea, eb))
+                used_edges.add(k)
+            elif b in current_members and a == nxt:
+                eq_pairs.append((eb, ea))
+                used_edges.add(k)
+        rel = relinfos[nxt]
+        # probe side = current accumulated tree, build = the joined-in relation if it is
+        # smaller; physical layer finalizes sides, logical Join is (left=probe-ish)
+        current = _Rel(L.Join(current.node, rel.node, "inner", eq_pairs),
+                       current.ids | rel.ids, max(current.est_rows, rel.est_rows))
+        current_members.add(nxt)
+        remaining.discard(nxt)
+
+    # any edges between already-joined members that were not consumed become filters
+    for k, (a, b, ea, eb) in enumerate(edges):
+        if k not in used_edges:
+            residual_preds.append(ir.call("eq", ea, eb))
+    out = current.node
+    if residual_preds:
+        out = L.Filter(out, ir.and_(*residual_preds))
+    return out
+
+
+def _flatten_crosses(node: L.RelNode) -> List[L.RelNode]:
+    if isinstance(node, L.Join) and node.kind == "cross" and not node.equi:
+        return _flatten_crosses(node.left) + _flatten_crosses(node.right)
+    return [node]
+
+
+def _rewrite_children(node: L.RelNode, fn) -> L.RelNode:
+    node.children = [fn(c) for c in node.children]
+    return node
+
+
+# ---------------------------------------------------------------------------
+# filter pushdown (through Project / into Join sides)
+# ---------------------------------------------------------------------------
+
+def push_filters(node: L.RelNode) -> L.RelNode:
+    node = _rewrite_children(node, push_filters)
+    if not isinstance(node, L.Filter):
+        return node
+    child = node.child
+    if isinstance(child, L.Filter):
+        merged = L.Filter(child.child, ir.and_(child.cond, node.cond))
+        return push_filters(merged)
+    if isinstance(child, L.Join) and child.kind in ("inner", "semi", "anti", "left"):
+        left_ids = set(child.left.field_ids())
+        right_ids = set(child.right.field_ids())
+        keep: List[ir.Expr] = []
+        lpush: List[ir.Expr] = []
+        rpush: List[ir.Expr] = []
+        for c in conjuncts(node.cond):
+            refs = set(ir.referenced_columns(c))
+            if refs <= left_ids:
+                lpush.append(c)
+            elif refs <= right_ids and child.kind == "inner":
+                rpush.append(c)
+            else:
+                keep.append(c)
+        if lpush:
+            child.children[0] = push_filters(L.Filter(child.left, ir.and_(*lpush)))
+        if rpush:
+            child.children[1] = push_filters(L.Filter(child.right, ir.and_(*rpush)))
+        if keep:
+            return L.Filter(child, ir.and_(*keep))
+        return child
+    return node
+
+
+# ---------------------------------------------------------------------------
+# column pruning
+# ---------------------------------------------------------------------------
+
+def prune_columns(node: L.RelNode, required: Optional[Set[str]] = None) -> L.RelNode:
+    """Drop unreferenced columns from scans and projections (top-down)."""
+    if required is None:
+        required = set(node.field_ids())
+
+    if isinstance(node, L.Scan):
+        cols = [(oid, c) for oid, c in node.columns if oid in required]
+        if not cols:
+            cols = node.columns[:1]  # keep at least one lane for row existence
+        node.columns = cols
+        return node
+    if isinstance(node, L.Project):
+        node.exprs = [(n, e) for n, e in node.exprs if n in required] or node.exprs[:1]
+        need = set()
+        for _, e in node.exprs:
+            need.update(ir.referenced_columns(e))
+        node.children = [prune_columns(node.child, need)]
+        return node
+    if isinstance(node, L.Filter):
+        need = set(required) | set(ir.referenced_columns(node.cond))
+        node.children = [prune_columns(node.child, need)]
+        return node
+    if isinstance(node, L.Aggregate):
+        need = set()
+        for _, e in node.groups:
+            need.update(ir.referenced_columns(e))
+        for a in node.aggs:
+            if a.arg is not None:
+                need.update(ir.referenced_columns(a.arg))
+        node.children = [prune_columns(node.child, need)]
+        return node
+    if isinstance(node, L.Join):
+        need = set(required)
+        for a, b in node.equi:
+            need.update(ir.referenced_columns(a))
+            need.update(ir.referenced_columns(b))
+        if node.residual is not None:
+            need.update(ir.referenced_columns(node.residual))
+        left_ids = set(node.left.field_ids())
+        right_ids = set(node.right.field_ids())
+        node.children = [prune_columns(node.left, need & left_ids),
+                         prune_columns(node.right, need & right_ids)]
+        return node
+    if isinstance(node, L.Sort):
+        need = set(required)
+        for e, _ in node.keys:
+            need.update(ir.referenced_columns(e))
+        node.children = [prune_columns(node.child, need)]
+        return node
+    if isinstance(node, (L.Limit,)):
+        node.children = [prune_columns(node.child, set(required))]
+        return node
+    if isinstance(node, L.Union):
+        node.children = [prune_columns(c, set(c.field_ids())) for c in node.children]
+        return node
+    return node
+
+
+# ---------------------------------------------------------------------------
+# partition pruning
+# ---------------------------------------------------------------------------
+
+def prune_partitions(node: L.RelNode) -> L.RelNode:
+    node = _rewrite_children(node, prune_partitions)
+    if not isinstance(node, L.Filter) or not isinstance(node.child, L.Scan):
+        return node
+    scan = node.child
+    info = scan.table.partition
+    if info.method in ("single", "broadcast") or info.num_partitions <= 1:
+        return node
+    router = PartitionRouter(scan.table)
+    id_to_col = {oid: col for oid, col in scan.columns}
+    parts: Optional[Set[int]] = None
+    for c in conjuncts(node.cond):
+        got = _prune_one(c, router, id_to_col)
+        if got is not None:
+            parts = set(got) if parts is None else (parts & set(got))
+    if parts is not None:
+        scan.partitions = sorted(parts)
+    return node
+
+
+def _prune_one(c: ir.Expr, router: PartitionRouter, id_to_col) -> Optional[List[int]]:
+    if isinstance(c, ir.Call) and c.op == "eq":
+        col, lit = _col_lit(c.args[0], c.args[1], id_to_col)
+        if col is not None:
+            return router.prune_eq(col, lit)
+    if isinstance(c, ir.InList) and not c.negated:
+        if isinstance(c.arg, ir.ColRef) and c.arg.name in id_to_col:
+            out: List[int] = []
+            for v in c.values:
+                got = router.prune_eq(id_to_col[c.arg.name], v)
+                if got is None:
+                    return None
+                out.extend(got)
+            return sorted(set(out))
+    return None
+
+
+def _col_lit(a: ir.Expr, b: ir.Expr, id_to_col):
+    if isinstance(a, ir.ColRef) and isinstance(b, ir.Literal) and a.name in id_to_col:
+        return id_to_col[a.name], b.value
+    if isinstance(b, ir.ColRef) and isinstance(a, ir.Literal) and b.name in id_to_col:
+        return id_to_col[b.name], a.value
+    return None, None
+
+
+def optimize(node: L.RelNode) -> L.RelNode:
+    """The full RBO pipeline.
+
+    push_filters runs BEFORE join-tree construction: subquery unnesting wraps the
+    cross-join forest in semi/anti joins, and the WHERE conjuncts above them must reach
+    the forest first or the forest would be ordered without its predicates."""
+    node = push_filters(node)
+    node = build_join_tree(node)
+    node = push_filters(node)
+    node = prune_partitions(node)
+    node = prune_columns(node)
+    return node

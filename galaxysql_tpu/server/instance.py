@@ -1,0 +1,62 @@
+"""Engine instance: the in-process root object (CobarServer/TDataSource analog).
+
+Owns the catalog, table stores, planner, TSO, and config (SURVEY.md §2.2/§3.1 boot
+path).  Sessions (`server/session.py`) hang off an Instance the way ServerConnections
+hang off CobarServer.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+from galaxysql_tpu.config.params import ConfigParams
+from galaxysql_tpu.meta.catalog import Catalog, TableMeta
+from galaxysql_tpu.meta.tso import TimestampOracle
+from galaxysql_tpu.plan.planner import Planner
+from galaxysql_tpu.storage.table_store import TableStore
+
+
+class Instance:
+    def __init__(self, data_dir: Optional[str] = None):
+        self.catalog = Catalog()
+        self.stores: Dict[str, TableStore] = {}
+        self.planner = Planner(self.catalog)
+        self.tso = TimestampOracle()
+        self.config = ConfigParams()
+        self.data_dir = data_dir
+        self.lock = threading.RLock()
+        self.catalog.create_schema("information_schema", if_not_exists=True)
+        self.next_conn_id = 1
+        self.sessions: Dict[int, object] = {}
+
+    # -- store management ------------------------------------------------------
+
+    def store_key(self, schema: str, table: str) -> str:
+        return f"{schema.lower()}.{table.lower()}"
+
+    def register_table(self, tm: TableMeta) -> TableStore:
+        store = TableStore(tm)
+        self.stores[self.store_key(tm.schema, tm.name)] = store
+        return store
+
+    def drop_store(self, schema: str, table: str):
+        self.stores.pop(self.store_key(schema, table), None)
+
+    def store(self, schema: str, table: str) -> TableStore:
+        return self.stores[self.store_key(schema, table)]
+
+    # -- persistence -----------------------------------------------------------
+
+    def save(self):
+        if not self.data_dir:
+            return
+        for key, store in self.stores.items():
+            store.save(os.path.join(self.data_dir, key.replace(".", os.sep)))
+
+    def allocate_conn_id(self) -> int:
+        with self.lock:
+            cid = self.next_conn_id
+            self.next_conn_id += 1
+            return cid

@@ -1,0 +1,568 @@
+"""Session: per-connection state + statement dispatch.
+
+Reference analog: `ServerConnection` (§2.2) — schema selection, autocommit/transaction
+lifecycle, and `innerExecute` as the top of every query.  DQL goes parse -> plan ->
+operators; DML runs the TP host path against the MVCC store; DDL/SET/SHOW/USE handled
+inline (the reference's 133 logical handlers, §2.6, are this dispatch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from galaxysql_tpu.chunk.batch import ColumnBatch, Dictionary
+from galaxysql_tpu.exec.operators import run_to_batch
+from galaxysql_tpu.expr import ir
+from galaxysql_tpu.expr.compiler import ExprCompiler, _find_dictionary
+from galaxysql_tpu.meta.catalog import (ColumnMeta, IndexMeta, PartitionInfo, TableMeta,
+                                        SINGLE)
+from galaxysql_tpu.plan import logical as L
+from galaxysql_tpu.plan.binder import Binder, Scope
+from galaxysql_tpu.plan.physical import ExecContext, build_operator
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.sql import ast
+from galaxysql_tpu.sql.lexer import split_statements
+from galaxysql_tpu.sql.parser import parse
+from galaxysql_tpu.storage.table_store import INFINITY_TS
+from galaxysql_tpu.types import datatype as dt
+from galaxysql_tpu.utils import errors
+
+
+@dataclasses.dataclass
+class ResultSet:
+    names: List[str]
+    types: List[dt.DataType]
+    rows: List[Tuple]
+    affected: int = 0
+    last_insert_id: int = 0
+    info: str = ""
+
+    @property
+    def is_query(self) -> bool:
+        return bool(self.names)
+
+
+def ok(affected: int = 0, info: str = "", last_insert_id: int = 0) -> ResultSet:
+    return ResultSet([], [], [], affected, last_insert_id, info)
+
+
+class Transaction:
+    """TSO transaction: snapshot at begin, provisional (-txn_id) stamps on writes,
+    finalized to a fresh commit timestamp at COMMIT (TsoTransaction analog, §3.4)."""
+
+    def __init__(self, ts: int):
+        self.snapshot_ts = ts
+        self.txn_id = ts  # TSO values are unique; the snapshot doubles as txn id
+        # (store, pid, start_row, n) appended ranges awaiting commit stamp
+        self.inserted: List[Tuple[Any, int, int, int]] = []
+        # (store, pid, row_ids, old_end_ts) provisional deletes
+        self.deleted: List[Tuple[Any, int, np.ndarray, np.ndarray]] = []
+
+    def touched_tables(self):
+        seen = {}
+        for store, *_ in self.inserted + self.deleted:
+            seen[id(store)] = store
+        return seen.values()
+
+
+class Session:
+    def __init__(self, instance: Instance, schema: Optional[str] = None):
+        self.instance = instance
+        self.conn_id = instance.allocate_conn_id()
+        self.schema = schema
+        self.autocommit = True
+        self.txn: Optional[Transaction] = None
+        self.vars: Dict[str, Any] = {}
+        self.user_vars: Dict[str, Any] = {}
+        self.last_trace: List[str] = []
+        instance.sessions[self.conn_id] = self
+
+    # -- public API -----------------------------------------------------------
+
+    def execute(self, sql: str, params: Optional[list] = None) -> ResultSet:
+        stmts = split_statements(sql)
+        if not stmts:
+            return ok()
+        result = ok()
+        for s in stmts:
+            result = self._execute_one(s, params)
+        return result
+
+    def close(self):
+        if self.txn is not None:
+            self._rollback()
+        self.instance.sessions.pop(self.conn_id, None)
+
+    # -- dispatch ----------------------------------------------------------------
+
+    def _execute_one(self, sql: str, params: Optional[list]) -> ResultSet:
+        stmt = parse(sql)
+        return self.execute_statement(stmt, sql, params)
+
+    def execute_statement(self, stmt: ast.Statement, sql: str = "",
+                          params: Optional[list] = None) -> ResultSet:
+        if isinstance(stmt, (ast.Select, ast.SetOpSelect)):
+            return self._run_query(stmt, sql, params)
+        if isinstance(stmt, ast.Insert):
+            return self._run_insert(stmt, params)
+        if isinstance(stmt, ast.Update):
+            return self._run_update(stmt, params)
+        if isinstance(stmt, ast.Delete):
+            return self._run_delete(stmt, params)
+        if isinstance(stmt, ast.CreateTable):
+            return self._run_create_table(stmt)
+        if isinstance(stmt, ast.DropTable):
+            return self._run_drop_table(stmt)
+        if isinstance(stmt, ast.TruncateTable):
+            return self._run_truncate(stmt)
+        if isinstance(stmt, ast.CreateDatabase):
+            self.instance.catalog.create_schema(stmt.name, stmt.if_not_exists)
+            return ok()
+        if isinstance(stmt, ast.DropDatabase):
+            self._drop_database(stmt)
+            return ok()
+        if isinstance(stmt, ast.UseDb):
+            self.instance.catalog.schema(stmt.name)  # validates
+            self.schema = stmt.name
+            return ok()
+        if isinstance(stmt, ast.SetStmt):
+            return self._run_set(stmt)
+        if isinstance(stmt, ast.Show):
+            return self._run_show(stmt)
+        if isinstance(stmt, ast.Explain):
+            return self._run_explain(stmt, params)
+        if isinstance(stmt, ast.Describe):
+            return self._describe(stmt.table)
+        if isinstance(stmt, ast.Begin):
+            self._begin()
+            return ok()
+        if isinstance(stmt, ast.Commit):
+            self._commit()
+            return ok()
+        if isinstance(stmt, ast.Rollback):
+            self._rollback()
+            return ok()
+        if isinstance(stmt, ast.AnalyzeTable):
+            return self._run_analyze(stmt)
+        if isinstance(stmt, ast.KillStmt):
+            return ok(info="kill acknowledged")
+        if isinstance(stmt, (ast.CreateIndex, ast.DropIndex)):
+            from galaxysql_tpu.ddl.engine import run_index_ddl
+            return run_index_ddl(self, stmt)
+        raise errors.NotSupportedError(f"statement {type(stmt).__name__}")
+
+    # -- DQL ------------------------------------------------------------------------
+
+    def _require_schema(self) -> str:
+        if not self.schema:
+            raise errors.TddlError("No database selected")
+        return self.schema
+
+    def _snapshot_ts(self) -> int:
+        if self.txn is not None:
+            return self.txn.snapshot_ts
+        return self.instance.tso.next_timestamp()
+
+    def _run_query(self, stmt, sql: str, params: Optional[list]) -> ResultSet:
+        schema = self._require_schema()
+        t0 = time.time()
+        if sql:
+            plan = self.instance.planner.plan_select(sql, schema, params)
+        else:
+            plan = self.instance.planner.bind_statement(stmt, schema, params or [])
+        cache = None
+        if plan.workload == "AP" and self.instance.config.get("ENABLE_TPU_ENGINE",
+                                                              self.vars):
+            from galaxysql_tpu.exec.device_cache import GLOBAL_DEVICE_CACHE
+            cache = GLOBAL_DEVICE_CACHE
+        ctx = ExecContext(self.instance.stores, self._snapshot_ts(), params or [],
+                          device_cache=cache,
+                          txn_id=self.txn.txn_id if self.txn is not None else 0)
+        op = build_operator(plan.rel, ctx)
+        batch = run_to_batch(op)
+        rows = batch.to_pylist()
+        fields = plan.fields()
+        self.last_trace = ctx.trace + [f"elapsed={time.time() - t0:.3f}s "
+                                       f"workload={plan.workload}"]
+        return ResultSet(plan.display_names, [t for _, t, _ in fields], rows)
+
+    # -- DML -------------------------------------------------------------------------
+
+    def _begin(self):
+        if self.txn is None:
+            self.txn = Transaction(self.instance.tso.next_timestamp())
+
+    def _commit(self):
+        txn = self.txn
+        self.txn = None
+        if txn is None:
+            return
+        commit_ts = self.instance.tso.next_timestamp()
+        for store, pid, start, n in txn.inserted:
+            p = store.partitions[pid]
+            with p.lock:
+                seg = p.begin_ts[start:start + n]
+                p.begin_ts[start:start + n] = np.where(seg == -txn.txn_id,
+                                                       commit_ts, seg)
+        for store, pid, row_ids, _old in txn.deleted:
+            p = store.partitions[pid]
+            with p.lock:
+                cur = p.end_ts[row_ids]
+                p.end_ts[row_ids] = np.where(cur == -txn.txn_id, commit_ts, cur)
+        for store in txn.touched_tables():
+            store.table.bump_version()  # invalidates device-cached ts lanes
+        if txn.inserted or txn.deleted:
+            self.instance.catalog.version += 1
+
+    def _rollback(self):
+        txn = self.txn
+        self.txn = None
+        if txn is None:
+            return
+        # undo: remove appended rows, restore end_ts on provisionally deleted rows
+        for store, pid, start, n in reversed(txn.inserted):
+            p = store.partitions[pid]
+            with p.lock:
+                keep = start
+                for c in store.table.columns:
+                    p.lanes[c.name] = p.lanes[c.name][:keep]
+                    p.valid[c.name] = p.valid[c.name][:keep]
+                p.begin_ts = p.begin_ts[:keep]
+                p.end_ts = p.end_ts[:keep]
+        for store, pid, row_ids, old_end in reversed(txn.deleted):
+            p = store.partitions[pid]
+            with p.lock:
+                p.end_ts[row_ids] = old_end
+        for store in txn.touched_tables():
+            store.table.bump_version()
+
+    def _dml_ts(self) -> Tuple[int, Optional[Transaction]]:
+        """Timestamp to stamp writes with: provisional (-txn_id) inside a transaction,
+        a real TSO value for autocommit single-statement writes."""
+        if self.txn is not None:
+            return -self.txn.txn_id, self.txn
+        return self.instance.tso.next_timestamp(), None
+
+    def _run_insert(self, stmt: ast.Insert, params: Optional[list]) -> ResultSet:
+        schema = self._require_schema()
+        tname = stmt.table.table
+        tm = self.instance.catalog.table(stmt.table.schema or schema, tname)
+        store = self.instance.store(tm.schema, tm.name)
+        ts, txn = self._dml_ts()
+
+        if stmt.select is not None:
+            sub = self._run_query(stmt.select, "", params)
+            columns = stmt.columns or tm.column_names()
+            data = {c: [r[i] for r in sub.rows] for i, c in enumerate(columns)}
+        else:
+            columns = stmt.columns or tm.column_names()
+            binder = Binder(self.instance.catalog, schema, params or [])
+            scope = Scope()
+            data: Dict[str, List[Any]] = {c: [] for c in columns}
+            for row in stmt.rows:
+                if len(row) != len(columns):
+                    raise errors.TddlError("Column count doesn't match value count")
+                for c, v in zip(columns, row):
+                    e = binder._bind_expr(v, scope)
+                    if not isinstance(e, ir.Literal):
+                        e = _fold_constant(e)
+                    data[c].append(e.value)
+        # normalize column name case
+        data = {tm.column(c).name: vals for c, vals in data.items()}
+        before_counts = [p.num_rows for p in store.partitions]
+        n = store.insert_pylists(data, ts)
+        if txn is not None:
+            for pid, p in enumerate(store.partitions):
+                added = p.num_rows - before_counts[pid]
+                if added:
+                    txn.inserted.append((store, pid, before_counts[pid], added))
+        tm.bump_version()
+        self.instance.catalog.version += 1
+        return ok(affected=n)
+
+    def _dml_match(self, tm: TableMeta, where: Optional[ast.ExprNode],
+                   params: Optional[list], alias: str):
+        """Evaluate WHERE on the host engine per partition -> (pid, row_ids)."""
+        store = self.instance.store(tm.schema, tm.name)
+        binder = Binder(self.instance.catalog, tm.schema, params or [])
+        scope = Scope()
+        fields = [(f"{alias}.{c.name}", c.dtype, tm.dictionaries.get(c.name.lower()))
+                  for c in tm.columns]
+        scope.add(alias, fields)
+        pred = None
+        if where is not None:
+            cond = binder._bind_expr(where, scope)
+            pred = ExprCompiler(np).compile_predicate(cond)
+        ts = self._snapshot_ts()
+        txn_id = self.txn.txn_id if self.txn is not None else 0
+        for pid, p in enumerate(store.partitions):
+            vis = p.visible_mask(ts, txn_id)
+            if not vis.any():
+                continue
+            if pred is None:
+                yield store, pid, np.nonzero(vis)[0]
+                continue
+            env = {}
+            for c in tm.columns:
+                env[f"{alias}.{c.name}"] = (p.lanes[c.name], p.valid[c.name])
+            mask = pred(env) & vis
+            ids = np.nonzero(mask)[0]
+            if ids.size:
+                yield store, pid, ids
+
+    def _run_delete(self, stmt: ast.Delete, params: Optional[list]) -> ResultSet:
+        schema = self._require_schema()
+        tm = self.instance.catalog.table(stmt.table.schema or schema, stmt.table.table)
+        ts, txn = self._dml_ts()
+        alias = (stmt.table.alias or stmt.table.table).lower()
+        n = 0
+        for store, pid, ids in self._dml_match(tm, stmt.where, params, alias):
+            old_end = store.partitions[pid].end_ts[ids].copy()
+            store.partitions[pid].delete_rows(ids, ts)
+            if txn is not None:
+                txn.deleted.append((store, pid, ids, old_end))
+            n += ids.size
+        tm.stats.row_count = max(tm.stats.row_count - n, 0)
+        tm.bump_version()
+        self.instance.catalog.version += 1
+        return ok(affected=n)
+
+    def _run_update(self, stmt: ast.Update, params: Optional[list]) -> ResultSet:
+        schema = self._require_schema()
+        if not isinstance(stmt.table, ast.TableName):
+            raise errors.NotSupportedError("multi-table UPDATE")
+        tm = self.instance.catalog.table(stmt.table.schema or schema, stmt.table.table)
+        ts, txn = self._dml_ts()
+        alias = (stmt.table.alias or stmt.table.table).lower()
+        binder = Binder(self.instance.catalog, schema, params or [])
+        scope = Scope()
+        fields = [(f"{alias}.{c.name}", c.dtype, tm.dictionaries.get(c.name.lower()))
+                  for c in tm.columns]
+        scope.add(alias, fields)
+        sets: List[Tuple[str, Any]] = []
+        for name, vexpr in stmt.sets:
+            cm = tm.column(name.simple)
+            e = binder._bind_expr(vexpr, scope)
+            target = cm.dtype
+            if not (e.dtype.clazz == target.clazz and e.dtype.scale == target.scale) \
+                    and e.dtype.clazz != dt.TypeClass.NULL and not target.is_string:
+                e = ir.Cast(e, target)
+            sets.append((cm.name, ExprCompiler(np).compile(e)))
+        n = 0
+        for store, pid, ids in self._dml_match(tm, stmt.where, params, alias):
+            p = store.partitions[pid]
+            env = {}
+            for c in tm.columns:
+                env[f"{alias}.{c.name}"] = (p.lanes[c.name][ids], p.valid[c.name][ids])
+            new_lanes: Dict[str, np.ndarray] = {}
+            new_valid: Dict[str, np.ndarray] = {}
+            for cname, fn in sets:
+                cm = tm.column(cname)
+                d, v = fn(env)
+                d = np.broadcast_to(np.asarray(d), (ids.size,)).astype(cm.dtype.lane)
+                vm = np.ones(ids.size, np.bool_) if v is None else \
+                    np.broadcast_to(np.asarray(v), (ids.size,))
+                new_lanes[cm.name] = d
+                new_valid[cm.name] = vm.copy()
+            old_end = p.end_ts[ids].copy()
+            start = p.num_rows
+            p.update_rows(ids, new_lanes, new_valid, ts)
+            if txn is not None:
+                txn.deleted.append((store, pid, ids, old_end))
+                txn.inserted.append((store, pid, start, ids.size))
+            n += ids.size
+        tm.bump_version()
+        self.instance.catalog.version += 1
+        return ok(affected=n)
+
+    # -- DDL ----------------------------------------------------------------------
+
+    def _run_create_table(self, stmt: ast.CreateTable) -> ResultSet:
+        schema = stmt.name.schema or self._require_schema()
+        if stmt.like is not None:
+            src = self.instance.catalog.table(stmt.like.schema or schema,
+                                              stmt.like.table)
+            tm = TableMeta(schema, stmt.name.table, src.columns, src.primary_key,
+                           src.partition, src.indexes)
+        else:
+            cols = []
+            pk = list(stmt.primary_key)
+            for cd in stmt.columns:
+                typ = dt.from_sql_name(
+                    cd.type_name + (" UNSIGNED" if cd.unsigned else ""),
+                    cd.precision, cd.scale)
+                default = None
+                if cd.default is not None and not isinstance(cd.default, ast.NullLit):
+                    default = _ast_literal_value(cd.default)
+                cols.append(ColumnMeta(cd.name, typ, cd.nullable and not cd.primary_key,
+                                       default, cd.auto_increment, cd.comment))
+                if cd.primary_key:
+                    pk.append(cd.name)
+            part = _partition_info(stmt, cols)
+            indexes = [IndexMeta(i.name or f"i_{k}", i.columns, i.unique,
+                                 i.global_index, i.covering)
+                       for k, i in enumerate(stmt.indexes) if i.columns]
+            tm = TableMeta(schema, stmt.name.table, cols, pk, part, indexes,
+                           stmt.comment)
+        added = self.instance.catalog.add_table(tm, stmt.if_not_exists)
+        if added:
+            self.instance.register_table(tm)
+        return ok()
+
+    def _run_drop_table(self, stmt: ast.DropTable) -> ResultSet:
+        schema = self._require_schema()
+        for name in stmt.names:
+            s = name.schema or schema
+            if self.instance.catalog.drop_table(s, name.table, stmt.if_exists):
+                self.instance.drop_store(s, name.table)
+        return ok()
+
+    def _run_truncate(self, stmt: ast.TruncateTable) -> ResultSet:
+        schema = self._require_schema()
+        tm = self.instance.catalog.table(stmt.name.schema or schema, stmt.name.table)
+        self.instance.store(tm.schema, tm.name).truncate()
+        tm.bump_version()
+        self.instance.catalog.version += 1
+        return ok()
+
+    def _drop_database(self, stmt: ast.DropDatabase):
+        cat = self.instance.catalog
+        key = stmt.name.lower()
+        if key in cat.schemas:
+            for t in list(cat.schemas[key].tables.values()):
+                self.instance.drop_store(t.schema, t.name)
+        cat.drop_schema(stmt.name, stmt.if_exists)
+        if self.schema and self.schema.lower() == key:
+            self.schema = None
+
+    def _run_analyze(self, stmt: ast.AnalyzeTable) -> ResultSet:
+        schema = self._require_schema()
+        rows = []
+        for name in stmt.names:
+            tm = self.instance.catalog.table(name.schema or schema, name.table)
+            store = self.instance.store(tm.schema, tm.name)
+            tm.stats.row_count = store.row_count()
+            for c in tm.columns:
+                sample = np.concatenate(
+                    [p.lanes[c.name][:65536] for p in store.partitions]) \
+                    if store.partitions else np.zeros(0)
+                if sample.size:
+                    tm.stats.ndv[c.name] = int(len(np.unique(sample)))
+                    if not c.dtype.is_string:
+                        tm.stats.min_max[c.name] = (sample.min().item(),
+                                                    sample.max().item())
+            rows.append((f"{tm.schema}.{tm.name}", "analyze", "status", "OK"))
+        self.instance.catalog.version += 1
+        return ResultSet(["Table", "Op", "Msg_type", "Msg_text"],
+                         [dt.VARCHAR] * 4, rows)
+
+    # -- SET / SHOW / EXPLAIN ------------------------------------------------------
+
+    def _run_set(self, stmt: ast.SetStmt) -> ResultSet:
+        for scope, name, vexpr in stmt.assignments:
+            value = _ast_literal_value(vexpr)
+            if scope == "user":
+                self.user_vars[name.lower()] = value
+            elif scope == "global":
+                self.instance.config.set_instance(name, value)
+            else:
+                self.vars[name.upper() if name.upper() in
+                          self.instance.config.registry() else name.lower()] = value
+        return ok()
+
+    def _run_show(self, stmt: ast.Show) -> ResultSet:
+        from galaxysql_tpu.server import show_handlers
+        return show_handlers.handle(self, stmt)
+
+    def _run_explain(self, stmt: ast.Explain, params) -> ResultSet:
+        schema = self._require_schema()
+        inner = stmt.stmt
+        if not isinstance(inner, (ast.Select, ast.SetOpSelect)):
+            return ResultSet(["plan"], [dt.VARCHAR], [("not a plannable statement",)])
+        plan = self.instance.planner.bind_statement(inner, schema, params or [])
+        lines = plan.explain().split("\n")
+        if stmt.analyze:
+            ctx = ExecContext(self.instance.stores, self._snapshot_ts(), params or [])
+            op = build_operator(plan.rel, ctx)
+            t0 = time.time()
+            batch = run_to_batch(op)
+            elapsed = time.time() - t0
+            lines += [f"-- rows: {batch.num_live()}", f"-- elapsed: {elapsed:.3f}s"] + \
+                [f"-- {t}" for t in ctx.trace]
+        lines.append(f"-- workload: {plan.workload}")
+        return ResultSet(["plan"], [dt.VARCHAR], [(l,) for l in lines])
+
+    def _describe(self, name: ast.TableName) -> ResultSet:
+        schema = self._require_schema()
+        tm = self.instance.catalog.table(name.schema or schema, name.table)
+        rows = []
+        for c in tm.columns:
+            key = "PRI" if c.name in tm.primary_key else ""
+            rows.append((c.name, c.dtype.sql_name().lower(),
+                         "YES" if c.nullable else "NO", key,
+                         None if c.default is None else str(c.default),
+                         "auto_increment" if c.auto_increment else ""))
+        return ResultSet(["Field", "Type", "Null", "Key", "Default", "Extra"],
+                         [dt.VARCHAR] * 6, rows)
+
+
+def _partition_info(stmt: ast.CreateTable, cols: List[ColumnMeta]) -> PartitionInfo:
+    if stmt.broadcast:
+        return PartitionInfo("broadcast")
+    if stmt.single or stmt.partition is None:
+        return SINGLE
+    p = stmt.partition
+    colnames = []
+    for e in p.exprs:
+        if isinstance(e, ast.Name):
+            colnames.append(e.simple)
+        else:
+            raise errors.NotSupportedError("partition expressions must be columns")
+    boundaries = []
+    by_name = {c.name.lower(): c for c in cols}
+    for pname, vals in p.boundaries:
+        enc = []
+        for v in vals:
+            if isinstance(v, ast.Name) and v.simple.upper() == "MAXVALUE":
+                enc.append(None)
+            else:
+                lit = _ast_literal_value(v)
+                cm = by_name.get(colnames[0].lower())
+                from galaxysql_tpu.meta.catalog import encode_partition_value
+                enc.append(encode_partition_value(lit, cm.dtype) if cm else lit)
+        boundaries.append((pname, enc))
+    count = p.count or (len(boundaries) if boundaries else 8)
+    return PartitionInfo(p.method, colnames, count, boundaries)
+
+
+def _ast_literal_value(e: ast.ExprNode):
+    if isinstance(e, ast.NumberLit):
+        return e.value
+    if isinstance(e, ast.StringLit):
+        return e.value
+    if isinstance(e, ast.NullLit):
+        return None
+    if isinstance(e, ast.BoolLit):
+        return 1 if e.value else 0
+    if isinstance(e, ast.Unary) and e.op == "-":
+        return -_ast_literal_value(e.arg)
+    if isinstance(e, ast.Func):
+        return str(e.name)
+    if isinstance(e, ast.DateLit):
+        return e.value
+    raise errors.NotSupportedError("expected literal value")
+
+
+def _fold_constant(e: ir.Expr) -> ir.Literal:
+    f = ExprCompiler(np).compile(e)
+    d, v = f({})
+    if v is not None and not np.all(np.asarray(v)):
+        return ir.Literal(None, e.dtype)
+    val = np.asarray(d).item()
+    if e.dtype.clazz == dt.TypeClass.DECIMAL:
+        val = val / (10 ** e.dtype.scale)
+    return ir.Literal(val, e.dtype)

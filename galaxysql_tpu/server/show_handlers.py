@@ -1,0 +1,122 @@
+"""SHOW command handlers.
+
+Reference analog: `manager/response/*` + `executor/handler` SHOW handlers (SURVEY.md
+§2.2/§2.6 — 133 logical handlers).  Each handler returns a ResultSet shaped like MySQL's.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import List, Tuple
+
+from galaxysql_tpu.sql import ast
+from galaxysql_tpu.types import datatype as dt
+from galaxysql_tpu.utils import errors
+
+
+def _like_filter(names: List[str], pattern) -> List[str]:
+    if not pattern:
+        return names
+    translated = pattern.replace("%", "*").replace("_", "?")
+    return [n for n in names if fnmatch.fnmatch(n.lower(), translated.lower())]
+
+
+def handle(session, stmt: ast.Show):
+    from galaxysql_tpu.server.session import ResultSet
+
+    kind = stmt.kind
+    inst = session.instance
+    if kind == "databases":
+        names = sorted(s.name for s in inst.catalog.schemas.values())
+        names = _like_filter(names, stmt.like)
+        return ResultSet(["Database"], [dt.VARCHAR], [(n,) for n in names])
+    if kind == "tables":
+        schema = stmt.target or session.schema
+        if not schema:
+            raise errors.TddlError("No database selected")
+        s = inst.catalog.schema(schema)
+        names = sorted(t.name for t in s.tables.values())
+        names = _like_filter(names, stmt.like)
+        return ResultSet([f"Tables_in_{schema}"], [dt.VARCHAR], [(n,) for n in names])
+    if kind == "columns":
+        return session._describe(ast.TableName([stmt.target]))
+    if kind == "create_table":
+        schema = session.schema
+        tm = inst.catalog.table(schema, stmt.target)
+        lines = [f"CREATE TABLE `{tm.name}` ("]
+        parts = []
+        for c in tm.columns:
+            nn = "" if c.nullable else " NOT NULL"
+            ai = " AUTO_INCREMENT" if c.auto_increment else ""
+            parts.append(f"  `{c.name}` {c.dtype.sql_name()}{nn}{ai}")
+        if tm.primary_key:
+            parts.append("  PRIMARY KEY (" +
+                         ", ".join(f"`{k}`" for k in tm.primary_key) + ")")
+        for i in tm.indexes:
+            g = "GLOBAL " if i.global_index else ""
+            u = "UNIQUE " if i.unique else ""
+            parts.append(f"  {g}{u}KEY `{i.name}` (" +
+                         ", ".join(f"`{c}`" for c in i.columns) + ")")
+        body = ",\n".join(parts)
+        p = tm.partition
+        tail = ""
+        if p.method == "broadcast":
+            tail = " BROADCAST"
+        elif p.method == "single":
+            tail = " SINGLE"
+        elif p.method in ("hash", "key"):
+            tail = (f" PARTITION BY {p.method.upper()}(" +
+                    ", ".join(p.columns) + f") PARTITIONS {p.count}")
+        elif p.method.startswith(("range", "list")):
+            tail = f" PARTITION BY {p.method.upper()}({', '.join(p.columns)}) (...)"
+        ddl = "\n".join([lines[0], body, ")" + tail])
+        return ResultSet(["Table", "Create Table"], [dt.VARCHAR, dt.VARCHAR],
+                         [(tm.name, ddl)])
+    if kind == "variables":
+        reg = inst.config.registry()
+        rows: List[Tuple] = []
+        overlay = {k: v for k, v in session.vars.items()}
+        for name, d in sorted(reg.items()):
+            rows.append((name.lower(), str(inst.config.get(name, overlay))))
+        for name, v in sorted(session.vars.items()):
+            if name.upper() not in reg:
+                rows.append((name.lower(), str(v)))
+        names = _like_filter([r[0] for r in rows], stmt.like)
+        rows = [r for r in rows if r[0] in names]
+        return ResultSet(["Variable_name", "Value"], [dt.VARCHAR, dt.VARCHAR], rows)
+    if kind == "processlist":
+        rows = []
+        for cid, s in sorted(inst.sessions.items()):
+            rows.append((cid, "root", "localhost", s.schema or "", "Query", 0, "", ""))
+        return ResultSet(["Id", "User", "Host", "db", "Command", "Time", "State",
+                          "Info"],
+                         [dt.BIGINT, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR,
+                          dt.BIGINT, dt.VARCHAR, dt.VARCHAR], rows)
+    if kind in ("index", "indexes", "keys"):
+        schema = session.schema
+        tm = inst.catalog.table(schema, stmt.target)
+        rows = []
+        for i in tm.indexes:
+            for seq, c in enumerate(i.columns, 1):
+                rows.append((tm.name, 0 if i.unique else 1, i.name, seq, c,
+                             "GLOBAL" if i.global_index else "LOCAL", i.status))
+        for seq, c in enumerate(tm.primary_key, 1):
+            rows.append((tm.name, 0, "PRIMARY", seq, c, "LOCAL", "PUBLIC"))
+        return ResultSet(["Table", "Non_unique", "Key_name", "Seq_in_index",
+                          "Column_name", "Index_type", "Status"],
+                         [dt.VARCHAR, dt.BIGINT, dt.VARCHAR, dt.BIGINT, dt.VARCHAR,
+                          dt.VARCHAR, dt.VARCHAR], rows)
+    if kind == "warnings":
+        return ResultSet(["Level", "Code", "Message"],
+                         [dt.VARCHAR, dt.BIGINT, dt.VARCHAR], [])
+    if kind == "trace":
+        return ResultSet(["Trace"], [dt.VARCHAR],
+                         [(t,) for t in session.last_trace])
+    if kind in ("status", "engines", "charset", "collation"):
+        if kind == "engines":
+            return ResultSet(["Engine", "Support", "Comment"],
+                             [dt.VARCHAR] * 3,
+                             [("TPU_COLUMNAR", "DEFAULT",
+                               "Device-resident columnar engine")])
+        return ResultSet(["Variable_name", "Value"], [dt.VARCHAR, dt.VARCHAR], [])
+    raise errors.NotSupportedError(f"SHOW {kind}")

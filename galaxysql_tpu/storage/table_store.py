@@ -1,0 +1,275 @@
+"""Partitioned columnar table store — the DN (data node) storage analog.
+
+Reference analog: the `galaxyengine` DN holds sharded row storage; the CN ships physical
+operations per shard (SURVEY.md §2.9, §3.2).  Here each partition is a host-resident
+struct-of-arrays column set (numpy lanes + null masks) with:
+
+- append path used by INSERT/LOAD (routes rows via PartitionRouter),
+- scan path yielding ColumnBatches (bucketed/padded for stable jit shapes),
+- persistence as one .npz per partition + dictionaries, for restart.
+
+MVCC: each partition keeps per-row `begin_ts`/`end_ts` lanes; a snapshot scan at ts sees
+rows with begin_ts <= ts < end_ts.  DML writes go through `txn/` which stamps these lanes
+(TSO ordering, SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from galaxysql_tpu.chunk.batch import Column, ColumnBatch, Dictionary, column_from_pylist
+from galaxysql_tpu.meta.catalog import PartitionRouter, TableMeta
+from galaxysql_tpu.types import datatype as dt
+from galaxysql_tpu.utils import errors
+
+INFINITY_TS = (1 << 63) - 1  # int64 max; must exceed any TSO value (phys_ms << 22 ~ 7.5e18)
+
+
+class Partition:
+    """One shard of a table: numpy lanes + validity + MVCC timestamps."""
+
+    def __init__(self, table: TableMeta, pid: int):
+        self.table = table
+        self.pid = pid
+        self.lanes: Dict[str, np.ndarray] = {
+            c.name: np.zeros(0, dtype=c.dtype.lane) for c in table.columns}
+        self.valid: Dict[str, np.ndarray] = {
+            c.name: np.zeros(0, dtype=np.bool_) for c in table.columns}
+        self.begin_ts = np.zeros(0, dtype=np.int64)
+        self.end_ts = np.zeros(0, dtype=np.int64)
+        self.lock = threading.RLock()
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.begin_ts.shape[0])
+
+    def append(self, lanes: Dict[str, np.ndarray], valid: Dict[str, np.ndarray],
+               begin_ts: int):
+        n = next(iter(lanes.values())).shape[0] if lanes else 0
+        with self.lock:
+            for c in self.table.columns:
+                self.lanes[c.name] = np.concatenate([self.lanes[c.name], lanes[c.name]])
+                self.valid[c.name] = np.concatenate([self.valid[c.name], valid[c.name]])
+            self.begin_ts = np.concatenate(
+                [self.begin_ts, np.full(n, begin_ts, dtype=np.int64)])
+            self.end_ts = np.concatenate(
+                [self.end_ts, np.full(n, INFINITY_TS, dtype=np.int64)])
+
+    def visible_mask(self, snapshot_ts: Optional[int], txn_id: int = 0) -> np.ndarray:
+        """MVCC visibility.  Uncommitted changes carry NEGATIVE timestamps (-txn_id):
+        visible only to the owning transaction; finalized to real TSO values at commit
+        (the in-process analog of the reference's innodb snapshot_seq/commit_seq dance,
+        SURVEY.md §3.4)."""
+        b, e = self.begin_ts, self.end_ts
+        if snapshot_ts is None:
+            inserted_ok = b >= 0
+            deleted = e != INFINITY_TS
+        else:
+            inserted_ok = (b >= 0) & (b <= snapshot_ts)
+            deleted = (e >= 0) & (e <= snapshot_ts)
+        if txn_id:
+            inserted_ok = inserted_ok | (b == -txn_id)
+            deleted = deleted | (e == -txn_id)
+        else:
+            deleted = deleted  # others treat pending deletes (-id) as still visible
+        return inserted_ok & ~deleted
+
+    def delete_rows(self, row_ids: np.ndarray, commit_ts: int):
+        with self.lock:
+            self.end_ts[row_ids] = commit_ts
+
+    def update_rows(self, row_ids: np.ndarray, new_lanes: Dict[str, np.ndarray],
+                    new_valid: Dict[str, np.ndarray], commit_ts: int):
+        """MVCC update = end old versions + append new versions."""
+        with self.lock:
+            full_lanes = {}
+            full_valid = {}
+            for c in self.table.columns:
+                if c.name in new_lanes:
+                    full_lanes[c.name] = new_lanes[c.name]
+                    full_valid[c.name] = new_valid[c.name]
+                else:
+                    full_lanes[c.name] = self.lanes[c.name][row_ids]
+                    full_valid[c.name] = self.valid[c.name][row_ids]
+            self.end_ts[row_ids] = commit_ts
+            self.append(full_lanes, full_valid, commit_ts)
+
+
+class TableStore:
+    def __init__(self, table: TableMeta):
+        self.table = table
+        self.router = PartitionRouter(table)
+        n = table.partition.num_partitions
+        self.partitions = [Partition(table, i) for i in range(n)]
+
+    # -- write path ----------------------------------------------------------
+
+    def insert_pylists(self, data: Dict[str, List[Any]], begin_ts: int) -> int:
+        """Encode python values and route rows to partitions.  Returns rows inserted."""
+        table = self.table
+        n = len(next(iter(data.values()))) if data else 0
+        lanes: Dict[str, np.ndarray] = {}
+        valid: Dict[str, np.ndarray] = {}
+        for c in table.columns:
+            values = data.get(c.name)
+            if values is None:
+                if c.auto_increment:
+                    start = table.auto_increment_next
+                    table.auto_increment_next += n
+                    lanes[c.name] = np.arange(start, start + n, dtype=c.dtype.lane)
+                    valid[c.name] = np.ones(n, dtype=np.bool_)
+                    continue
+                dv = c.default
+                values = [dv] * n
+            col = column_from_pylist(values, c.dtype,
+                                     table.dictionaries.get(c.name.lower()))
+            lanes[c.name] = col.np_data()
+            valid[c.name] = col.np_valid()
+            if not c.nullable and not valid[c.name].all() and c.default is None:
+                raise errors.TddlError(f"Column '{c.name}' cannot be null")
+        pids = self._route(lanes)
+        for pid in np.unique(pids):
+            sel = np.nonzero(pids == pid)[0]
+            self.partitions[int(pid)].append(
+                {k: v[sel] for k, v in lanes.items()},
+                {k: v[sel] for k, v in valid.items()}, begin_ts)
+        table.stats.row_count += n
+        return n
+
+    def insert_arrays(self, data: Dict[str, Any], begin_ts: int) -> int:
+        """Bulk ingestion fast path: numeric columns as numpy arrays pass through;
+        string columns are dictionary-encoded via np.unique (LOAD DATA analog)."""
+        table = self.table
+        n = len(next(iter(data.values()))) if data else 0
+        lanes: Dict[str, np.ndarray] = {}
+        valid: Dict[str, np.ndarray] = {}
+        for c in table.columns:
+            values = data.get(c.name)
+            if values is None:
+                if c.auto_increment:
+                    start = table.auto_increment_next
+                    table.auto_increment_next += n
+                    lanes[c.name] = np.arange(start, start + n, dtype=c.dtype.lane)
+                    valid[c.name] = np.ones(n, dtype=np.bool_)
+                    continue
+                lanes[c.name] = np.zeros(n, dtype=c.dtype.lane)
+                valid[c.name] = np.zeros(n, dtype=np.bool_)
+                continue
+            if c.dtype.is_string:
+                arr = np.asarray(values, dtype=object)
+                uniq, inverse = np.unique(arr.astype(str), return_inverse=True)
+                d = table.dictionaries[c.name.lower()]
+                trans = np.fromiter((d.encode_one(u) for u in uniq.tolist()),
+                                    dtype=np.int32, count=len(uniq))
+                lanes[c.name] = trans[inverse].astype(np.int32)
+                valid[c.name] = np.ones(n, dtype=np.bool_)
+            elif c.dtype.clazz == dt.TypeClass.DECIMAL:
+                a = np.asarray(values, dtype=np.float64)
+                lanes[c.name] = np.round(a * 10 ** c.dtype.scale).astype(np.int64)
+                valid[c.name] = ~np.isnan(a)
+            else:
+                lanes[c.name] = np.asarray(values).astype(c.dtype.lane)
+                valid[c.name] = np.ones(n, dtype=np.bool_)
+        pids = self._route(lanes)
+        for pid in np.unique(pids):
+            sel = np.nonzero(pids == pid)[0]
+            self.partitions[int(pid)].append(
+                {k: v[sel] for k, v in lanes.items()},
+                {k: v[sel] for k, v in valid.items()}, begin_ts)
+        table.stats.row_count += n
+        return n
+
+    def _route(self, lanes: Dict[str, np.ndarray]) -> np.ndarray:
+        info = self.table.partition
+        n = next(iter(lanes.values())).shape[0] if lanes else 0
+        if info.method in ("single", "broadcast"):
+            return np.zeros(n, dtype=np.int32)
+        keys = [lanes[c] if c in lanes else lanes[self.table.column(c).name]
+                for c in info.columns]
+        return self.router.route_rows(keys)
+
+    # -- read path -------------------------------------------------------------
+
+    def scan_partition(self, pid: int, columns: Sequence[str],
+                       snapshot_ts: Optional[int] = None,
+                       batch_rows: int = 1 << 20,
+                       txn_id: int = 0) -> Iterator[ColumnBatch]:
+        """Yield ColumnBatches of up to batch_rows visible rows."""
+        p = self.partitions[pid]
+        with p.lock:
+            vis = p.visible_mask(snapshot_ts, txn_id)
+            idx = np.nonzero(vis)[0]
+            data = {c: p.lanes[c][idx] for c in columns}
+            valid = {c: p.valid[c][idx] for c in columns}
+        n = idx.shape[0]
+        table = self.table
+        for off in range(0, max(n, 1), batch_rows):
+            hi = min(off + batch_rows, n)
+            if n == 0 and off > 0:
+                break
+            cols = {}
+            for c in columns:
+                cm = table.column(c)
+                v = valid[c][off:hi]
+                cols[c] = Column(data[c][off:hi], None if v.all() else v, cm.dtype,
+                                 table.dictionaries.get(c.lower()))
+            yield ColumnBatch(cols, None)
+            if hi >= n:
+                break
+
+    def scan(self, columns: Sequence[str], partitions: Optional[Sequence[int]] = None,
+             snapshot_ts: Optional[int] = None, txn_id: int = 0
+             ) -> Iterator[ColumnBatch]:
+        pids = range(len(self.partitions)) if partitions is None else partitions
+        for pid in pids:
+            yield from self.scan_partition(pid, columns, snapshot_ts, txn_id=txn_id)
+
+    def row_count(self, snapshot_ts: Optional[int] = None, txn_id: int = 0) -> int:
+        return sum(int(p.visible_mask(snapshot_ts, txn_id).sum())
+                   for p in self.partitions)
+
+    def truncate(self):
+        n = self.table.partition.num_partitions
+        self.partitions = [Partition(self.table, i) for i in range(n)]
+        self.table.stats.row_count = 0
+
+    # -- persistence -------------------------------------------------------------
+
+    def save(self, directory: str):
+        os.makedirs(directory, exist_ok=True)
+        for p in self.partitions:
+            arrays = {f"lane__{k}": v for k, v in p.lanes.items()}
+            arrays.update({f"valid__{k}": v for k, v in p.valid.items()})
+            arrays["begin_ts"] = p.begin_ts
+            arrays["end_ts"] = p.end_ts
+            np.savez_compressed(os.path.join(directory, f"p{p.pid}.npz"), **arrays)
+        dicts = {k: d.values for k, d in self.table.dictionaries.items()}
+        with open(os.path.join(directory, "dictionaries.json"), "w") as f:
+            json.dump(dicts, f)
+
+    def load(self, directory: str):
+        dpath = os.path.join(directory, "dictionaries.json")
+        if os.path.exists(dpath):
+            with open(dpath) as f:
+                dicts = json.load(f)
+            for k, values in dicts.items():
+                d = self.table.dictionaries.get(k)
+                if d is not None:
+                    for v in values:
+                        d.encode_one(v)
+        for p in self.partitions:
+            path = os.path.join(directory, f"p{p.pid}.npz")
+            if not os.path.exists(path):
+                continue
+            z = np.load(path, allow_pickle=False)
+            p.begin_ts = z["begin_ts"]
+            p.end_ts = z["end_ts"]
+            for c in self.table.columns:
+                p.lanes[c.name] = z[f"lane__{c.name}"]
+                p.valid[c.name] = z[f"valid__{c.name}"]
+        self.table.stats.row_count = self.row_count()

@@ -162,3 +162,44 @@ class TestRound2Findings:
         out = run_to_batch(op).to_pydict()
         assert sorted(out.keys()) == ["k", "pk", "v"]
         assert out["v"] == [None, None] and sorted(out["pk"]) == [1, 2]
+
+
+class TestRound3Findings:
+    def test_max_with_nulls_in_group(self):
+        b = batch_from_pydict({"g": [1, 1, 1, 2], "x": [5, 7, None, None]},
+                              {"g": dt.BIGINT, "x": dt.BIGINT})
+        op = HashAggOp(SourceOp([b]), [("g", col(b, "g"))],
+                       [AggCall("max", col(b, "x"), "mx"),
+                        AggCall("min", col(b, "x"), "mn")])
+        out = run_to_batch(op).to_pydict()
+        m = dict(zip(out["g"], zip(out["mx"], out["mn"])))
+        assert m[1] == (7, 5)
+        assert m[2] == (None, None)  # all-NULL group
+
+    def test_distinct_dict_transforms_not_merged(self):
+        from galaxysql_tpu.plan.binder import Binder
+        from galaxysql_tpu.sql import ast as A
+        from galaxysql_tpu.meta.catalog import Catalog, ColumnMeta, TableMeta
+        # upper(s) and lower(s) must have different expression keys
+        import numpy as np
+        from galaxysql_tpu.chunk.batch import Dictionary
+        d = Dictionary(["Ab", "cD"])
+        cref = ir.ColRef("s", dt.VARCHAR, d)
+        up = ir.Call("dict_transform", [cref], dt.VARCHAR)
+        up.dictionary = Dictionary(["AB", "CD"])
+        up.meta = (np.array([0, 1], dtype=np.int32),)
+        lo = ir.Call("dict_transform", [cref], dt.VARCHAR)
+        lo.dictionary = Dictionary(["ab", "cd"])
+        lo.meta = (np.array([0, 1], dtype=np.int32),)
+        assert up.key() != lo.key()
+
+    def test_source_op_accepts_generator(self):
+        b = batch_from_pydict({"g": list(range(100)), "v": list(range(100))},
+                              {"g": dt.BIGINT, "v": dt.BIGINT})
+        gen = (x for x in [b])
+        # max_groups=... power of two floor below 100 forces an overflow retry,
+        # which re-iterates the (materialized) source
+        op = HashAggOp(SourceOp(gen), [("g", col(b, "g"))],
+                       [AggCall("count_star", None, "c")], max_groups=64)
+        out = run_to_batch(op).to_pydict()
+        assert len(out["g"]) == 100

@@ -1,0 +1,148 @@
+"""End-to-end session tests: SQL in, rows out, through the full stack."""
+
+import numpy as np
+import pytest
+
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.utils import errors
+
+
+@pytest.fixture()
+def session():
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE test")
+    s.execute("USE test")
+    yield s
+    s.close()
+
+
+class TestBasics:
+    def test_create_insert_select(self, session):
+        session.execute("""
+            CREATE TABLE t (
+                id BIGINT NOT NULL AUTO_INCREMENT PRIMARY KEY,
+                name VARCHAR(20),
+                amount DECIMAL(10,2),
+                d DATE
+            ) PARTITION BY HASH(id) PARTITIONS 4
+        """)
+        r = session.execute(
+            "INSERT INTO t (id, name, amount, d) VALUES "
+            "(1, 'alice', 10.50, '2024-01-01'), (2, 'bob', 20.25, '2024-06-15'), "
+            "(3, NULL, NULL, NULL)")
+        assert r.affected == 3
+        r = session.execute("SELECT id, name, amount, d FROM t ORDER BY id")
+        assert r.rows == [(1, "alice", 10.5, "2024-01-01"),
+                          (2, "bob", 20.25, "2024-06-15"),
+                          (3, None, None, None)]
+
+    def test_where_and_expressions(self, session):
+        session.execute("CREATE TABLE t (a BIGINT, b BIGINT)")
+        session.execute("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30), (4, NULL)")
+        r = session.execute("SELECT a + b AS s FROM t WHERE b > 10 ORDER BY a")
+        assert r.rows == [(22,), (33,)]
+        r = session.execute("SELECT count(*), sum(b), avg(b) FROM t")
+        assert r.rows[0][0] == 4 and r.rows[0][1] == 60
+
+    def test_group_by_having(self, session):
+        session.execute("CREATE TABLE s (g VARCHAR(5), v BIGINT)")
+        session.execute(
+            "INSERT INTO s VALUES ('a', 1), ('a', 2), ('b', 5), ('b', 7), ('c', 1)")
+        r = session.execute(
+            "SELECT g, sum(v) AS total FROM s GROUP BY g HAVING sum(v) > 2 "
+            "ORDER BY total DESC")
+        assert r.rows == [("b", 12), ("a", 3)]
+
+    def test_join(self, session):
+        session.execute("CREATE TABLE c (id BIGINT, name VARCHAR(10))")
+        session.execute("CREATE TABLE o (cid BIGINT, total BIGINT)")
+        session.execute("INSERT INTO c VALUES (1, 'x'), (2, 'y'), (3, 'z')")
+        session.execute("INSERT INTO o VALUES (1, 100), (1, 200), (2, 50)")
+        r = session.execute(
+            "SELECT c.name, sum(o.total) AS t FROM c, o WHERE c.id = o.cid "
+            "GROUP BY c.name ORDER BY t DESC")
+        assert r.rows == [("x", 300), ("y", 50)]
+        r = session.execute(
+            "SELECT c.name, o.total FROM c LEFT JOIN o ON c.id = o.cid "
+            "ORDER BY c.name, o.total")
+        assert r.rows == [("x", 100), ("x", 200), ("y", 50), ("z", None)]
+
+    def test_update_delete(self, session):
+        session.execute("CREATE TABLE t (id BIGINT, v BIGINT)")
+        session.execute("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")
+        r = session.execute("UPDATE t SET v = v + 1 WHERE id >= 2")
+        assert r.affected == 2
+        r = session.execute("SELECT v FROM t ORDER BY id")
+        assert r.rows == [(10,), (21,), (31,)]
+        r = session.execute("DELETE FROM t WHERE id = 2")
+        assert r.affected == 1
+        assert session.execute("SELECT count(*) FROM t").rows == [(2,)]
+
+    def test_transaction_rollback(self, session):
+        session.execute("CREATE TABLE t (id BIGINT)")
+        session.execute("INSERT INTO t VALUES (1)")
+        session.execute("BEGIN")
+        session.execute("INSERT INTO t VALUES (2)")
+        session.execute("DELETE FROM t WHERE id = 1")
+        assert session.execute("SELECT count(*) FROM t").rows == [(1,)]
+        session.execute("ROLLBACK")
+        r = session.execute("SELECT id FROM t")
+        assert r.rows == [(1,)]
+
+    def test_show_and_describe(self, session):
+        session.execute("CREATE TABLE t1 (a INT PRIMARY KEY, b VARCHAR(10))")
+        assert ("test",) in session.execute("SHOW DATABASES").rows
+        assert session.execute("SHOW TABLES").rows == [("t1",)]
+        r = session.execute("DESC t1")
+        assert r.rows[0][0] == "a" and r.rows[0][3] == "PRI"
+        r = session.execute("SHOW CREATE TABLE t1")
+        assert "CREATE TABLE" in r.rows[0][1]
+
+    def test_explain(self, session):
+        session.execute("CREATE TABLE t (a BIGINT) PARTITION BY HASH(a) PARTITIONS 8")
+        session.execute("INSERT INTO t VALUES (1), (2), (3)")
+        r = session.execute("EXPLAIN SELECT * FROM t WHERE a = 1")
+        text = "\n".join(r0[0] for r0 in r.rows)
+        assert "Scan" in text and "partitions=[" in text  # partition pruning visible
+
+    def test_errors(self, session):
+        with pytest.raises(errors.UnknownTableError):
+            session.execute("SELECT * FROM missing")
+        with pytest.raises(errors.UnknownColumnError):
+            session.execute("CREATE TABLE e (a INT)") and None
+            session.execute("SELECT nope FROM e")
+        with pytest.raises(errors.TddlError):
+            session.execute("CREATE TABLE e2 (a INT)")
+            session.execute("CREATE TABLE e2 (a INT)")
+
+    def test_insert_select_and_autoinc(self, session):
+        session.execute("CREATE TABLE src (v BIGINT)")
+        session.execute("INSERT INTO src VALUES (5), (6)")
+        session.execute(
+            "CREATE TABLE dst (id BIGINT AUTO_INCREMENT PRIMARY KEY, v BIGINT)")
+        session.execute("INSERT INTO dst (v) SELECT v FROM src")
+        r = session.execute("SELECT id, v FROM dst ORDER BY id")
+        assert r.rows == [(1, 5), (2, 6)]
+
+    def test_distinct_union_limit(self, session):
+        session.execute("CREATE TABLE t (a BIGINT)")
+        session.execute("INSERT INTO t VALUES (1), (1), (2), (3), (3)")
+        assert session.execute("SELECT DISTINCT a FROM t ORDER BY a").rows == \
+            [(1,), (2,), (3,)]
+        r = session.execute("SELECT a FROM t UNION SELECT a + 10 FROM t ORDER BY 1")
+        assert len(r.rows) == 6
+        assert session.execute("SELECT a FROM t ORDER BY a LIMIT 2, 2").rows == \
+            [(2,), (3,)]
+
+    def test_plan_cache_hit(self, session):
+        session.execute("CREATE TABLE t (a BIGINT)")
+        session.execute("INSERT INTO t VALUES (1), (2), (3)")
+        p = session.instance.planner
+        before = p.cache.misses
+        session.execute("SELECT * FROM t WHERE a = 1")
+        session.execute("SELECT * FROM t WHERE a = 2")
+        assert p.cache.hits >= 1
+        # different values reuse the cached AST (no reparse), same key
+        assert p.cache.misses == before + 1

@@ -1,0 +1,145 @@
+"""TPC-H differential test: every query runs on both galaxysql_tpu and sqlite3 over the
+same generated data; results must match (with float tolerance).
+
+This is the engine's correctness anchor — the analog of the reference's TPC-H planner
+golden suite (SURVEY.md §4), but checking *results*, which a from-scratch engine needs
+more than plan shapes.
+"""
+
+import math
+import re
+import sqlite3
+
+import numpy as np
+import pytest
+
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.storage import tpch
+from galaxysql_tpu.storage.tpch_queries import QUERIES
+from galaxysql_tpu.types import temporal
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def env():
+    data = tpch.generate(SF)
+
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE tpch")
+    s.execute("USE tpch")
+    for t in tpch.TABLE_ORDER:
+        s.execute(tpch.TPCH_DDL[t])
+        store = inst.store("tpch", t)
+        store.insert_pylists(data[t], inst.tso.next_timestamp())
+    s.execute("ANALYZE TABLE " + ", ".join(tpch.TABLE_ORDER))
+
+    db = sqlite3.connect(":memory:")
+    db.create_function("year_of", 1, lambda d: temporal.civil_from_days(int(d))[0])
+    for t in tpch.TABLE_ORDER:
+        cols = list(data[t].keys())
+        decls = []
+        for c in cols:
+            v = data[t][c][0] if data[t][c] else 0
+            decls.append(f"{c} {'TEXT' if isinstance(v, str) else 'NUMERIC'}")
+        db.execute(f"CREATE TABLE {t} ({', '.join(decls)})")
+        rows = list(zip(*[data[t][c] for c in cols]))
+        db.executemany(f"INSERT INTO {t} VALUES ({','.join('?' * len(cols))})", rows)
+    db.commit()
+    yield s, db
+    s.close()
+    db.close()
+
+
+_DATE_ARITH = re.compile(
+    r"date\s+'(\d{4}-\d{2}-\d{2})'(?:\s*([+-])\s*interval\s+'(\d+)'\s+(day|month|year))?",
+    re.IGNORECASE)
+_EXTRACT = re.compile(r"extract\s*\(\s*year\s+from\s+([a-z0-9_.]+)\s*\)", re.IGNORECASE)
+
+
+def to_sqlite(q: str) -> str:
+    def fold(m):
+        days = temporal.parse_date(m.group(1))
+        if m.group(2):
+            n = int(m.group(3))
+            if m.group(2) == "-":
+                n = -n
+            unit = m.group(4).lower()
+            if unit == "day":
+                days += n
+            elif unit == "month":
+                days = temporal.add_interval_months(days, n)
+            else:
+                days = temporal.add_interval_months(days, n * 12)
+        return str(days)
+
+    q = _DATE_ARITH.sub(fold, q)
+    q = _EXTRACT.sub(r"year_of(\1)", q)
+
+    # constant decimal arithmetic: sqlite uses binary float64 (0.06 + 0.01 =
+    # 0.06999...), while MySQL/our engine use exact decimals; fold to exact values
+    def dec_fold(m):
+        from decimal import Decimal
+        a, op, b = Decimal(m.group(1)), m.group(2), Decimal(m.group(3))
+        return str(a + b if op == "+" else a - b)
+
+    q = re.sub(r"(\d+\.\d+)\s*([+-])\s*(\d+\.\d+)", dec_fold, q)
+    return q
+
+
+def normalize(rows, has_order):
+    out = []
+    for r in rows:
+        nr = []
+        for v in r:
+            if isinstance(v, float):
+                nr.append(round(v, 2))
+            elif isinstance(v, str) and re.fullmatch(r"\d{4}-\d{2}-\d{2}", v):
+                nr.append(temporal.parse_date(v))  # date as days for comparison
+            else:
+                nr.append(v)
+        out.append(tuple(nr))
+    if not has_order:
+        out.sort(key=lambda r: tuple(str(x) for x in r))
+    return out
+
+
+def rows_close(a, b):
+    if len(a) != len(b):
+        return False, f"row count {len(a)} != {len(b)}"
+    for i, (ra, rb) in enumerate(zip(a, b)):
+        if len(ra) != len(rb):
+            return False, f"row {i} arity"
+        for j, (va, vb) in enumerate(zip(ra, rb)):
+            if va is None and vb is None:
+                continue
+            if isinstance(va, (int, float)) and isinstance(vb, (int, float)):
+                tol = max(abs(float(vb)) * 1e-4, 0.02)
+                if not math.isclose(float(va), float(vb), abs_tol=tol):
+                    return False, f"row {i} col {j}: {va} != {vb}"
+            elif va != vb:
+                return False, f"row {i} col {j}: {va!r} != {vb!r}"
+    return True, ""
+
+
+ORDERED = {1, 2, 3, 4, 5, 7, 8, 9, 10, 11, 12, 13, 15, 16, 18, 20, 21, 22}
+
+
+@pytest.mark.parametrize("qid", sorted(QUERIES))
+def test_query(env, qid):
+    session, db = env
+    q = QUERIES[qid]
+    mine = session.execute(q)
+    theirs = db.execute(to_sqlite(q)).fetchall()
+    a = normalize(mine.rows, qid in ORDERED)
+    b = normalize(theirs, qid in ORDERED)
+    # dates come back as 'yyyy-mm-dd' from our engine, ints from sqlite: normalize
+    # handled above.  Compare.
+    okk, msg = rows_close(a, b)
+    if not okk and qid in ORDERED:
+        # ties in ORDER BY keys may legitimately reorder; retry order-insensitive
+        okk, msg = rows_close(sorted(a, key=lambda r: tuple(str(x) for x in r)),
+                              sorted(b, key=lambda r: tuple(str(x) for x in r)))
+    assert okk, f"Q{qid}: {msg}\nmine: {a[:5]}\nsqlite: {b[:5]}"
