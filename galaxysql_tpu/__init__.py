@@ -1,0 +1,17 @@
+"""galaxysql_tpu: a TPU-native distributed SQL engine (PolarDB-X CN capabilities,
+re-designed for JAX/XLA — see SURVEY.md for the blueprint)."""
+
+import os
+
+
+def _ensure_platforms():
+    """Allow a CPU backend beside the accelerator (TP queries run host-side).
+
+    Must run before JAX initializes its backends.  When JAX_PLATFORMS pins a single
+    accelerator platform (e.g. 'axon'), extend it with 'cpu'."""
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    if plats and "cpu" not in plats.split(","):
+        os.environ["JAX_PLATFORMS"] = plats + ",cpu"
+
+
+_ensure_platforms()

@@ -1,0 +1,272 @@
+"""Asyncio MySQL-protocol frontend.
+
+Reference analog: `NIOAcceptor`/`NIOProcessor`/`FrontendConnection` +
+`FrontendCommandHandler` (SURVEY.md §2.1, §3.2).  One asyncio task per connection
+replaces the reactor threads; blocking query execution runs in a thread pool so the
+event loop keeps serving other connections (the NIOProcessor R/W split analog).
+
+Served commands: handshake/auth (mysql_native_password), COM_QUERY (multi-statement),
+COM_INIT_DB, COM_PING, COM_FIELD_LIST, COM_STMT_PREPARE/EXECUTE/CLOSE/RESET,
+COM_SET_OPTION, COM_QUIT.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import secrets
+import struct
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional
+
+from galaxysql_tpu.net import packets as P
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import ResultSet, Session
+from galaxysql_tpu.sql.parser import parse as parse_sql
+from galaxysql_tpu.utils import errors
+
+
+class PreparedStatement:
+    def __init__(self, stmt_id: int, sql: str, n_params: int):
+        self.stmt_id = stmt_id
+        self.sql = sql
+        self.n_params = n_params
+        # param types from the first COM_STMT_EXECUTE (connectors omit them later)
+        self.param_types = None
+
+
+class Connection:
+    def __init__(self, server: "MySQLServer", reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self.server = server
+        self.reader = reader
+        self.writer = writer
+        self.session = Session(server.instance)
+        self.seq = 0
+        self.stmts: Dict[int, PreparedStatement] = {}
+        self.next_stmt_id = 1
+        self.closed = False
+
+    # -- framing ---------------------------------------------------------------
+
+    async def read_packet(self) -> Optional[bytes]:
+        header = await self.reader.readexactly(4)
+        length = header[0] | (header[1] << 8) | (header[2] << 16)
+        self.seq = (header[3] + 1) & 0xFF
+        return await self.reader.readexactly(length)
+
+    def send(self, payload: bytes):
+        while True:
+            chunk, payload = payload[:0xFFFFFF], payload[0xFFFFFF:]
+            header = struct.pack("<I", len(chunk))[:3] + bytes([self.seq])
+            self.seq = (self.seq + 1) & 0xFF
+            self.writer.write(header + chunk)
+            if len(chunk) < 0xFFFFFF:
+                break
+
+    async def flush(self):
+        await self.writer.drain()
+
+    def _status(self) -> int:
+        st = P.SERVER_STATUS_AUTOCOMMIT if self.session.autocommit else 0
+        if self.session.txn is not None:
+            st |= P.SERVER_STATUS_IN_TRANS
+        return st
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def run(self):
+        try:
+            await self._run_inner()
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass  # client vanished or sent garbage framing: drop quietly
+        finally:
+            self.session.close()
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+
+    async def _run_inner(self):
+        seed = secrets.token_bytes(20)
+        self.send(P.handshake_v10(self.session.conn_id, seed))
+        await self.flush()
+        payload = await self.read_packet()
+        creds = P.parse_handshake_response(payload)
+        if not self.server.authenticate(creds["user"], creds["auth"], seed):
+            self.send(P.err_packet(1045, "28000",
+                                   f"Access denied for user '{creds['user']}'"))
+            await self.flush()
+            return
+        self.session.user = creds["user"]
+        if creds.get("database"):
+            try:
+                self.session.execute(f"USE `{creds['database']}`")
+            except errors.TddlError as e:
+                self.send(P.err_packet(e.errno, e.sqlstate, e.message))
+                await self.flush()
+                return
+        self.send(P.ok_packet(status=self._status()))
+        await self.flush()
+        while not self.closed:
+            self.seq = 0
+            try:
+                payload = await self.read_packet()
+            except (asyncio.IncompleteReadError, ConnectionResetError):
+                break
+            if not payload:
+                break
+            await self.dispatch(payload)
+            await self.flush()
+
+    # -- command dispatch --------------------------------------------------------
+
+    async def dispatch(self, payload: bytes):
+        cmd = payload[0]
+        try:
+            if cmd == P.COM_QUIT:
+                self.closed = True
+            elif cmd == P.COM_PING:
+                self.send(P.ok_packet(status=self._status()))
+            elif cmd == P.COM_INIT_DB:
+                db = payload[1:].decode("utf8", "replace")
+                await self.run_blocking(self.session.execute, f"USE `{db}`")
+                self.send(P.ok_packet(status=self._status()))
+            elif cmd == P.COM_QUERY:
+                sql = payload[1:].decode("utf8", "replace")
+                r = await self.run_blocking(self.session.execute, sql)
+                self.send_result(r)
+            elif cmd == P.COM_FIELD_LIST:
+                table = payload[1:].split(b"\0")[0].decode("utf8", "replace")
+                r = await self.run_blocking(self.session.execute,
+                                            f"DESC `{table}`")
+                for row in r.rows:
+                    from galaxysql_tpu.types import datatype as dt
+                    self.send(P.column_def(row[0], dt.VARCHAR, table))
+                self.send(P.eof_packet(self._status()))
+            elif cmd == P.COM_STMT_PREPARE:
+                self.stmt_prepare(payload[1:].decode("utf8", "replace"))
+            elif cmd == P.COM_STMT_EXECUTE:
+                await self.stmt_execute(payload)
+            elif cmd == P.COM_STMT_CLOSE:
+                stmt_id = struct.unpack_from("<I", payload, 1)[0]
+                self.stmts.pop(stmt_id, None)  # no response
+            elif cmd == P.COM_STMT_RESET:
+                self.send(P.ok_packet(status=self._status()))
+            elif cmd == P.COM_SET_OPTION:
+                self.send(P.eof_packet(self._status()))
+            else:
+                self.send(P.err_packet(1047, "08S01", f"Unknown command {cmd:#x}"))
+        except errors.TddlError as e:
+            self.send(P.err_packet(e.errno, e.sqlstate, e.message))
+        except Exception as e:  # pragma: no cover - hardening
+            self.send(P.err_packet(1105, "HY000", f"{type(e).__name__}: {e}"))
+
+    async def run_blocking(self, fn, *args):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self.server.pool, fn, *args)
+
+    def send_result(self, r: ResultSet, binary: bool = False):
+        if not r.is_query:
+            self.send(P.ok_packet(r.affected, r.last_insert_id, self._status(),
+                                  info=r.info.encode("utf8")))
+            return
+        self.send(P.lenenc_int(len(r.names)))
+        for name, typ in zip(r.names, r.types):
+            self.send(P.column_def(name, typ))
+        self.send(P.eof_packet(self._status()))
+        for row in r.rows:
+            if binary:
+                self.send(P.binary_row(row, r.types))
+            else:
+                self.send(P.text_row(row))
+        self.send(P.eof_packet(self._status()))
+
+    # -- prepared statements -------------------------------------------------------
+
+    def stmt_prepare(self, sql: str):
+        from galaxysql_tpu.sql.lexer import T, tokenize
+        parse_sql(sql)  # validate syntax up front (errors -> ERR packet)
+        n_params = sum(1 for t in tokenize(sql) if t.kind == T.PARAM)
+        stmt = PreparedStatement(self.next_stmt_id, sql, n_params)
+        self.next_stmt_id += 1
+        self.stmts[stmt.stmt_id] = stmt
+        # response: [ok][stmt_id][n_cols][n_params][filler][warnings]
+        head = (b"\x00" + struct.pack("<I", stmt.stmt_id) +
+                struct.pack("<H", 0) + struct.pack("<H", n_params) +
+                b"\x00" + struct.pack("<H", 0))
+        self.send(head)
+        if n_params:
+            from galaxysql_tpu.types import datatype as dt
+            for i in range(n_params):
+                self.send(P.column_def(f"?{i}", dt.VARCHAR))
+            self.send(P.eof_packet(self._status()))
+
+    async def stmt_execute(self, payload: bytes):
+        stmt_id = struct.unpack_from("<I", payload, 1)[0]
+        stmt = self.stmts.get(stmt_id)
+        if stmt is None:
+            self.send(P.err_packet(1243, "HY000", "Unknown prepared statement"))
+            return
+        params, types = P.parse_stmt_execute_params(payload, stmt.n_params,
+                                                     stmt.param_types)
+        if types:
+            stmt.param_types = types
+        r = await self.run_blocking(self.session.execute, stmt.sql, params)
+        self.send_result(r, binary=True)
+
+
+class MySQLServer:
+    """The frontend acceptor (CobarServer.startupServer analog, §3.1)."""
+
+    def __init__(self, instance: Instance, host: str = "127.0.0.1", port: int = 3406,
+                 users: Optional[Dict[str, str]] = None, pool_size: int = 16):
+        self.instance = instance
+        self.host = host
+        self.port = port
+        self.users = users if users is not None else {"root": ""}
+        self.pool = ThreadPoolExecutor(max_workers=pool_size,
+                                       thread_name_prefix="exec")
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    def authenticate(self, user: str, auth: bytes, seed: bytes) -> bool:
+        if user not in self.users:
+            return False
+        password = self.users[user].encode("utf8")
+        if not password:
+            return auth in (b"", b"\0")
+        return auth == P.native_password_scramble(password, seed)
+
+    async def start(self):
+        async def handler(reader, writer):
+            conn = Connection(self, reader, writer)
+            await conn.run()
+
+        self._server = await asyncio.start_server(handler, self.host, self.port)
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        self.pool.shutdown(wait=False)
+
+    async def serve_forever(self):
+        await self.start()
+        await self._server.serve_forever()
+
+
+def main():  # pragma: no cover - manual entry point
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=3406)
+    ap.add_argument("--host", default="127.0.0.1")
+    args = ap.parse_args()
+    inst = Instance()
+    server = MySQLServer(inst, args.host, args.port)
+    asyncio.run(server.serve_forever())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -747,7 +747,11 @@ class Binder:
         if isinstance(e, ast.ParamRef):
             if e.index >= len(self.params):
                 raise errors.TddlError("not enough parameters bound")
-            return ir.lit(self.params[e.index])
+            v = self.params[e.index]
+            from galaxysql_tpu.sql.parameterize import DecimalParam
+            if isinstance(v, DecimalParam):
+                return ir.Literal(v.value, dt.decimal(18, v.scale))
+            return ir.lit(v)
         if isinstance(e, ast.DateLit):
             if e.kind == "date":
                 return ir.Literal(temporal.parse_date(e.value), dt.DATE)

@@ -101,33 +101,26 @@ class Planner:
 
     def plan_select(self, sql: str, schema: str,
                     params: Optional[list] = None) -> ExecutionPlan:
-        """Plan a SELECT (or EXPLAIN-able) statement with caching."""
+        """Plan a SELECT (or EXPLAIN-able) statement with caching.
+
+        The PARAMETERIZED text is what gets parsed, so the cached AST carries `?`
+        placeholders; executions with new values re-bind from that AST (skipping the
+        parse — the reference's PlanCache + per-execution PostPlanner split).  Literal
+        values and client-protocol params resolve through the slot plan in order.
+        """
         p = parameterize(sql)
         key = (schema.lower(), p.cache_key)
-        effective_params = list(p.params)
-        if params:
-            # explicit protocol params replace ?s the client sent; literal
-            # parameterization only applies when the SQL carried inline literals
-            effective_params = params
-            key = (schema.lower(), sql)
+        bind_values = p.resolve(params or [])
         cached = self.cache.get(key, self.catalog.version)
-        if cached is not None and cached.param_count == len(effective_params) and \
-                _params_compatible(cached, effective_params):
-            return self._rebind_if_needed(cached, sql, schema, effective_params)
-        stmt = parse(sql)
-        plan = self.bind_statement(stmt, schema, effective_params)
+        if cached is not None and cached.param_count == len(bind_values):
+            if cached.bound_params == bind_values:
+                return cached
+            plan = self.bind_statement(cached.statement, schema, bind_values)
+            self.cache.put(key, plan)
+            return plan
+        stmt = parse(p.parameterized)
+        plan = self.bind_statement(stmt, schema, bind_values)
         self.cache.put(key, plan)
-        return plan
-
-    def _rebind_if_needed(self, cached: ExecutionPlan, sql: str, schema: str,
-                          params: list) -> ExecutionPlan:
-        # Plans bake literal values into compiled expressions (partition pruning and
-        # dictionary resolution are value-dependent, like PostPlanner re-pruning per
-        # execution).  Same values -> reuse as-is; different values -> re-bind from the
-        # cached AST (skips parsing, the expensive part for big statements).
-        if cached.bound_params == params:
-            return cached
-        plan = self.bind_statement(cached.statement, schema, params)
         return plan
 
     def bind_statement(self, stmt: ast.Statement, schema: str,
@@ -166,5 +159,3 @@ class Planner:
         return union, names
 
 
-def _params_compatible(plan: ExecutionPlan, params: list) -> bool:
-    return getattr(plan, "bound_params", None) is not None

@@ -50,6 +50,26 @@ def ok(affected: int = 0, info: str = "", last_insert_id: int = 0) -> ResultSet:
     return ResultSet([], [], [], affected, last_insert_id, info)
 
 
+import contextlib
+
+_NULL_CTX = contextlib.nullcontext()
+_CPU_DEVICE = None
+
+
+def _cpu_device_ctx():
+    global _CPU_DEVICE
+    if _CPU_DEVICE is None:
+        import jax
+        try:
+            _CPU_DEVICE = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:
+            _CPU_DEVICE = False
+    if _CPU_DEVICE is False:
+        return contextlib.nullcontext()
+    import jax
+    return jax.default_device(_CPU_DEVICE)
+
+
 class Transaction:
     """TSO transaction: snapshot at begin, provisional (-txn_id) stamps on writes,
     finalized to a fresh commit timestamp at COMMIT (TsoTransaction analog, §3.4)."""
@@ -78,6 +98,7 @@ class Session:
         self.txn: Optional[Transaction] = None
         self.vars: Dict[str, Any] = {}
         self.user_vars: Dict[str, Any] = {}
+        self.user = "root"
         self.last_trace: List[str] = []
         instance.sessions[self.conn_id] = self
 
@@ -183,7 +204,12 @@ class Session:
                           device_cache=cache,
                           txn_id=self.txn.txn_id if self.txn is not None else 0)
         op = build_operator(plan.rel, ctx)
-        batch = run_to_batch(op)
+        # TP fast path: pin execution to the host CPU backend — point queries must not
+        # pay accelerator dispatch/compile latency (the CURSOR-mode bypass, SURVEY.md
+        # §7.3 'latency floor')
+        device_ctx = _cpu_device_ctx() if plan.workload == "TP" else _NULL_CTX
+        with device_ctx:
+            batch = run_to_batch(op)
         rows = batch.to_pylist()
         fields = plan.fields()
         self.last_trace = ctx.trace + [f"elapsed={time.time() - t0:.3f}s "

@@ -14,19 +14,51 @@ from typing import Any, List, Tuple
 from galaxysql_tpu.sql.lexer import T, Token, tokenize
 
 
+@dataclasses.dataclass(frozen=True)
+class DecimalParam:
+    """A dotted numeric literal with its textual scale preserved.
+
+    MySQL treats 0.06 as an exact DECIMAL(_,2); losing that to a float64 param would
+    change comparison semantics (see the Q6/Q14 decimal-literal findings)."""
+    value: float
+    scale: int
+
+    def __repr__(self):
+        return f"{self.value:.{self.scale}f}"
+
+
 @dataclasses.dataclass
 class ParameterizedSql:
     sql: str                 # original SQL
     parameterized: str       # literals replaced by ?
     params: List[Any]        # extracted literal values (str | int | float)
+    # slot plan for EVERY ? in `parameterized`, in order:
+    #   ("lit", value)  — a literal this pass extracted
+    #   ("client", k)   — the k-th placeholder the client sent in the original SQL
+    slots: List[Tuple[str, Any]] = dataclasses.field(default_factory=list)
 
     @property
     def cache_key(self) -> str:
         return self.parameterized
 
+    def resolve(self, client_params: List[Any]) -> List[Any]:
+        """Bind values for all ?s: extracted literals + client-protocol params."""
+        from galaxysql_tpu.utils.errors import TddlError
+        out: List[Any] = []
+        for kind, v in self.slots:
+            if kind == "lit":
+                out.append(v)
+            else:
+                if v >= len(client_params):
+                    raise TddlError("not enough parameters bound")
+                out.append(client_params[v])
+        return out
 
-# keywords after which a literal is structural, not a data value (don't parameterize)
-_KEEP_BEFORE = {"LIMIT", "OFFSET", "PARTITIONS", "TBPARTITIONS", "INTERVAL", "TOP"}
+
+# keywords after which a literal is structural, not a data value (don't parameterize).
+# DATE/TIMESTAMP/TIME keyword literals stay inline so the parser can type them.
+_KEEP_BEFORE = {"LIMIT", "OFFSET", "PARTITIONS", "TBPARTITIONS", "INTERVAL", "TOP",
+                "DATE", "TIMESTAMP", "TIME"}
 _KEEP_STMT_PREFIX = {"CREATE", "ALTER", "DROP", "SET", "SHOW", "USE", "KILL", "ANALYZE",
                      "TRUNCATE", "DESC", "DESCRIBE", "EXPLAIN", "BEGIN", "COMMIT",
                      "ROLLBACK", "START", "GRANT", "REVOKE"}
@@ -42,21 +74,46 @@ def parameterize(sql: str) -> ParameterizedSql:
 
     out: List[str] = []
     params: List[Any] = []
+    slots: List[Tuple[str, Any]] = []
+    client_ix = 0
     pos = 0
     prev_sig: Token | None = None
+    # GROUP BY / ORDER BY ordinal tracking: a bare integer that IS a whole by-list item
+    # is a column ordinal, structural for the plan — never parameterize it
+    _BY_HEADS = {"GROUP", "ORDER"}
+    _BY_ENDERS = {"HAVING", "ORDER", "LIMIT", "WHERE", "GROUP", "UNION", "FOR",
+                  "LOCK", "OFFSET"}
+    in_by_list = False
     for i, t in enumerate(toks):
+        if t.kind == T.IDENT and not t.quoted:
+            if t.upper == "BY" and prev_sig is not None and \
+                    prev_sig.kind == T.IDENT and prev_sig.upper in _BY_HEADS:
+                in_by_list = True
+            elif t.upper in _BY_ENDERS:
+                in_by_list = False
+        if t.kind == T.PARAM:
+            slots.append(("client", client_ix))
+            client_ix += 1
+            prev_sig = t
+            continue
         if t.kind not in (T.NUMBER, T.STRING, T.HEX):
             if t.kind != T.EOF:
                 prev_sig = t
             continue
+        if in_by_list and t.kind == T.NUMBER and prev_sig is not None and \
+                ((prev_sig.kind == T.OP and prev_sig.text == ",") or
+                 prev_sig.is_kw("BY")):
+            nxt = toks[i + 1] if i + 1 < len(toks) else None
+            if nxt is None or nxt.kind == T.EOF or \
+                    (nxt.kind == T.OP and nxt.text in (",", ";", ")")) or \
+                    nxt.is_kw("ASC", "DESC", *(_BY_ENDERS)):
+                prev_sig = t
+                continue  # ordinal, keep inline
         if prev_sig is not None:
             if prev_sig.kind == T.IDENT and not prev_sig.quoted and \
                     prev_sig.upper in _KEEP_BEFORE:
                 prev_sig = t
                 continue
-            # DATE '...' style keyword literals: keep the keyword, parameterize the string
-            # (they're data values).  INTERVAL '90' DAY: the value is structural for plan
-            # shape in our planner (constant folding), keep it.
         # LIMIT 10, 20 — second literal after comma still under LIMIT
         if prev_sig is not None and prev_sig.kind == T.OP and prev_sig.text == "," and i >= 2:
             # find the significant token before the comma's left operand
@@ -71,12 +128,18 @@ def parameterize(sql: str) -> ParameterizedSql:
         out.append("?")
         pos = t.end
         if t.kind == T.NUMBER:
-            params.append(float(t.text) if "." in t.text or "e" in t.text.lower()
-                          else int(t.text))
+            if "." in t.text and "e" not in t.text.lower():
+                v = DecimalParam(float(t.text), min(len(t.text.split(".")[1]), 8))
+            elif "e" in t.text.lower():
+                v = float(t.text)
+            else:
+                v = int(t.text)
         elif t.kind == T.HEX:
-            params.append(int(t.text, 16))
+            v = int(t.text, 16)
         else:
-            params.append(t.text)
+            v = t.text
+        params.append(v)
+        slots.append(("lit", v))
         prev_sig = t
     out.append(sql[pos:])
-    return ParameterizedSql(sql, "".join(out), params)
+    return ParameterizedSql(sql, "".join(out), params, slots)

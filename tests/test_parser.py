@@ -173,10 +173,17 @@ class TestParameterize:
         b = parameterize("SELECT * FROM t WHERE a = 99")
         assert a.cache_key == b.cache_key
 
-    def test_interval_kept(self):
+    def test_interval_and_date_kept(self):
         p = parameterize("SELECT * FROM t WHERE d < date '1994-01-01' + interval '1' year")
         assert "interval '1' year" in p.parameterized
-        assert p.params == ["1994-01-01"]
+        assert "date '1994-01-01'" in p.parameterized  # typed literal stays inline
+        assert p.params == []
+
+    def test_client_param_slots(self):
+        p = parameterize("SELECT * FROM t WHERE a = ? AND b = 5")
+        assert p.parameterized == "SELECT * FROM t WHERE a = ? AND b = ?"
+        assert p.slots == [("client", 0), ("lit", 5)]
+        assert p.resolve([42]) == [42, 5]
 
     def test_ddl_untouched(self):
         sql = "CREATE TABLE t (a INT DEFAULT 5)"

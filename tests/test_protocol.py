@@ -1,0 +1,179 @@
+"""Wire-protocol integration: real sockets, real MySQL packets, full server stack.
+
+Reference analog: `MockServer` protocol-level tests (SURVEY.md §4 server tests), but
+against the actual engine rather than a mock executor.
+"""
+
+import asyncio
+import threading
+
+import pytest
+
+from galaxysql_tpu.net.client import MiniClient, MySQLError
+from galaxysql_tpu.net.server import MySQLServer
+from galaxysql_tpu.server.instance import Instance
+
+
+@pytest.fixture(scope="module")
+def server():
+    inst = Instance()
+    srv = MySQLServer(inst, port=0, users={"root": "", "alice": "secret"})
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(srv.start())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    started.wait(10)
+    yield srv
+    loop.call_soon_threadsafe(loop.stop)
+
+
+@pytest.fixture()
+def client(server):
+    c = MiniClient("127.0.0.1", server.port)
+    yield c
+    c.close()
+
+
+class TestProtocol:
+    def test_handshake_and_ping(self, client):
+        assert client.server_version.startswith("8.0")
+        assert client.ping()
+
+    def test_auth_password(self, server):
+        c = MiniClient("127.0.0.1", server.port, user="alice", password="secret")
+        assert c.ping()
+        c.close()
+        with pytest.raises(MySQLError) as ei:
+            MiniClient("127.0.0.1", server.port, user="alice", password="wrong")
+        assert ei.value.errno == 1045
+        with pytest.raises(MySQLError):
+            MiniClient("127.0.0.1", server.port, user="nobody")
+
+    def test_query_roundtrip(self, client):
+        client.query("CREATE DATABASE IF NOT EXISTS wire")
+        client.query("USE wire")
+        client.query("CREATE TABLE IF NOT EXISTS t (id BIGINT PRIMARY KEY, "
+                     "name VARCHAR(20), amount DECIMAL(10,2), d DATE)")
+        client.query("TRUNCATE TABLE t")
+        client.query("INSERT INTO t VALUES (1,'ann',3.50,'2024-01-05'),"
+                     "(2,NULL,NULL,NULL)")
+        names, rows = client.query("SELECT id, name, amount, d FROM t ORDER BY id")
+        assert names == ["id", "name", "amount", "d"]
+        assert rows == [("1", "ann", "3.5", "2024-01-05"), ("2", None, None, None)]
+
+    def test_error_packet(self, client):
+        client.query("CREATE DATABASE IF NOT EXISTS wire")
+        client.query("USE wire")
+        with pytest.raises(MySQLError) as ei:
+            client.query("SELECT * FROM does_not_exist")
+        assert ei.value.errno == 1146
+        # connection stays usable after an error
+        assert client.query("SELECT 1 AS x")[1] == [("1",)]
+
+    def test_multi_statement(self, client):
+        client.query("CREATE DATABASE IF NOT EXISTS wire; USE wire")
+        names, rows = client.query(
+            "CREATE TABLE IF NOT EXISTS m (a BIGINT); TRUNCATE TABLE m; "
+            "INSERT INTO m VALUES (7); SELECT a FROM m")
+        assert rows == [("7",)]
+
+    def test_connect_with_database(self, server):
+        c0 = MiniClient("127.0.0.1", server.port)
+        c0.query("CREATE DATABASE IF NOT EXISTS withdb")
+        c0.close()
+        c = MiniClient("127.0.0.1", server.port, database="withdb")
+        assert c.query("SELECT database() AS d")[1] == [("withdb",)]
+        c.close()
+
+    def test_prepared_statements_binary(self, client):
+        client.query("CREATE DATABASE IF NOT EXISTS wire; USE wire")
+        client.query("CREATE TABLE IF NOT EXISTS p (id BIGINT, v DOUBLE, "
+                     "s VARCHAR(10)); TRUNCATE TABLE p")
+        sid = client.prepare("INSERT INTO p VALUES (?, ?, ?)")
+        client.execute(sid, [1, 2.5, "xy"])
+        client.execute(sid, [2, None, None])
+        sid2 = client.prepare("SELECT id, v, s FROM p WHERE id >= ? ORDER BY id")
+        names, rows = client.execute(sid2, [1])
+        assert names == ["id", "v", "s"]
+        assert rows[0] == (1, 2.5, "xy")
+        assert rows[1] == (2, None, None)
+
+    def test_show_via_wire(self, client):
+        names, rows = client.query("SHOW DATABASES")
+        assert names == ["Database"]
+        assert any("information_schema" in r for r in rows)
+
+    def test_concurrent_sessions(self, server):
+        results = []
+
+        def worker(i):
+            c = MiniClient("127.0.0.1", server.port)
+            c.query("CREATE DATABASE IF NOT EXISTS wire")
+            c.query("USE wire")
+            _, rows = c.query(f"SELECT {i} + 1 AS v")
+            results.append(rows[0][0])
+            c.close()
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert sorted(results) == [str(i + 1) for i in range(6)]
+
+
+class TestReviewRegressions:
+    def test_group_order_ordinals_survive_parameterization(self, client):
+        client.query("CREATE DATABASE IF NOT EXISTS wire; USE wire")
+        client.query("CREATE TABLE IF NOT EXISTS ordi (a BIGINT, b BIGINT); "
+                     "TRUNCATE TABLE ordi")
+        client.query("INSERT INTO ordi VALUES (1, 10), (1, 20), (2, 5)")
+        names, rows = client.query(
+            "SELECT a, SUM(b) FROM ordi GROUP BY 1 ORDER BY 2 DESC")
+        assert rows == [("1", "30"), ("2", "5")]
+
+    def test_stmt_execute_reuses_cached_types(self, server):
+        # craft a second COM_STMT_EXECUTE with new_params_bound_flag = 0
+        import struct
+        from galaxysql_tpu.net import packets as P
+        c = MiniClient("127.0.0.1", server.port)
+        c.query("CREATE DATABASE IF NOT EXISTS wire; USE wire")
+        c.query("CREATE TABLE IF NOT EXISTS pt (a BIGINT); TRUNCATE TABLE pt")
+        c.query("INSERT INTO pt VALUES (1), (2), (3)")
+        sid = c.prepare("SELECT a FROM pt WHERE a = ? ORDER BY a")
+        assert c.execute(sid, [2])[1] == [(2,)]
+        # manual re-execute: null bitmap, flag=0, no types, value only
+        payload = (bytes([P.COM_STMT_EXECUTE]) + struct.pack("<IBI", sid, 0, 1) +
+                   b"\x00" + b"\x00" + struct.pack("<q", 3))
+        c._command(payload)
+        names, rows = c._read_result(binary=True)
+        assert rows == [(3,)]
+        c.close()
+
+    def test_question_mark_inside_string_literal(self, client):
+        client.query("CREATE DATABASE IF NOT EXISTS wire; USE wire")
+        client.query("CREATE TABLE IF NOT EXISTS qs (s VARCHAR(10)); "
+                     "TRUNCATE TABLE qs")
+        sid = client.prepare("INSERT INTO qs VALUES ('who?')")
+        client.execute(sid, [])
+        assert client.query("SELECT s FROM qs")[1] == [("who?",)]
+
+    def test_missing_params_proper_error(self, client):
+        client.query("CREATE DATABASE IF NOT EXISTS wire; USE wire")
+        client.query("CREATE TABLE IF NOT EXISTS mp (a BIGINT)")
+        sid = client.prepare("SELECT a FROM mp WHERE a = ?")
+        with pytest.raises(MySQLError) as ei:
+            # send an execute claiming zero params for a 1-param statement
+            import struct
+            from galaxysql_tpu.net import packets as P
+            payload = bytes([P.COM_STMT_EXECUTE]) + struct.pack("<IBI", sid, 0, 1)
+            client._command(payload)
+            client._read_result(binary=True)
+        assert ei.value.errno != 0
