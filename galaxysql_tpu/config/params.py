@@ -54,6 +54,7 @@ DEADLOCK_DETECT_INTERVAL = _p("DEADLOCK_DETECT_INTERVAL", 1000, "ms")
 DML_BATCH_SIZE = _p("DML_BATCH_SIZE", 10_000, "insert batch size")
 
 # --- MPP ----------------------------------------------------------------------
+ENABLE_MPP = _p("ENABLE_MPP", True, "SPMD mesh execution for AP queries")
 MPP_PARALLELISM = _p("MPP_PARALLELISM", 8, "devices per query")
 MPP_MIN_AP_ROWS = _p("MPP_MIN_AP_ROWS", 1 << 22, "rows before cluster MPP kicks in")
 

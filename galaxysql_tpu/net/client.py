@@ -28,15 +28,20 @@ class MiniClient:
                  database: Optional[str] = None, timeout: float = 30.0):
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.seq = 0
+        self.more_results = False
         self._handshake(user, password, database)
 
     # -- framing ---------------------------------------------------------------
 
     def _read_packet(self) -> bytes:
-        header = self._recvn(4)
-        length = header[0] | (header[1] << 8) | (header[2] << 16)
-        self.seq = (header[3] + 1) & 0xFF
-        return self._recvn(length)
+        payload = b""
+        while True:
+            header = self._recvn(4)
+            length = header[0] | (header[1] << 8) | (header[2] << 16)
+            self.seq = (header[3] + 1) & 0xFF
+            payload += self._recvn(length)
+            if length < 0xFFFFFF:
+                return payload
 
     def _recvn(self, n: int) -> bytes:
         buf = b""
@@ -48,9 +53,13 @@ class MiniClient:
         return buf
 
     def _send(self, payload: bytes):
-        header = struct.pack("<I", len(payload))[:3] + bytes([self.seq])
-        self.seq = (self.seq + 1) & 0xFF
-        self.sock.sendall(header + payload)
+        while True:
+            chunk, payload = payload[:0xFFFFFF], payload[0xFFFFFF:]
+            header = struct.pack("<I", len(chunk))[:3] + bytes([self.seq])
+            self.seq = (self.seq + 1) & 0xFF
+            self.sock.sendall(header + chunk)
+            if len(chunk) < 0xFFFFFF:
+                break
 
     def _command(self, payload: bytes):
         self.seq = 0
@@ -99,9 +108,15 @@ class MiniClient:
     # -- queries -----------------------------------------------------------------
 
     def query(self, sql: str) -> Tuple[List[str], List[Tuple]]:
-        """Returns (column names, rows).  Non-queries return ([], [])."""
+        """Returns the LAST statement's (column names, rows); use query_all for all."""
+        return self.query_all(sql)[-1]
+
+    def query_all(self, sql: str) -> List[Tuple[List[str], List[Tuple]]]:
         self._command(bytes([P.COM_QUERY]) + sql.encode("utf8"))
-        return self._read_result(binary=False)
+        out = [self._read_result(binary=False)]
+        while self.more_results:
+            out.append(self._read_result(binary=False))
+        return out
 
     def ping(self) -> bool:
         self._command(bytes([P.COM_PING]))
@@ -153,8 +168,15 @@ class MiniClient:
     def _read_result(self, binary: bool) -> Tuple[List[str], List[Tuple]]:
         first = self._read_packet()
         if first[0] == 0xFF:
+            self.more_results = False
             raise self._err(first)
         if first[0] == 0x00:
+            # OK packet: [affected][last_id][status][warnings]
+            pos = 1
+            _, pos = P.read_lenenc_int(first, pos)
+            _, pos = P.read_lenenc_int(first, pos)
+            status = struct.unpack_from("<H", first, pos)[0]
+            self.more_results = bool(status & P.SERVER_MORE_RESULTS_EXISTS)
             return [], []
         n_cols, _ = P.read_lenenc_int(first, 0)
         names: List[str] = []
@@ -174,6 +196,8 @@ class MiniClient:
         while True:
             pkt = self._read_packet()
             if pkt[0] == 0xFE and len(pkt) < 9:
+                status = struct.unpack_from("<H", pkt, 3)[0]
+                self.more_results = bool(status & P.SERVER_MORE_RESULTS_EXISTS)
                 break
             if pkt[0] == 0xFF:
                 raise self._err(pkt)

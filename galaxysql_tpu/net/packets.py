@@ -40,6 +40,7 @@ SERVER_CAPABILITIES = (CLIENT_LONG_PASSWORD | CLIENT_FOUND_ROWS | CLIENT_LONG_FL
 # status flags
 SERVER_STATUS_AUTOCOMMIT = 2
 SERVER_STATUS_IN_TRANS = 1
+SERVER_MORE_RESULTS_EXISTS = 8
 
 # commands
 COM_QUIT = 0x01
@@ -308,7 +309,8 @@ def parse_stmt_execute_params(payload: bytes, n_params: int,
     elif known_types is not None:
         types = known_types
     else:
-        return params, []  # no type info at all: only NULLs decodable
+        from galaxysql_tpu.utils.errors import TddlError
+        raise TddlError("malformed COM_STMT_EXECUTE: no parameter types bound")
     for i in range(n_params):
         if null_bitmap[i // 8] & (1 << (i % 8)):
             params[i] = None

@@ -50,10 +50,15 @@ class Connection:
     # -- framing ---------------------------------------------------------------
 
     async def read_packet(self) -> Optional[bytes]:
-        header = await self.reader.readexactly(4)
-        length = header[0] | (header[1] << 8) | (header[2] << 16)
-        self.seq = (header[3] + 1) & 0xFF
-        return await self.reader.readexactly(length)
+        # reassemble >=16MB payloads split across continuation packets
+        payload = b""
+        while True:
+            header = await self.reader.readexactly(4)
+            length = header[0] | (header[1] << 8) | (header[2] << 16)
+            self.seq = (header[3] + 1) & 0xFF
+            payload += await self.reader.readexactly(length)
+            if length < 0xFFFFFF:
+                return payload
 
     def send(self, payload: bytes):
         while True:
@@ -134,8 +139,12 @@ class Connection:
                 self.send(P.ok_packet(status=self._status()))
             elif cmd == P.COM_QUERY:
                 sql = payload[1:].decode("utf8", "replace")
-                r = await self.run_blocking(self.session.execute, sql)
-                self.send_result(r)
+                results = await self.run_blocking(self.session.execute_all, sql)
+                # CLIENT_MULTI_STATEMENTS: every statement's result is sent, with
+                # SERVER_MORE_RESULTS_EXISTS on all but the last
+                for i, r in enumerate(results):
+                    more = P.SERVER_MORE_RESULTS_EXISTS if i + 1 < len(results) else 0
+                    self.send_result(r, status_extra=more)
             elif cmd == P.COM_FIELD_LIST:
                 table = payload[1:].split(b"\0")[0].decode("utf8", "replace")
                 r = await self.run_blocking(self.session.execute,
@@ -148,6 +157,8 @@ class Connection:
                 self.stmt_prepare(payload[1:].decode("utf8", "replace"))
             elif cmd == P.COM_STMT_EXECUTE:
                 await self.stmt_execute(payload)
+            elif cmd == P.COM_STMT_SEND_LONG_DATA:
+                pass  # protocol: NO response; long-data binding not yet supported
             elif cmd == P.COM_STMT_CLOSE:
                 stmt_id = struct.unpack_from("<I", payload, 1)[0]
                 self.stmts.pop(stmt_id, None)  # no response
@@ -166,21 +177,23 @@ class Connection:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(self.server.pool, fn, *args)
 
-    def send_result(self, r: ResultSet, binary: bool = False):
+    def send_result(self, r: ResultSet, binary: bool = False,
+                    status_extra: int = 0):
+        status = self._status() | status_extra
         if not r.is_query:
-            self.send(P.ok_packet(r.affected, r.last_insert_id, self._status(),
+            self.send(P.ok_packet(r.affected, r.last_insert_id, status,
                                   info=r.info.encode("utf8")))
             return
         self.send(P.lenenc_int(len(r.names)))
         for name, typ in zip(r.names, r.types):
             self.send(P.column_def(name, typ))
-        self.send(P.eof_packet(self._status()))
+        self.send(P.eof_packet(status))
         for row in r.rows:
             if binary:
                 self.send(P.binary_row(row, r.types))
             else:
                 self.send(P.text_row(row))
-        self.send(P.eof_packet(self._status()))
+        self.send(P.eof_packet(status))
 
     # -- prepared statements -------------------------------------------------------
 

@@ -1,0 +1,74 @@
+"""The exchange plane: repartition/broadcast as ICI collectives.
+
+Reference analog: the MPP data plane — `PartitionedOutputBuffer`/`ExchangeClient`
+shuttling LZ4 pages over HTTP (SURVEY.md §2.7, §5.8 plane 3).  Here an exchange is a
+collective inside the SPMD program: hash repartition = bucketed `all_to_all`, broadcast
+= `all_gather`, both over the mesh's `shard` axis (ICI inside a slice).  No serde, no
+HTTP, no compression — the interconnect moves raw column lanes.
+
+All functions run INSIDE shard_map blocks: arrays are the local shard ([R] lanes).
+Fixed shapes: each destination gets a `quota`-sized bucket; senders report overflow so
+the host can retry with a bigger quota (the reference's unbounded buffers become
+bounded buckets + retry, consistent with the engine's overflow-retry discipline).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+AXIS = "shard"
+
+
+def repartition_by_hash(lanes: Sequence[Any], live: Any, hash_lane: Any,
+                        quota: int) -> Tuple[List[Any], Any, Any]:
+    """Hash-repartition rows over the mesh axis.
+
+    lanes: per-row payload arrays [R]; live: [R] bool; hash_lane: uint64 [R].
+    Returns (exchanged lanes [S*quota], exchanged live, overflow flag scalar).
+    Row r goes to shard hash % S; each (src, dst) pair carries `quota` slots.
+    """
+    ns = jax.lax.axis_size(AXIS)
+    n = live.shape[0]
+    dest = (hash_lane % jnp.uint64(ns)).astype(jnp.int32)
+    # dead rows: send nowhere (dest stays, live=False travels with them)
+    order = jnp.lexsort((jnp.arange(n), jnp.where(live, dest, ns)))
+    dest_s = dest[order]
+    live_s = live[order]
+    counts = jnp.sum(jnp.where(live[None, :] & (dest[None, :] ==
+                                                jnp.arange(ns)[:, None]), 1, 0),
+                     axis=1)
+    overflow = jnp.any(counts > quota)
+    starts = jnp.searchsorted(jnp.where(live_s, dest_s, ns), jnp.arange(ns))
+    rank = jnp.arange(n) - starts[jnp.clip(dest_s, 0, ns - 1)]
+    ok = (rank >= 0) & (rank < quota) & live_s
+    flat = jnp.where(ok, dest_s * quota + rank, ns * quota)
+
+    out_lanes = []
+    for lane in lanes:
+        lane_s = lane[order]
+        buf = jnp.zeros(ns * quota, dtype=lane.dtype)
+        buf = buf.at[flat].set(jnp.where(ok, lane_s, jnp.zeros((), lane.dtype)),
+                               mode="drop")
+        x = jax.lax.all_to_all(buf.reshape(ns, quota), AXIS, 0, 0).reshape(-1)
+        out_lanes.append(x)
+    live_buf = jnp.zeros(ns * quota, dtype=jnp.bool_).at[flat].set(ok, mode="drop")
+    live_x = jax.lax.all_to_all(live_buf.reshape(ns, quota), AXIS, 0, 0).reshape(-1)
+    return out_lanes, live_x, overflow
+
+
+def broadcast_all(lanes: Sequence[Any], live: Any) -> Tuple[List[Any], Any]:
+    """Replicate every shard's rows to all shards (broadcast join build side).
+
+    Returns lanes of shape [S*R] and the combined live mask."""
+    out = [jax.lax.all_gather(lane, AXIS, axis=0, tiled=False).reshape(
+        (-1,) + lane.shape[1:]) for lane in lanes]
+    live_g = jax.lax.all_gather(live, AXIS, axis=0, tiled=False).reshape(-1)
+    return out, live_g
+
+
+def gather_concat(lanes: Sequence[Any], live: Any) -> Tuple[List[Any], Any]:
+    """all_gather: every shard receives the concatenation (replicated result)."""
+    return broadcast_all(lanes, live)

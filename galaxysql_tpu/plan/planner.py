@@ -35,7 +35,8 @@ class ExecutionPlan:
         self.statement = statement
         self.catalog_version = catalog_version
         self.param_count = param_count
-        self.workload = classify_workload(rel)
+        self.scanned_rows = scanned_rows_estimate(rel)
+        self.workload = "AP" if self.scanned_rows >= AP_ROW_THRESHOLD else "TP"
 
     def fields(self) -> List[L.Field]:
         return self.rel.fields()
@@ -47,8 +48,7 @@ class ExecutionPlan:
 AP_ROW_THRESHOLD = 50_000
 
 
-def classify_workload(rel: L.RelNode) -> str:
-    """TP = small row footprint (host engine); AP = large (device engine)."""
+def scanned_rows_estimate(rel: L.RelNode) -> float:
     total = 0.0
     for n in L.walk(rel):
         if isinstance(n, L.Scan):
@@ -56,7 +56,12 @@ def classify_workload(rel: L.RelNode) -> str:
             if n.partitions is not None and n.table.partition.num_partitions > 0:
                 frac = len(n.partitions) / n.table.partition.num_partitions
             total += n.table.stats.row_count * frac
-    return "AP" if total >= AP_ROW_THRESHOLD else "TP"
+    return total
+
+
+def classify_workload(rel: L.RelNode) -> str:
+    """TP = small row footprint (host engine); AP = large (device engine)."""
+    return "AP" if scanned_rows_estimate(rel) >= AP_ROW_THRESHOLD else "TP"
 
 
 class PlanCache:

@@ -60,3 +60,18 @@ class Instance:
             cid = self.next_conn_id
             self.next_conn_id += 1
             return cid
+
+    def mesh(self):
+        """The instance's device mesh for MPP execution (None on a single device)."""
+        if not hasattr(self, "_mesh"):
+            import jax
+            try:
+                devs = jax.devices()
+            except RuntimeError:
+                devs = []
+            if len(devs) > 1:
+                from galaxysql_tpu.parallel.mesh import make_mesh
+                self._mesh = make_mesh(devices=devs)
+            else:
+                self._mesh = None
+        return self._mesh

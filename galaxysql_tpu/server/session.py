@@ -105,13 +105,14 @@ class Session:
     # -- public API -----------------------------------------------------------
 
     def execute(self, sql: str, params: Optional[list] = None) -> ResultSet:
+        """Run statement(s); returns the LAST result (embedded convenience API)."""
+        results = self.execute_all(sql, params)
+        return results[-1] if results else ok()
+
+    def execute_all(self, sql: str, params: Optional[list] = None) -> List[ResultSet]:
+        """Run every statement, returning each result (the wire protocol sends all)."""
         stmts = split_statements(sql)
-        if not stmts:
-            return ok()
-        result = ok()
-        for s in stmts:
-            result = self._execute_one(s, params)
-        return result
+        return [self._execute_one(s, params) for s in stmts] if stmts else [ok()]
 
     def close(self):
         if self.txn is not None:
@@ -203,13 +204,28 @@ class Session:
         ctx = ExecContext(self.instance.stores, self._snapshot_ts(), params or [],
                           device_cache=cache,
                           txn_id=self.txn.txn_id if self.txn is not None else 0)
-        op = build_operator(plan.rel, ctx)
-        # TP fast path: pin execution to the host CPU backend — point queries must not
-        # pay accelerator dispatch/compile latency (the CURSOR-mode bypass, SURVEY.md
-        # §7.3 'latency floor')
-        device_ctx = _cpu_device_ctx() if plan.workload == "TP" else _NULL_CTX
-        with device_ctx:
-            batch = run_to_batch(op)
+        batch = None
+        if plan.workload == "AP" and \
+                self.instance.config.get("ENABLE_MPP", self.vars) and \
+                plan.scanned_rows >= self.instance.config.get("MPP_MIN_AP_ROWS",
+                                                              self.vars):
+            # cluster MPP mode: the plan compiles to SPMD stages over the device mesh
+            # (ExecutorHelper.executeCluster analog)
+            mesh = self.instance.mesh()
+            if mesh is not None:
+                from galaxysql_tpu.parallel.mpp import MppExecutor
+                try:
+                    batch = MppExecutor(ctx, mesh).execute(plan.rel)
+                except errors.NotSupportedError:
+                    batch = None  # plan shape not yet distributed: local engine
+        if batch is None:
+            op = build_operator(plan.rel, ctx)
+            # TP fast path: pin execution to the host CPU backend — point queries must
+            # not pay accelerator dispatch/compile latency (CURSOR-mode bypass,
+            # SURVEY.md §7.3 'latency floor')
+            device_ctx = _cpu_device_ctx() if plan.workload == "TP" else _NULL_CTX
+            with device_ctx:
+                batch = run_to_batch(op)
         rows = batch.to_pylist()
         fields = plan.fields()
         self.last_trace = ctx.trace + [f"elapsed={time.time() - t0:.3f}s "

@@ -91,6 +91,10 @@ def parameterize(sql: str) -> ParameterizedSql:
                 in_by_list = True
             elif t.upper in _BY_ENDERS:
                 in_by_list = False
+        elif t.kind == T.OP and t.text in ("(", ")"):
+            # parens close the by-list scope (a subquery ending at ')' must not leak
+            # its ordinal context into the outer query's literals)
+            in_by_list = False
         if t.kind == T.PARAM:
             slots.append(("client", client_ix))
             client_ix += 1

@@ -1,0 +1,112 @@
+"""MPP engine tests on the 8-virtual-device CPU mesh: distributed results must equal
+the single-device engine's (the LocalServer-style in-proc cluster test, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from galaxysql_tpu.parallel.mesh import make_mesh
+from galaxysql_tpu.parallel.mpp import MppExecutor
+from galaxysql_tpu.plan.physical import ExecContext
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.storage import tpch
+from galaxysql_tpu.storage.tpch_queries import QUERIES
+from galaxysql_tpu.utils import errors
+
+
+@pytest.fixture(scope="module")
+def env():
+    import jax
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    data = tpch.generate(0.01)
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE tpch")
+    s.execute("USE tpch")
+    for t in tpch.TABLE_ORDER:
+        s.execute(tpch.TPCH_DDL[t])
+        inst.store("tpch", t).insert_arrays(data[t], inst.tso.next_timestamp())
+    s.execute("ANALYZE TABLE " + ", ".join(tpch.TABLE_ORDER))
+    mesh = make_mesh(8)
+    yield inst, s, mesh
+    s.close()
+
+
+def run_mpp(inst, s, mesh, sql):
+    plan = inst.planner.plan_select(sql, "tpch")
+    ctx = ExecContext(inst.stores, inst.tso.next_timestamp(), [])
+    ex = MppExecutor(ctx, mesh)
+    return ex.execute(plan.rel)
+
+
+def rows_of(batch):
+    return batch.to_pylist()
+
+
+def assert_same(mpp_rows, local_rows, ordered):
+    if not ordered:
+        keyf = lambda r: tuple(str(x) for x in r)
+        mpp_rows = sorted(mpp_rows, key=keyf)
+        local_rows = sorted(local_rows, key=keyf)
+    assert len(mpp_rows) == len(local_rows)
+    for a, b in zip(mpp_rows, local_rows):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            if isinstance(x, float) and isinstance(y, float):
+                assert abs(x - y) <= max(abs(y) * 1e-6, 1e-6)
+            else:
+                assert x == y
+
+
+MPP_QUERIES = {
+    # qid: ordered?
+    1: True,    # scan + big multi-agg + sort
+    3: True,    # 3-way join + agg + topn
+    5: True,    # 6-way join incl. broadcast dims
+    6: False,   # scan + global agg
+    10: True,   # 4-way join + agg + topn
+    12: True,   # join + conditional agg
+    14: False,  # join + case agg ratio
+    19: False,  # factored OR join
+}
+
+
+@pytest.mark.parametrize("qid", sorted(MPP_QUERIES))
+def test_tpch_mpp_matches_local(env, qid):
+    inst, s, mesh = env
+    sql = QUERIES[qid]
+    local = s.execute(sql)
+    mpp = run_mpp(inst, s, mesh, sql)
+    assert_same(rows_of(mpp), local.rows, MPP_QUERIES[qid])
+
+
+def test_shuffle_join_path(env):
+    """Force the hash-shuffle path by dropping the broadcast threshold."""
+    import galaxysql_tpu.parallel.mpp as M
+    inst, s, mesh = env
+    old = M.BROADCAST_BUILD_LIMIT
+    M.BROADCAST_BUILD_LIMIT = 0
+    try:
+        sql = ("SELECT o_orderpriority, count(*) AS n FROM orders, lineitem "
+               "WHERE o_orderkey = l_orderkey AND l_quantity < 10 "
+               "GROUP BY o_orderpriority ORDER BY o_orderpriority")
+        local = s.execute(sql)
+        mpp = run_mpp(inst, s, mesh, sql)
+        assert_same(rows_of(mpp), local.rows, True)
+    finally:
+        M.BROADCAST_BUILD_LIMIT = old
+
+
+def test_semi_anti_join_mpp(env):
+    inst, s, mesh = env
+    sql = ("SELECT c_custkey FROM customer WHERE c_custkey IN "
+           "(SELECT o_custkey FROM orders WHERE o_totalprice > 100) "
+           "ORDER BY c_custkey LIMIT 20")
+    local = s.execute(sql)
+    mpp = run_mpp(inst, s, mesh, sql)
+    assert_same(rows_of(mpp), local.rows, True)
+    sql2 = ("SELECT count(*) FROM customer WHERE c_custkey NOT IN "
+            "(SELECT o_custkey FROM orders)")
+    local2 = s.execute(sql2)
+    mpp2 = run_mpp(inst, s, mesh, sql2)
+    assert_same(rows_of(mpp2), local2.rows, False)

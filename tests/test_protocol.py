@@ -79,10 +79,15 @@ class TestProtocol:
 
     def test_multi_statement(self, client):
         client.query("CREATE DATABASE IF NOT EXISTS wire; USE wire")
-        names, rows = client.query(
+        results = client.query_all(
             "CREATE TABLE IF NOT EXISTS m (a BIGINT); TRUNCATE TABLE m; "
             "INSERT INTO m VALUES (7); SELECT a FROM m")
-        assert rows == [("7",)]
+        # EVERY statement's result arrives (SERVER_MORE_RESULTS_EXISTS chain)
+        assert len(results) == 4
+        assert results[-1][1] == [("7",)]
+        assert results[0] == ([], []) and results[2] == ([], [])
+        # and the convenience API returns the last
+        assert client.query("SELECT 1; SELECT 2")[1] == [("2",)]
 
     def test_connect_with_database(self, server):
         c0 = MiniClient("127.0.0.1", server.port)
