@@ -1,0 +1,523 @@
+"""DDL job engine: crash-recoverable online schema changes.
+
+Reference analog: the declarative DDL framework (SURVEY.md §3.5) — a job is a DAG of
+idempotent tasks persisted in the metadb (`ddl_engine`/`ddl_engine_task`, Appendix B);
+`DdlEngineDagExecutor.java:102` runs tasks with per-task checkpointing, resumes from
+the last completed task after a crash, and rolls back by undoing completed tasks in
+reverse.  Linear DAGs here (the reference's jobs are mostly linear too); tasks register
+by name so persisted jobs can be rehydrated.
+
+GSI builds follow the online state machine CREATING -> WRITE_ONLY -> PUBLIC
+(Appendix D): the index table is created and backfilled from a snapshot while the
+status gates writer maintenance, then published.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from galaxysql_tpu.meta.catalog import ColumnMeta, IndexMeta, PartitionInfo, TableMeta
+from galaxysql_tpu.types import datatype as dt
+from galaxysql_tpu.utils import errors
+from galaxysql_tpu.utils.failpoint import FAIL_POINTS, FP_AFTER_DDL_TASK, \
+    FP_BEFORE_DDL_TASK
+
+_TASK_REGISTRY: Dict[str, type] = {}
+
+
+def task(cls):
+    _TASK_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+class DdlTask:
+    """An idempotent unit of DDL work with an undo."""
+
+    def __init__(self, payload: Dict[str, Any]):
+        self.payload = payload
+
+    def run(self, ctx: "DdlContext"):
+        raise NotImplementedError
+
+    def undo(self, ctx: "DdlContext"):
+        pass  # default: nothing to undo
+
+
+class DdlContext:
+    def __init__(self, instance, schema: str):
+        self.instance = instance
+        self.schema = schema
+
+    def table(self, name: str) -> TableMeta:
+        return self.instance.catalog.table(self.schema, name)
+
+    def bump(self, tm: TableMeta):
+        tm.bump_version()
+        self.instance.catalog.version += 1
+        if self.instance.metadb is not None:
+            self.instance.metadb.save_table(tm)
+            self.instance.metadb.notify(f"table.{tm.schema}.{tm.name}")
+
+
+# ---------------------------------------------------------------------------
+# task library (the `ddl/job/task/basic` + `gsi` analogs, Appendix D)
+# ---------------------------------------------------------------------------
+
+@task
+class ValidateTableTask(DdlTask):
+    def run(self, ctx):
+        ctx.table(self.payload["table"])  # raises if missing
+
+
+@task
+class AddColumnTask(DdlTask):
+    def run(self, ctx):
+        tm = ctx.table(self.payload["table"])
+        name = self.payload["name"]
+        if tm.has_column(name):
+            return  # idempotent re-run after crash
+        typ = dt.from_sql_name(self.payload["type"], self.payload.get("precision", 0),
+                               self.payload.get("scale", 0))
+        cm = ColumnMeta(name, typ, self.payload.get("nullable", True),
+                        self.payload.get("default"))
+        after = self.payload.get("after")
+        pos = len(tm.columns)
+        if after == "":
+            pos = 0  # FIRST
+        elif after:
+            pos = next((i + 1 for i, c in enumerate(tm.columns)
+                        if c.name.lower() == after.lower()), pos)
+        tm.columns.insert(pos, cm)
+        tm.by_name[name.lower()] = cm
+        if typ.is_string:
+            from galaxysql_tpu.chunk.batch import Dictionary
+            tm.dictionaries[name.lower()] = Dictionary()
+        # physical: add the lane to every partition (default-filled)
+        store = ctx.instance.store(tm.schema, tm.name)
+        for p in store.partitions:
+            n = p.num_rows
+            fill = np.zeros(n, dtype=typ.lane)
+            valid = np.zeros(n, dtype=np.bool_)
+            dv = self.payload.get("default")
+            if dv is not None:
+                from galaxysql_tpu.chunk.batch import column_from_pylist
+                col = column_from_pylist([dv] * n, typ,
+                                         tm.dictionaries.get(name.lower()))
+                fill, valid = col.np_data(), col.np_valid()
+            p.lanes[cm.name] = fill
+            p.valid[cm.name] = valid
+        ctx.bump(tm)
+
+    def undo(self, ctx):
+        tm = ctx.table(self.payload["table"])
+        name = self.payload["name"]
+        if not tm.has_column(name):
+            return
+        tm.columns = [c for c in tm.columns if c.name.lower() != name.lower()]
+        tm.by_name.pop(name.lower(), None)
+        store = ctx.instance.store(tm.schema, tm.name)
+        for p in store.partitions:
+            p.lanes.pop(name, None)
+            p.valid.pop(name, None)
+        ctx.bump(tm)
+
+
+@task
+class DropColumnTask(DdlTask):
+    def run(self, ctx):
+        tm = ctx.table(self.payload["table"])
+        name = self.payload["name"]
+        if not tm.has_column(name):
+            return
+        if name in tm.primary_key:
+            raise errors.TddlError(f"cannot drop primary key column '{name}'")
+        if any(name.lower() in (c.lower() for c in tm.partition.columns)
+               for _ in [0]):
+            if name.lower() in (c.lower() for c in tm.partition.columns):
+                raise errors.TddlError(f"cannot drop partition column '{name}'")
+        tm.columns = [c for c in tm.columns if c.name.lower() != name.lower()]
+        cm = tm.by_name.pop(name.lower(), None)
+        store = ctx.instance.store(tm.schema, tm.name)
+        for p in store.partitions:
+            p.lanes.pop(name, None)
+            p.valid.pop(name, None)
+        ctx.bump(tm)
+    # undo of a drop would need the saved lane; the engine runs destructive tasks
+    # LAST so rollback never has to restore them (reference does the same)
+
+
+@task
+class RenameTableTask(DdlTask):
+    def run(self, ctx):
+        tm = ctx.table(self.payload["table"])
+        new = self.payload["new_name"]
+        cat = ctx.instance.catalog
+        s = cat.schema(tm.schema)
+        if new.lower() in s.tables:
+            return  # already applied
+        store = ctx.instance.store(tm.schema, tm.name)
+        del s.tables[tm.name.lower()]
+        if ctx.instance.metadb is not None:
+            ctx.instance.metadb.drop_table(tm.schema, tm.name)
+        ctx.instance.drop_store(tm.schema, tm.name)
+        tm.name = new
+        s.tables[new.lower()] = tm
+        ctx.instance.stores[ctx.instance.store_key(tm.schema, new)] = store
+        ctx.bump(tm)
+
+
+@task
+class AddIndexMetaTask(DdlTask):
+    """Create index metadata in CREATING state (online build entry point)."""
+
+    def run(self, ctx):
+        tm = ctx.table(self.payload["table"])
+        name = self.payload["name"]
+        if any(i.name.lower() == name.lower() for i in tm.indexes):
+            return
+        for c in self.payload["columns"]:
+            tm.column(c)
+        meta = IndexMeta(name, self.payload["columns"], self.payload.get("unique",
+                                                                         False),
+                         self.payload.get("global", False),
+                         self.payload.get("covering", []))
+        meta.status = "CREATING"
+        tm.indexes.append(meta)
+        ctx.bump(tm)
+
+    def undo(self, ctx):
+        tm = ctx.table(self.payload["table"])
+        tm.indexes = [i for i in tm.indexes
+                      if i.name.lower() != self.payload["name"].lower()]
+        ctx.bump(tm)
+
+
+@task
+class CreateGsiTableTask(DdlTask):
+    """Materialize the GSI as its own partitioned table (partitioned by index cols)."""
+
+    def run(self, ctx):
+        tm = ctx.table(self.payload["table"])
+        gsi_name = _gsi_table_name(tm.name, self.payload["name"])
+        try:
+            ctx.instance.catalog.table(tm.schema, gsi_name)
+            return  # already created
+        except errors.UnknownTableError:
+            pass
+        cols = []
+        wanted = list(self.payload["columns"]) + \
+            [c for c in self.payload.get("covering", [])] + \
+            [c for c in tm.primary_key
+             if c not in self.payload["columns"]]
+        seen = set()
+        for c in wanted:
+            cl = c.lower()
+            if cl in seen:
+                continue
+            seen.add(cl)
+            src = tm.column(c)
+            cols.append(ColumnMeta(src.name, src.dtype, src.nullable))
+        part = PartitionInfo("hash", [self.payload["columns"][0]],
+                             tm.partition.count if tm.partition.method == "hash" else 8)
+        gsi_tm = TableMeta(tm.schema, gsi_name, cols, tm.primary_key, part)
+        # share dictionaries with the base table so codes align for lookups
+        for c in cols:
+            if c.dtype.is_string:
+                gsi_tm.dictionaries[c.name.lower()] = \
+                    tm.dictionaries[c.name.lower()]
+        ctx.instance.catalog.add_table(gsi_tm, if_not_exists=True)
+        ctx.instance.register_table(gsi_tm)
+        ctx.bump(gsi_tm)
+
+    def undo(self, ctx):
+        tm = ctx.table(self.payload["table"])
+        gsi_name = _gsi_table_name(tm.name, self.payload["name"])
+        if ctx.instance.catalog.drop_table(tm.schema, gsi_name, if_exists=True):
+            ctx.instance.drop_store(tm.schema, gsi_name)
+
+
+@task
+class GsiBackfillTask(DdlTask):
+    """Chunked snapshot backfill with a persisted position checkpoint.
+
+    Reference analog: `executor/backfill/Extractor.java:99` -> `Loader.java:52` with
+    positions persisted in metadb so a crashed backfill resumes mid-table."""
+
+    CHUNK = 8192
+
+    def run(self, ctx):
+        tm = ctx.table(self.payload["table"])
+        gsi_name = _gsi_table_name(tm.name, self.payload["name"])
+        gsi_tm = ctx.instance.catalog.table(tm.schema, gsi_name)
+        base = ctx.instance.store(tm.schema, tm.name)
+        gsi = ctx.instance.store(tm.schema, gsi_name)
+        snapshot = self.payload.get("snapshot_ts") or \
+            ctx.instance.tso.next_timestamp()
+        self.payload["snapshot_ts"] = snapshot
+        cols = gsi_tm.column_names()
+        pos = self.payload.get("position", [0, 0])  # [partition, row offset]
+        pstart, roffset = pos
+        for pid in range(pstart, len(base.partitions)):
+            p = base.partitions[pid]
+            vis = p.visible_mask(snapshot)
+            idx = np.nonzero(vis)[0]
+            start = roffset if pid == pstart else 0
+            while start < idx.shape[0]:
+                FAIL_POINTS.inject("FP_BACKFILL_PAUSE", f"p{pid}@{start}")
+                chunk = idx[start:start + self.CHUNK]
+                lanes = {c: p.lanes[c][chunk] for c in cols}
+                valid = {c: p.valid[c][chunk] for c in cols}
+                pids = gsi._route(lanes)
+                for gp in np.unique(pids):
+                    sel = np.nonzero(pids == gp)[0]
+                    gsi.partitions[int(gp)].append(
+                        {k: v[sel] for k, v in lanes.items()},
+                        {k: v[sel] for k, v in valid.items()}, snapshot)
+                start += self.CHUNK
+                # checkpoint after every chunk (resume granularity)
+                self.payload["position"] = [pid, start]
+                ctx._checkpoint()
+            roffset = 0
+        gsi_tm.stats.row_count = gsi.row_count()
+
+    def undo(self, ctx):
+        tm = ctx.table(self.payload["table"])
+        gsi_name = _gsi_table_name(tm.name, self.payload["name"])
+        try:
+            ctx.instance.store(tm.schema, gsi_name).truncate()
+        except KeyError:
+            pass
+
+
+@task
+class UpdateIndexStatusTask(DdlTask):
+    def run(self, ctx):
+        tm = ctx.table(self.payload["table"])
+        for i in tm.indexes:
+            if i.name.lower() == self.payload["name"].lower():
+                i.status = self.payload["status"]
+        ctx.bump(tm)
+
+    def undo(self, ctx):
+        tm = ctx.table(self.payload["table"])
+        prev = self.payload.get("prev_status", "CREATING")
+        for i in tm.indexes:
+            if i.name.lower() == self.payload["name"].lower():
+                i.status = prev
+        ctx.bump(tm)
+
+
+@task
+class DropIndexTask(DdlTask):
+    def run(self, ctx):
+        tm = ctx.table(self.payload["table"])
+        name = self.payload["name"]
+        before = len(tm.indexes)
+        dropped = [i for i in tm.indexes if i.name.lower() == name.lower()]
+        tm.indexes = [i for i in tm.indexes if i.name.lower() != name.lower()]
+        if dropped and dropped[0].global_index:
+            gsi_name = _gsi_table_name(tm.name, name)
+            if ctx.instance.catalog.drop_table(tm.schema, gsi_name, if_exists=True):
+                ctx.instance.drop_store(tm.schema, gsi_name)
+        if len(tm.indexes) != before:
+            ctx.bump(tm)
+
+
+@task
+class InvalidatePlansTask(DdlTask):
+    """Sync-action analog: flush plan caches after a metadata change (App.D)."""
+
+    def run(self, ctx):
+        ctx.instance.planner.cache.invalidate_all()
+        from galaxysql_tpu.exec.device_cache import GLOBAL_DEVICE_CACHE
+        GLOBAL_DEVICE_CACHE.clear()
+
+
+def _gsi_table_name(table: str, index: str) -> str:
+    return f"{table}${index}"
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+class DdlJob:
+    def __init__(self, schema: str, sql: str, tasks: List[DdlTask]):
+        self.schema = schema
+        self.sql = sql
+        self.tasks = tasks
+        self.job_id: Optional[int] = None
+
+
+class DdlEngine:
+    """Executes jobs with per-task persisted state and reverse-order rollback."""
+
+    def __init__(self, instance):
+        self.instance = instance
+
+    @property
+    def metadb(self):
+        return self.instance.metadb
+
+    def submit_and_run(self, job: DdlJob):
+        db = self.metadb
+        if db is not None:
+            cur = db.execute(
+                "INSERT INTO ddl_engine (schema_name, ddl_sql, state, job_json, "
+                "created, updated) VALUES (?,?,?,?,?,?)",
+                (job.schema, job.sql, "RUNNING", "", time.time(), time.time()))
+            job.job_id = cur.lastrowid
+            for tid, t in enumerate(job.tasks):
+                db.execute("INSERT INTO ddl_engine_task VALUES (?,?,?,?,?)",
+                           (job.job_id, tid, type(t).__name__, "PENDING",
+                            json.dumps(t.payload)))
+        self._execute(job)
+
+    def _execute(self, job: DdlJob, start_from: int = 0):
+        ctx = DdlContext(self.instance, job.schema)
+        db = self.metadb
+
+        def checkpoint_task(tid, t, state):
+            if db is not None:
+                db.execute(
+                    "UPDATE ddl_engine_task SET state=?, payload_json=? "
+                    "WHERE job_id=? AND task_id=?",
+                    (state, json.dumps(t.payload), job.job_id, tid))
+
+        ctx._checkpoint = lambda: None
+        done: List[int] = list(range(start_from))
+        try:
+            for tid in range(start_from, len(job.tasks)):
+                t = job.tasks[tid]
+                FAIL_POINTS.inject(FP_BEFORE_DDL_TASK, type(t).__name__)
+                ctx._checkpoint = lambda _t=t, _tid=tid: checkpoint_task(
+                    _tid, _t, "RUNNING")
+                t.run(ctx)
+                checkpoint_task(tid, t, "DONE")
+                done.append(tid)
+                FAIL_POINTS.inject(FP_AFTER_DDL_TASK, type(t).__name__)
+            if db is not None:
+                db.execute("UPDATE ddl_engine SET state='DONE', updated=? "
+                           "WHERE job_id=?", (time.time(), job.job_id))
+        except errors.TddlError:
+            # semantic failure: roll back completed tasks in reverse
+            self._rollback(job, ctx, done)
+            raise
+        # crashes (FailPointError etc.) propagate with state left RUNNING: the
+        # recovery path resumes from the last completed task
+
+    def _rollback(self, job: DdlJob, ctx: DdlContext, done: List[int]):
+        for tid in reversed(done):
+            try:
+                job.tasks[tid].undo(ctx)
+            except Exception:
+                pass
+        if self.metadb is not None:
+            self.metadb.execute("UPDATE ddl_engine SET state='ROLLBACK', updated=? "
+                                "WHERE job_id=?", (time.time(), job.job_id))
+
+    def recover(self) -> List[int]:
+        """Resume RUNNING jobs from their last completed task (crash recovery)."""
+        db = self.metadb
+        if db is None:
+            return []
+        resumed = []
+        for job_id, schema, sql in db.query(
+                "SELECT job_id, schema_name, ddl_sql FROM ddl_engine "
+                "WHERE state='RUNNING'"):
+            tasks = []
+            first_pending = 0
+            rows = db.query(
+                "SELECT task_id, name, state, payload_json FROM ddl_engine_task "
+                "WHERE job_id=? ORDER BY task_id", (job_id,))
+            for tid, name, state, payload_json in rows:
+                cls = _TASK_REGISTRY[name]
+                tasks.append(cls(json.loads(payload_json)))
+                if state == "DONE":
+                    first_pending = tid + 1
+            job = DdlJob(schema, sql, tasks)
+            job.job_id = job_id
+            self._execute(job, start_from=first_pending)
+            resumed.append(job_id)
+        return resumed
+
+
+# ---------------------------------------------------------------------------
+# job factories (ddl/job/factory analogs)
+# ---------------------------------------------------------------------------
+
+def alter_table_job(schema: str, sql: str, table: str, actions) -> DdlJob:
+    tasks: List[DdlTask] = [ValidateTableTask({"table": table})]
+    destructive: List[DdlTask] = []
+    for action in actions:
+        kind = action[0]
+        if kind == "add_column":
+            cd, after = action[1], action[2]
+            from galaxysql_tpu.server.session import _ast_literal_value
+            default = None
+            if cd.default is not None:
+                from galaxysql_tpu.sql import ast as A
+                if not isinstance(cd.default, A.NullLit):
+                    default = _ast_literal_value(cd.default)
+            tasks.append(AddColumnTask({
+                "table": table, "name": cd.name,
+                "type": cd.type_name + (" UNSIGNED" if cd.unsigned else ""),
+                "precision": cd.precision, "scale": cd.scale,
+                "nullable": cd.nullable, "default": default, "after": after}))
+        elif kind == "drop_column":
+            destructive.append(DropColumnTask({"table": table, "name": action[1]}))
+        elif kind == "add_index":
+            idx = action[1]
+            tasks.extend(create_index_tasks(table, idx.name or f"i_{idx.columns[0]}",
+                                            idx.columns, idx.unique,
+                                            idx.global_index, idx.covering))
+        elif kind == "drop_index":
+            destructive.append(DropIndexTask({"table": table, "name": action[1]}))
+        elif kind == "rename":
+            destructive.append(RenameTableTask({"table": table,
+                                                "new_name": action[1]}))
+        elif kind == "modify_column":
+            raise errors.NotSupportedError("MODIFY COLUMN not supported yet")
+        else:
+            raise errors.NotSupportedError(f"ALTER action {kind}")
+    # destructive tasks run last so rollback never restores dropped data
+    tasks.extend(destructive)
+    tasks.append(InvalidatePlansTask({}))
+    return DdlJob(schema, sql, tasks)
+
+
+def create_index_tasks(table: str, name: str, columns, unique: bool,
+                       global_index: bool, covering) -> List[DdlTask]:
+    tasks: List[DdlTask] = [AddIndexMetaTask({
+        "table": table, "name": name, "columns": list(columns), "unique": unique,
+        "global": global_index, "covering": list(covering)})]
+    if global_index:
+        tasks.append(CreateGsiTableTask({"table": table, "name": name,
+                                         "columns": list(columns),
+                                         "covering": list(covering)}))
+        tasks.append(UpdateIndexStatusTask({"table": table, "name": name,
+                                            "status": "WRITE_ONLY",
+                                            "prev_status": "CREATING"}))
+        tasks.append(GsiBackfillTask({"table": table, "name": name}))
+    tasks.append(UpdateIndexStatusTask({"table": table, "name": name,
+                                        "status": "PUBLIC",
+                                        "prev_status": "WRITE_ONLY"}))
+    return tasks
+
+
+def create_index_job(schema: str, sql: str, table: str, name: str, columns,
+                     unique: bool, global_index: bool, covering) -> DdlJob:
+    tasks = [ValidateTableTask({"table": table})]
+    tasks += create_index_tasks(table, name, columns, unique, global_index, covering)
+    tasks.append(InvalidatePlansTask({}))
+    return DdlJob(schema, sql, tasks)
+
+
+def drop_index_job(schema: str, sql: str, table: str, name: str) -> DdlJob:
+    return DdlJob(schema, sql, [ValidateTableTask({"table": table}),
+                                DropIndexTask({"table": table, "name": name}),
+                                InvalidatePlansTask({})])

@@ -108,6 +108,9 @@ class Binder:
         self.default_schema = default_schema
         self.params = params or []
         self._ids = itertools.count()
+        # session hooks (sequences, connection id) — set by the caller when available
+        self.sequence_hook = None
+        self.connection_id = None
 
     def fresh(self, prefix: str) -> str:
         return f"{prefix}${next(self._ids)}"
@@ -925,6 +928,14 @@ class Binder:
             return _const_str(self.default_schema)
         if name == "version":
             return _const_str("8.0.3-galaxysql-tpu")
+        if name in ("nextval", "seq_nextval"):
+            if not args or not isinstance(args[0], ir.Literal):
+                raise errors.TddlError("NEXTVAL requires a sequence name literal")
+            seq_name = str(args[0].value)
+            v = self.sequence_hook(seq_name) if self.sequence_hook else 0
+            return ir.lit(int(v))
+        if name == "connection_id":
+            return ir.lit(int(self.connection_id or 0))
         if name == "@@":
             raise errors.NotSupportedError("system variable in expression")
         if name == "length" or name == "char_length":

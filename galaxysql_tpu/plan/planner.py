@@ -105,7 +105,7 @@ class Planner:
         self.cache = PlanCache()
 
     def plan_select(self, sql: str, schema: str,
-                    params: Optional[list] = None) -> ExecutionPlan:
+                    params: Optional[list] = None, session=None) -> ExecutionPlan:
         """Plan a SELECT (or EXPLAIN-able) statement with caching.
 
         The PARAMETERIZED text is what gets parsed, so the cached AST carries `?`
@@ -116,21 +116,31 @@ class Planner:
         p = parameterize(sql)
         key = (schema.lower(), p.cache_key)
         bind_values = p.resolve(params or [])
+        low = sql.lower()
+        if "nextval" in low or "connection_id" in low:
+            # per-execution values (sequences, session identity): never cache; bind
+            # the PARAMETERIZED text so client '?' indexes stay aligned
+            return self.bind_statement(parse(p.parameterized), schema, bind_values,
+                                       session)
         cached = self.cache.get(key, self.catalog.version)
         if cached is not None and cached.param_count == len(bind_values):
             if cached.bound_params == bind_values:
                 return cached
-            plan = self.bind_statement(cached.statement, schema, bind_values)
+            plan = self.bind_statement(cached.statement, schema, bind_values, session)
             self.cache.put(key, plan)
             return plan
         stmt = parse(p.parameterized)
-        plan = self.bind_statement(stmt, schema, bind_values)
+        plan = self.bind_statement(stmt, schema, bind_values, session)
         self.cache.put(key, plan)
         return plan
 
     def bind_statement(self, stmt: ast.Statement, schema: str,
-                       params: list) -> ExecutionPlan:
+                       params: list, session=None) -> ExecutionPlan:
         binder = Binder(self.catalog, schema, params)
+        if session is not None:
+            binder.sequence_hook = \
+                lambda nm: session.instance.sequences.next_value(schema, nm)
+            binder.connection_id = session.conn_id
         if isinstance(stmt, ast.Select):
             rel, names, _ = binder.bind_select(stmt)
         elif isinstance(stmt, ast.SetOpSelect):

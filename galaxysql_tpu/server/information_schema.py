@@ -1,0 +1,134 @@
+"""information_schema virtual tables.
+
+Reference analog: the 104 `InformationSchema*` views + their subhandlers (SURVEY.md
+§2.5 views / §5.5) — the SQL-visible observability surface.  Tables are materialized
+into ordinary stores on demand (refresh before any query touching the schema), so the
+whole query engine (joins, filters, MPP) works over them unmodified.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+from galaxysql_tpu.meta.catalog import ColumnMeta, TableMeta
+from galaxysql_tpu.types import datatype as dt
+
+_V = dt.VARCHAR
+_I = dt.BIGINT
+
+_DEFS: Dict[str, List] = {
+    "schemata": [("catalog_name", _V), ("schema_name", _V),
+                 ("default_character_set_name", _V), ("default_collation_name", _V)],
+    "tables": [("table_catalog", _V), ("table_schema", _V), ("table_name", _V),
+               ("table_type", _V), ("engine", _V), ("table_rows", _I),
+               ("auto_increment", _I), ("table_comment", _V)],
+    "columns": [("table_schema", _V), ("table_name", _V), ("column_name", _V),
+                ("ordinal_position", _I), ("is_nullable", _V), ("data_type", _V),
+                ("column_type", _V), ("column_key", _V), ("extra", _V)],
+    "statistics": [("table_schema", _V), ("table_name", _V), ("index_name", _V),
+                   ("non_unique", _I), ("seq_in_index", _I), ("column_name", _V),
+                   ("index_type", _V), ("index_status", _V)],
+    "partitions": [("table_schema", _V), ("table_name", _V), ("partition_name", _V),
+                   ("partition_method", _V), ("partition_expression", _V),
+                   ("table_rows", _I)],
+    "processlist": [("id", _I), ("user", _V), ("host", _V), ("db", _V),
+                    ("command", _V), ("time", _I), ("state", _V), ("info", _V)],
+    "engines": [("engine", _V), ("support", _V), ("comment", _V)],
+    "global_variables": [("variable_name", _V), ("variable_value", _V)],
+    "session_variables": [("variable_name", _V), ("variable_value", _V)],
+    "ddl_jobs": [("job_id", _I), ("schema_name", _V), ("ddl_sql", _V),
+                 ("state", _V)],
+    "node_info": [("node_id", _V), ("role", _V), ("host", _V), ("port", _I)],
+    "plan_cache": [("schema_name", _V), ("cache_key", _V), ("workload", _V),
+                   ("hit_count", _I)],
+}
+
+
+def ensure_tables(instance):
+    """Create the virtual TableMetas once (idempotent)."""
+    s = instance.catalog.schema("information_schema")
+    for name, cols in _DEFS.items():
+        if name in s.tables:
+            continue
+        tm = TableMeta("information_schema", name,
+                       [ColumnMeta(c, t) for c, t in cols])
+        instance.catalog.add_table(tm, if_not_exists=True)
+        instance.register_table(tm, persist=False)
+
+
+def refresh(instance, session=None):
+    """Re-materialize every information_schema table from live state."""
+    ensure_tables(instance)
+    ts = instance.tso.next_timestamp()
+    cat = instance.catalog
+
+    def fill(name: str, rows):
+        rows = [list(r) for r in rows]
+        store = instance.store("information_schema", name)
+        store.truncate()
+        if rows:
+            names = [c for c, _ in _DEFS[name]]
+            data = {nm: [r[i] for r in rows] for i, nm in enumerate(names)}
+            store.insert_pylists(data, ts)
+        store.table.stats.row_count = store.row_count()
+
+    fill("schemata", (["def", s.name, "utf8mb4", "utf8mb4_general_ci"]
+                      for s in cat.schemas.values()))
+
+    tables, columns, stats, parts = [], [], [], []
+    for s in cat.schemas.values():
+        if s.name == "information_schema":
+            continue
+        for tm in s.tables.values():
+            store = instance.stores.get(instance.store_key(tm.schema, tm.name))
+            nrows = store.row_count() if store else 0
+            tables.append(["def", tm.schema, tm.name, "BASE TABLE", "TPU_COLUMNAR",
+                           nrows, tm.auto_increment_next, tm.comment or ""])
+            for i, c in enumerate(tm.columns, 1):
+                key = "PRI" if c.name in tm.primary_key else ""
+                columns.append([tm.schema, tm.name, c.name, i,
+                                "YES" if c.nullable else "NO",
+                                c.dtype.sql_name().split("(")[0].lower(),
+                                c.dtype.sql_name().lower(), key,
+                                "auto_increment" if c.auto_increment else ""])
+            for seq, c in enumerate(tm.primary_key, 1):
+                stats.append([tm.schema, tm.name, "PRIMARY", 0, seq, c, "LOCAL",
+                              "PUBLIC"])
+            for idx in tm.indexes:
+                for seq, c in enumerate(idx.columns, 1):
+                    stats.append([tm.schema, tm.name, idx.name,
+                                  0 if idx.unique else 1, seq, c,
+                                  "GLOBAL" if idx.global_index else "LOCAL",
+                                  idx.status])
+            p = tm.partition
+            for pid in range(p.num_partitions):
+                pname = (p.boundaries[pid][0] if pid < len(p.boundaries)
+                         else f"p{pid}")
+                prows = store.partitions[pid].num_rows if store else 0
+                parts.append([tm.schema, tm.name, pname, p.method.upper(),
+                              ",".join(p.columns), prows])
+    fill("tables", tables)
+    fill("columns", columns)
+    fill("statistics", stats)
+    fill("partitions", parts)
+
+    now = time.time()
+    fill("processlist", (
+        [sid, getattr(se, "user", "root"), "localhost", se.schema or "", "Sleep",
+         0, "", ""] for sid, se in instance.sessions.items()))
+    fill("engines", [["TPU_COLUMNAR", "DEFAULT",
+                      "device-resident columnar engine"]])
+    reg = instance.config.registry()
+    gv = [[k.lower(), str(instance.config.get(k))] for k in sorted(reg)]
+    fill("global_variables", gv)
+    sv = gv if session is None else \
+        [[k.lower(), str(instance.config.get(k, session.vars))] for k in sorted(reg)]
+    fill("session_variables", sv)
+    fill("ddl_jobs", instance.metadb.query(
+        "SELECT job_id, schema_name, ddl_sql, state FROM ddl_engine"))
+    fill("node_info", instance.metadb.alive_nodes())
+    pc = instance.planner.cache
+    with pc._lock:
+        entries = [[k[0], k[1][:120], p.workload, 0] for k, p in pc._map.items()]
+    fill("plan_cache", entries)

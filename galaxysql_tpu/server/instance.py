@@ -1,48 +1,79 @@
 """Engine instance: the in-process root object (CobarServer/TDataSource analog).
 
-Owns the catalog, table stores, planner, TSO, and config (SURVEY.md §2.2/§3.1 boot
-path).  Sessions (`server/session.py`) hang off an Instance the way ServerConnections
-hang off CobarServer.
+Owns the catalog, table stores, planner, TSO, metadb (GMS), DDL engine, and config
+(SURVEY.md §2.2/§3.1 boot path).  Sessions (`server/session.py`) hang off an Instance
+the way ServerConnections hang off CobarServer.  `boot()` mirrors
+`MatrixConfigHolder.doInit`: load catalog from the metadb, attach stores, reload
+persisted partitions, then resume interrupted DDL jobs (§3.5 crash recovery).
 """
 
 from __future__ import annotations
 
 import os
 import threading
+import uuid
 from typing import Dict, Optional
 
 from galaxysql_tpu.config.params import ConfigParams
 from galaxysql_tpu.meta.catalog import Catalog, TableMeta
+from galaxysql_tpu.meta.gms import ConfigListener, MetaDb
 from galaxysql_tpu.meta.tso import TimestampOracle
 from galaxysql_tpu.plan.planner import Planner
 from galaxysql_tpu.storage.table_store import TableStore
 
 
 class Instance:
-    def __init__(self, data_dir: Optional[str] = None):
+    def __init__(self, data_dir: Optional[str] = None, boot: bool = True):
         self.catalog = Catalog()
         self.stores: Dict[str, TableStore] = {}
         self.planner = Planner(self.catalog)
         self.tso = TimestampOracle()
         self.config = ConfigParams()
         self.data_dir = data_dir
+        self.metadb = MetaDb(os.path.join(data_dir, "metadb.sqlite")
+                             if data_dir else None)
+        self.config_listener = ConfigListener(self.metadb)
+        from galaxysql_tpu.ddl.jobs import DdlEngine
+        self.ddl_engine = DdlEngine(self)
+        from galaxysql_tpu.meta.sequence import SequenceManager
+        self.sequences = SequenceManager(self.metadb)
+        self.node_id = f"cn-{uuid.uuid4().hex[:8]}"
         self.lock = threading.RLock()
-        self.catalog.create_schema("information_schema", if_not_exists=True)
         self.next_conn_id = 1
         self.sessions: Dict[int, object] = {}
+        self.catalog.create_schema("information_schema", if_not_exists=True)
+        if boot:
+            self.boot()
+
+    # -- boot ------------------------------------------------------------------
+
+    def boot(self):
+        """Load persisted metadata + data, then recover interrupted DDL jobs."""
+        loaded = self.metadb.load_catalog(self.catalog)
+        for tm in loaded:
+            store = self.register_table(tm, persist=False)
+            if self.data_dir:
+                d = os.path.join(self.data_dir, tm.schema.lower(), tm.name.lower())
+                if os.path.isdir(d):
+                    store.load(d)
+        self.metadb.heartbeat(self.node_id, "coordinator", "127.0.0.1", 0)
+        self.ddl_engine.recover()
 
     # -- store management ------------------------------------------------------
 
     def store_key(self, schema: str, table: str) -> str:
         return f"{schema.lower()}.{table.lower()}"
 
-    def register_table(self, tm: TableMeta) -> TableStore:
+    def register_table(self, tm: TableMeta, persist: bool = True) -> TableStore:
         store = TableStore(tm)
         self.stores[self.store_key(tm.schema, tm.name)] = store
+        if persist:
+            self.metadb.save_table(tm)
         return store
 
     def drop_store(self, schema: str, table: str):
         self.stores.pop(self.store_key(schema, table), None)
+        self.metadb.drop_table(schema, table)
 
     def store(self, schema: str, table: str) -> TableStore:
         return self.stores[self.store_key(schema, table)]
@@ -50,10 +81,12 @@ class Instance:
     # -- persistence -----------------------------------------------------------
 
     def save(self):
+        """Flush all table data + metadata to disk (checkpoint)."""
         if not self.data_dir:
             return
         for key, store in self.stores.items():
             store.save(os.path.join(self.data_dir, key.replace(".", os.sep)))
+            self.metadb.save_table(store.table)
 
     def allocate_conn_id(self) -> int:
         with self.lock:

@@ -143,6 +143,7 @@ class Session:
             return self._run_truncate(stmt)
         if isinstance(stmt, ast.CreateDatabase):
             self.instance.catalog.create_schema(stmt.name, stmt.if_not_exists)
+            self.instance.metadb.save_schema(stmt.name)
             return ok()
         if isinstance(stmt, ast.DropDatabase):
             self._drop_database(stmt)
@@ -172,10 +173,103 @@ class Session:
             return self._run_analyze(stmt)
         if isinstance(stmt, ast.KillStmt):
             return ok(info="kill acknowledged")
+        if isinstance(stmt, ast.AlterTable):
+            return self._run_alter(stmt, sql)
         if isinstance(stmt, (ast.CreateIndex, ast.DropIndex)):
-            from galaxysql_tpu.ddl.engine import run_index_ddl
-            return run_index_ddl(self, stmt)
+            return self._run_index_ddl(stmt, sql)
         raise errors.NotSupportedError(f"statement {type(stmt).__name__}")
+
+    def _run_alter(self, stmt: ast.AlterTable, sql: str) -> ResultSet:
+        from galaxysql_tpu.ddl.jobs import alter_table_job
+        schema = stmt.table.schema or self._require_schema()
+        self.instance.catalog.table(schema, stmt.table.table)  # validate early
+        job = alter_table_job(schema, sql, stmt.table.table, stmt.actions)
+        self.instance.ddl_engine.submit_and_run(job)
+        return ok()
+
+    def _run_index_ddl(self, stmt, sql: str) -> ResultSet:
+        from galaxysql_tpu.ddl.jobs import create_index_job, drop_index_job
+        schema = stmt.table.schema or self._require_schema()
+        if isinstance(stmt, ast.CreateIndex):
+            idx = stmt.index
+            job = create_index_job(schema, sql,
+                                   stmt.table.table,
+                                   idx.name or f"i_{idx.columns[0]}", idx.columns,
+                                   idx.unique, idx.global_index, idx.covering)
+        else:
+            job = drop_index_job(schema, sql,
+                                 stmt.table.table, stmt.name)
+        self.instance.ddl_engine.submit_and_run(job)
+        return ok()
+
+    # -- GSI write maintenance (online index writers, SURVEY.md App.D) -----------
+
+    def _gsi_targets(self, tm):
+        out = []
+        for i in tm.indexes:
+            if i.global_index and i.status in ("WRITE_ONLY", "PUBLIC"):
+                gsi_name = f"{tm.name}${i.name}"
+                try:
+                    gtm = self.instance.catalog.table(tm.schema, gsi_name)
+                    out.append((i, gtm, self.instance.store(tm.schema, gsi_name)))
+                except (errors.UnknownTableError, KeyError):
+                    pass
+        return out
+
+    def _gsi_write_rows(self, tm, base_store, pid: int, start: int, n: int,
+                        ts: int, txn):
+        """Propagate base rows appended at [start, start+n) into every GSI store.
+
+        Writes carry the same (possibly provisional) timestamp and register with the
+        transaction so COMMIT finalizes and ROLLBACK undoes them with the base rows."""
+        targets = self._gsi_targets(tm)
+        if not targets or n == 0:
+            return
+        p = base_store.partitions[pid]
+        for _i, gtm, gstore in targets:
+            cols = gtm.column_names()
+            lanes = {c: p.lanes[c][start:start + n] for c in cols}
+            valid = {c: p.valid[c][start:start + n] for c in cols}
+            pids = gstore._route(lanes)
+            for gp in np.unique(pids):
+                sel = np.nonzero(pids == gp)[0]
+                gpart = gstore.partitions[int(gp)]
+                before = gpart.num_rows
+                gpart.append({k: v[sel] for k, v in lanes.items()},
+                             {k: v[sel] for k, v in valid.items()}, ts)
+                if txn is not None:
+                    txn.inserted.append((gstore, int(gp), before, sel.size))
+
+    @staticmethod
+    def _pk_void(arrays: List[np.ndarray]) -> np.ndarray:
+        """Pack parallel key arrays into one comparable lane (exact tuple matching —
+        per-column isin would match the cross product of composite keys)."""
+        stacked = np.rec.fromarrays(arrays)
+        return stacked
+
+    def _gsi_delete(self, tm, base_store, pid: int, row_ids: np.ndarray, ts: int,
+                    txn):
+        """Remove the GSI entries of deleted base rows, matched on primary key."""
+        if not tm.primary_key:
+            return
+        targets = self._gsi_targets(tm)
+        if not targets:
+            return
+        p = base_store.partitions[pid]
+        del_keys = self._pk_void([p.lanes[c][row_ids] for c in tm.primary_key])
+        for _i, gtm, gstore in targets:
+            if not all(gtm.has_column(c) for c in tm.primary_key):
+                continue
+            for gp_id, gp in enumerate(gstore.partitions):
+                vis = gp.visible_mask(None)
+                keys = self._pk_void([gp.lanes[c] for c in tm.primary_key])
+                mask = vis & np.isin(keys, del_keys)
+                ids = np.nonzero(mask)[0]
+                if ids.size:
+                    if txn is not None:
+                        txn.deleted.append((gstore, gp_id, ids,
+                                            gp.end_ts[ids].copy()))
+                    gp.delete_rows(ids, ts)
 
     # -- DQL ------------------------------------------------------------------------
 
@@ -192,10 +286,23 @@ class Session:
     def _run_query(self, stmt, sql: str, params: Optional[list]) -> ResultSet:
         schema = self._require_schema()
         t0 = time.time()
+        if "information_schema" in (sql or "").lower() or \
+                schema.lower() == "information_schema":
+            from galaxysql_tpu.server import information_schema
+            information_schema.refresh(self.instance, self)
+        from galaxysql_tpu.utils.ccl import GLOBAL_CCL
+        admission = GLOBAL_CCL.admit(self, sql or "")
+        try:
+            return self._run_query_admitted(stmt, sql, params, schema, t0)
+        finally:
+            admission.release()
+
+    def _run_query_admitted(self, stmt, sql, params, schema, t0) -> ResultSet:
         if sql:
-            plan = self.instance.planner.plan_select(sql, schema, params)
+            plan = self.instance.planner.plan_select(sql, schema, params, self)
         else:
-            plan = self.instance.planner.bind_statement(stmt, schema, params or [])
+            plan = self.instance.planner.bind_statement(stmt, schema, params or [],
+                                                        self)
         cache = None
         if plan.workload == "AP" and self.instance.config.get("ENABLE_TPU_ENGINE",
                                                               self.vars):
@@ -228,8 +335,14 @@ class Session:
                 batch = run_to_batch(op)
         rows = batch.to_pylist()
         fields = plan.fields()
-        self.last_trace = ctx.trace + [f"elapsed={time.time() - t0:.3f}s "
+        elapsed = time.time() - t0
+        self.last_trace = ctx.trace + [f"elapsed={elapsed:.3f}s "
                                        f"workload={plan.workload}"]
+        slow_ms = self.instance.config.get("SLOW_SQL_MS", self.vars)
+        # 0 logs every query (MySQL long_query_time=0); negative disables
+        if slow_ms is not None and slow_ms >= 0 and elapsed * 1000 >= slow_ms:
+            from galaxysql_tpu.utils.tracing import SLOW_LOG
+            SLOW_LOG.record(sql or "<stmt>", elapsed, self.conn_id)
         return ResultSet(plan.display_names, [t for _, t, _ in fields], rows)
 
     # -- DML -------------------------------------------------------------------------
@@ -317,11 +430,13 @@ class Session:
         data = {tm.column(c).name: vals for c, vals in data.items()}
         before_counts = [p.num_rows for p in store.partitions]
         n = store.insert_pylists(data, ts)
-        if txn is not None:
-            for pid, p in enumerate(store.partitions):
-                added = p.num_rows - before_counts[pid]
-                if added:
+        for pid, p in enumerate(store.partitions):
+            added = p.num_rows - before_counts[pid]
+            if added:
+                if txn is not None:
                     txn.inserted.append((store, pid, before_counts[pid], added))
+                self._gsi_write_rows(tm, store, pid, before_counts[pid], added,
+                                     ts, txn)
         tm.bump_version()
         self.instance.catalog.version += 1
         return ok(affected=n)
@@ -346,7 +461,16 @@ class Session:
             if not vis.any():
                 continue
             if pred is None:
-                yield store, pid, np.nonzero(vis)[0]
+                ids0 = np.nonzero(vis)[0]
+                own = -self.txn.txn_id if self.txn is not None else None
+                pend = p.end_ts[ids0]
+                conflict = (pend < 0)
+                if own is not None:
+                    conflict &= (pend != own)
+                if conflict.any():
+                    raise errors.TransactionError(
+                        "write conflict: row locked by a concurrent transaction")
+                yield store, pid, ids0
                 continue
             env = {}
             for c in tm.columns:
@@ -354,6 +478,17 @@ class Session:
             mask = pred(env) & vis
             ids = np.nonzero(mask)[0]
             if ids.size:
+                # first-writer-wins: a row provisionally deleted by ANOTHER live txn
+                # may not be written again (no lock waits -> no deadlocks; the
+                # reference's DeadlockDetectionTask becomes unnecessary by design)
+                own = -self.txn.txn_id if self.txn is not None else None
+                pend = p.end_ts[ids]
+                conflict = (pend < 0)
+                if own is not None:
+                    conflict &= (pend != own)
+                if conflict.any():
+                    raise errors.TransactionError(
+                        "write conflict: row locked by a concurrent transaction")
                 yield store, pid, ids
 
     def _run_delete(self, stmt: ast.Delete, params: Optional[list]) -> ResultSet:
@@ -364,6 +499,7 @@ class Session:
         n = 0
         for store, pid, ids in self._dml_match(tm, stmt.where, params, alias):
             old_end = store.partitions[pid].end_ts[ids].copy()
+            self._gsi_delete(tm, store, pid, ids, ts, txn)
             store.partitions[pid].delete_rows(ids, ts)
             if txn is not None:
                 txn.deleted.append((store, pid, ids, old_end))
@@ -411,11 +547,13 @@ class Session:
                 new_lanes[cm.name] = d
                 new_valid[cm.name] = vm.copy()
             old_end = p.end_ts[ids].copy()
+            self._gsi_delete(tm, store, pid, ids, ts, txn)
             start = p.num_rows
             p.update_rows(ids, new_lanes, new_valid, ts)
             if txn is not None:
                 txn.deleted.append((store, pid, ids, old_end))
                 txn.inserted.append((store, pid, start, ids.size))
+            self._gsi_write_rows(tm, store, pid, start, ids.size, ts, txn)
             n += ids.size
         tm.bump_version()
         self.instance.catalog.version += 1
@@ -453,6 +591,8 @@ class Session:
         added = self.instance.catalog.add_table(tm, stmt.if_not_exists)
         if added:
             self.instance.register_table(tm)
+            self.instance.metadb.save_schema(schema)
+            self.instance.metadb.notify(f"table.{schema}.{tm.name}")
         return ok()
 
     def _run_drop_table(self, stmt: ast.DropTable) -> ResultSet:
@@ -478,6 +618,7 @@ class Session:
             for t in list(cat.schemas[key].tables.values()):
                 self.instance.drop_store(t.schema, t.name)
         cat.drop_schema(stmt.name, stmt.if_exists)
+        self.instance.metadb.drop_schema(stmt.name)
         if self.schema and self.schema.lower() == key:
             self.schema = None
 

@@ -106,6 +106,33 @@ def handle(session, stmt: ast.Show):
                           "Column_name", "Index_type", "Status"],
                          [dt.VARCHAR, dt.BIGINT, dt.VARCHAR, dt.BIGINT, dt.VARCHAR,
                           dt.VARCHAR, dt.VARCHAR], rows)
+    if kind == "slow":
+        from galaxysql_tpu.utils.tracing import SLOW_LOG
+        rows = [(e.conn_id, round(e.elapsed_s * 1000, 1), e.sql)
+                for e in SLOW_LOG.entries()]
+        return ResultSet(["Conn", "Elapsed_ms", "SQL"],
+                         [dt.BIGINT, dt.DOUBLE, dt.VARCHAR], rows)
+    if kind == "ccl_rules":
+        from galaxysql_tpu.utils.ccl import GLOBAL_CCL
+        rows = []
+        for st in GLOBAL_CCL.rules():
+            r = st.rule
+            rows.append((r.name, r.max_concurrency, r.keyword or "", r.user or "",
+                         st.running, st.waiting, st.total_matched, st.total_rejected))
+        return ResultSet(["Rule", "Max_concurrency", "Keyword", "User", "Running",
+                          "Waiting", "Matched", "Rejected"],
+                         [dt.VARCHAR, dt.BIGINT, dt.VARCHAR, dt.VARCHAR, dt.BIGINT,
+                          dt.BIGINT, dt.BIGINT, dt.BIGINT], rows)
+    if kind == "stats":
+        from galaxysql_tpu.utils.tracing import GLOBAL_STATS
+        return ResultSet(["Name", "Value"], [dt.VARCHAR, dt.BIGINT],
+                         GLOBAL_STATS.snapshot())
+    if kind == "ddl":
+        rows = inst.metadb.query(
+            "SELECT job_id, schema_name, state, ddl_sql FROM ddl_engine "
+            "ORDER BY job_id DESC LIMIT 50")
+        return ResultSet(["Job_id", "Schema", "State", "SQL"],
+                         [dt.BIGINT, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR], rows)
     if kind == "warnings":
         return ResultSet(["Level", "Code", "Message"],
                          [dt.VARCHAR, dt.BIGINT, dt.VARCHAR], [])

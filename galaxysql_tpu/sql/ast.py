@@ -319,6 +319,15 @@ class CreateTable(Statement):
 
 
 @dataclasses.dataclass
+class AlterTable(Statement):
+    table: TableName
+    # actions: ("add_column", ColumnDef, after|None) | ("drop_column", name)
+    #        | ("add_index", IndexDef) | ("drop_index", name) | ("rename", new_name)
+    #        | ("modify_column", ColumnDef)
+    actions: List[Tuple] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
 class DropTable(Statement):
     names: List[TableName]
     if_exists: bool = False

@@ -109,6 +109,8 @@ class Parser:
             return self._delete()
         if t.is_kw("CREATE"):
             return self._create()
+        if t.is_kw("ALTER"):
+            return self._alter()
         if t.is_kw("DROP"):
             return self._drop()
         if t.is_kw("TRUNCATE"):
@@ -973,6 +975,65 @@ class Parser:
                     break
             self.expect_op(")")
         return pd
+
+    def _alter(self) -> ast.Statement:
+        self.expect_kw("ALTER")
+        self.expect_kw("TABLE")
+        table = self._table_name()
+        stmt = ast.AlterTable(table)
+        while True:
+            if self.accept_kw("ADD"):
+                if self.at_kw("COLUMN"):
+                    self.next()
+                    cd = self._column_def()
+                    after = None
+                    if self.accept_kw("AFTER"):
+                        after = self.expect_ident()
+                    elif self.accept_kw("FIRST"):
+                        after = ""  # sentinel: place first
+                    stmt.actions.append(("add_column", cd, after))
+                elif self.at_kw("INDEX", "KEY", "UNIQUE", "GLOBAL"):
+                    idx = self._table_index_def()
+                    stmt.actions.append(("add_index", idx))
+                elif self.accept_kw("PRIMARY"):
+                    self.expect_kw("KEY")
+                    self.expect_op("(")
+                    cols = [self.expect_ident()]
+                    while self.accept_op(","):
+                        cols.append(self.expect_ident())
+                    self.expect_op(")")
+                    stmt.actions.append(("add_primary", cols))
+                else:
+                    cd = self._column_def()
+                    after = None
+                    if self.accept_kw("AFTER"):
+                        after = self.expect_ident()
+                    elif self.accept_kw("FIRST"):
+                        after = ""
+                    stmt.actions.append(("add_column", cd, after))
+            elif self.accept_kw("DROP"):
+                if self.at_kw("COLUMN"):
+                    self.next()
+                    stmt.actions.append(("drop_column", self.expect_ident()))
+                elif self.at_kw("INDEX", "KEY"):
+                    self.next()
+                    stmt.actions.append(("drop_index", self.expect_ident()))
+                elif self.accept_kw("PRIMARY"):
+                    self.expect_kw("KEY")
+                    stmt.actions.append(("drop_primary",))
+                else:
+                    stmt.actions.append(("drop_column", self.expect_ident()))
+            elif self.accept_kw("MODIFY"):
+                self.accept_kw("COLUMN")
+                stmt.actions.append(("modify_column", self._column_def()))
+            elif self.accept_kw("RENAME"):
+                self.accept_kw("TO")
+                stmt.actions.append(("rename", self._table_name().table))
+            else:
+                raise self.error("unsupported ALTER TABLE action")
+            if not self.accept_op(","):
+                break
+        return stmt
 
     def _drop(self) -> ast.Statement:
         self.expect_kw("DROP")

@@ -1,0 +1,61 @@
+"""Fail-point injection framework.
+
+Reference analog: `executor/utils/failpoint/FailPoint.java:63-111` (SURVEY.md §4) —
+no-op unless a key is armed (there via session vars `set @FP_X=...`); used by DDL
+crash-recovery tests to kill execution between tasks.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+FP_RANDOM_CRASH = "FP_RANDOM_CRASH"
+FP_BEFORE_DDL_TASK = "FP_BEFORE_DDL_TASK"
+FP_AFTER_DDL_TASK = "FP_AFTER_DDL_TASK"
+FP_BEFORE_COMMIT = "FP_BEFORE_COMMIT"
+FP_BACKFILL_PAUSE = "FP_BACKFILL_PAUSE"
+
+
+class FailPointError(RuntimeError):
+    """Raised by an armed fail point (simulated crash)."""
+
+
+class _FailPoints:
+    def __init__(self):
+        self._armed: Dict[str, Any] = {}
+        self._hits: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def arm(self, key: str, value: Any = True):
+        with self._lock:
+            self._armed[key] = value
+            self._hits[key] = 0
+
+    def disarm(self, key: str):
+        with self._lock:
+            self._armed.pop(key, None)
+
+    def clear(self):
+        with self._lock:
+            self._armed.clear()
+            self._hits.clear()
+
+    def value(self, key: str) -> Optional[Any]:
+        with self._lock:
+            return self._armed.get(key)
+
+    def inject(self, key: str, detail: str = ""):
+        """Raise FailPointError if `key` is armed.  Armed value semantics:
+        True -> fire always; int n -> fire on the n-th hit (1-based)."""
+        with self._lock:
+            v = self._armed.get(key)
+            if v is None:
+                return
+            self._hits[key] = self._hits.get(key, 0) + 1
+            hits = self._hits[key]
+        if v is True or (isinstance(v, int) and hits == v):
+            raise FailPointError(f"failpoint {key} fired ({detail})")
+
+
+FAIL_POINTS = _FailPoints()

@@ -1,0 +1,70 @@
+"""Tracing / profiling: slow-SQL recorder + per-query runtime statistics.
+
+Reference analog: SURVEY.md §5.1 — `SQLRecorder` (slow-SQL ring), `SQLTracer`
+(SHOW TRACE, held per session as `last_trace`), and `RuntimeStatistics` counters
+surfaced via EXPLAIN ANALYZE and SHOW FULL STATS.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Deque, List, Tuple
+
+
+@dataclasses.dataclass
+class SlowEntry:
+    sql: str
+    elapsed_s: float
+    conn_id: int
+    at: float
+
+
+class SlowLog:
+    """Bounded ring of slow queries (SQLRecorder analog)."""
+
+    def __init__(self, capacity: int = 256):
+        self._ring: Deque[SlowEntry] = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, sql: str, elapsed_s: float, conn_id: int):
+        with self._lock:
+            self._ring.append(SlowEntry(sql[:512], elapsed_s, conn_id, time.time()))
+
+    def entries(self) -> List[SlowEntry]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+
+
+SLOW_LOG = SlowLog()
+
+
+class MatrixStatistics:
+    """Instance-level counters (SHOW @@stats analog, §5.5)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.queries = 0
+        self.dml = 0
+        self.errors = 0
+        self.slow = 0
+        self.active_connections = 0
+
+    def bump(self, field: str, n: int = 1):
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def snapshot(self) -> List[Tuple[str, int]]:
+        with self._lock:
+            return [("queries", self.queries), ("dml", self.dml),
+                    ("errors", self.errors), ("slow", self.slow),
+                    ("active_connections", self.active_connections)]
+
+
+GLOBAL_STATS = MatrixStatistics()

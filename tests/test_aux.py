@@ -1,0 +1,178 @@
+"""Aux subsystems: information_schema, sequences, CCL, slow log, write conflicts."""
+
+import threading
+
+import pytest
+
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.utils import errors
+from galaxysql_tpu.utils.ccl import GLOBAL_CCL, CclRule
+
+
+@pytest.fixture()
+def session():
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE a")
+    s.execute("USE a")
+    yield s
+    GLOBAL_CCL.clear()
+    s.close()
+
+
+class TestInformationSchema:
+    def test_tables_and_columns(self, session):
+        session.execute("CREATE TABLE t1 (id BIGINT PRIMARY KEY, v VARCHAR(10)) "
+                        "PARTITION BY HASH(id) PARTITIONS 4")
+        session.execute("INSERT INTO t1 VALUES (1, 'x'), (2, 'y')")
+        r = session.execute(
+            "SELECT table_name, table_rows FROM information_schema.tables "
+            "WHERE table_schema = 'a'")
+        assert ("t1", 2) in r.rows
+        r = session.execute(
+            "SELECT column_name, column_key FROM information_schema.columns "
+            "WHERE table_name = 't1' ORDER BY ordinal_position")
+        assert r.rows == [("id", "PRI"), ("v", "")]
+
+    def test_partitions_and_statistics(self, session):
+        session.execute("CREATE TABLE t2 (id BIGINT) PARTITION BY HASH(id) "
+                        "PARTITIONS 4")
+        session.execute("CREATE INDEX i2 ON t2 (id)")
+        r = session.execute(
+            "SELECT count(*) FROM information_schema.partitions "
+            "WHERE table_name = 't2'")
+        assert r.rows == [(4,)]
+        r = session.execute(
+            "SELECT index_name, index_status FROM information_schema.statistics "
+            "WHERE table_name = 't2'")
+        assert ("i2", "PUBLIC") in r.rows
+
+    def test_processlist_and_joinable(self, session):
+        # info-schema tables are real tables: joins work over them
+        r = session.execute(
+            "SELECT s.schema_name FROM information_schema.schemata s "
+            "JOIN information_schema.schemata s2 "
+            "ON s.schema_name = s2.schema_name WHERE s.schema_name = 'a'")
+        assert r.rows == [("a",)]
+
+
+class TestSequences:
+    def test_nextval_monotonic(self, session):
+        a = session.execute("SELECT NEXTVAL('s1') AS v").rows[0][0]
+        b = session.execute("SELECT NEXTVAL('s1') AS v").rows[0][0]
+        c = session.execute("SELECT NEXTVAL('s2') AS v").rows[0][0]
+        assert b > a
+        assert c == 1  # independent sequence
+
+    def test_range_grab_survives_restart(self, tmp_path):
+        d = str(tmp_path / "data")
+        inst = Instance(data_dir=d)
+        s = Session(inst)
+        s.execute("CREATE DATABASE sq")
+        s.execute("USE sq")
+        v1 = s.execute("SELECT NEXTVAL('k')").rows[0][0]
+        s.close()
+        inst2 = Instance(data_dir=d)
+        s2 = Session(inst2, "sq")
+        v2 = s2.execute("SELECT NEXTVAL('k')").rows[0][0]
+        assert v2 > v1  # new range, never reused
+        s2.close()
+
+
+class TestCcl:
+    def test_reject_on_queue_full(self, session):
+        GLOBAL_CCL.add_rule(CclRule("block_t3", max_concurrency=1, keyword="t3",
+                                    wait_queue_size=0, wait_timeout_ms=100))
+        session.execute("CREATE TABLE t3 (a BIGINT)")
+        session.execute("INSERT INTO t3 VALUES (1)")
+        # one slot: first query fine
+        assert session.execute("SELECT * FROM t3").rows == [(1,)]
+        # hold the slot manually, then the next query must be rejected (queue size 0)
+        st = GLOBAL_CCL.rules()[0]
+        st.sem.acquire()
+        try:
+            with pytest.raises(errors.CclRejectError):
+                session.execute("SELECT * FROM t3")
+        finally:
+            st.sem.release()
+        r = session.execute("SHOW CCL_RULES")
+        assert r.rows[0][0] == "block_t3" and r.rows[0][7] >= 1  # rejected count
+
+    def test_non_matching_unaffected(self, session):
+        GLOBAL_CCL.add_rule(CclRule("only_bob", max_concurrency=1, user="bob",
+                                    wait_queue_size=0))
+        session.execute("CREATE TABLE t4 (a BIGINT)")
+        assert session.execute("SELECT count(*) FROM t4").rows == [(0,)]
+
+
+class TestSlowLog:
+    def test_slow_query_recorded(self, session):
+        from galaxysql_tpu.utils.tracing import SLOW_LOG
+        SLOW_LOG.clear()
+        session.execute("SET SLOW_SQL_MS = 0")  # everything is slow
+        session.execute("CREATE TABLE t5 (a BIGINT)")
+        session.execute("SELECT * FROM t5")
+        r = session.execute("SHOW SLOW")
+        assert any("t5" in row[2] for row in r.rows)
+
+
+class TestWriteConflict:
+    def test_first_writer_wins(self, session):
+        inst = session.instance
+        session.execute("CREATE TABLE w (id BIGINT, v BIGINT)")
+        session.execute("INSERT INTO w VALUES (1, 10)")
+        s2 = Session(inst, "a")
+        session.execute("BEGIN")
+        session.execute("UPDATE w SET v = 20 WHERE id = 1")
+        # a second transaction touching the same row must fail fast (no deadlock
+        # possible by design)
+        s2.execute("BEGIN")
+        with pytest.raises(errors.TransactionError):
+            s2.execute("DELETE FROM w WHERE id = 1")
+        s2.execute("ROLLBACK")
+        session.execute("COMMIT")
+        assert session.execute("SELECT v FROM w WHERE id = 1").rows == [(20,)]
+        s2.close()
+
+
+class TestGsiTxn:
+    def test_gsi_rollback_and_commit(self, session):
+        inst = session.instance
+        session.execute("CREATE TABLE gt (id BIGINT PRIMARY KEY, k BIGINT) "
+                        "PARTITION BY HASH(id) PARTITIONS 2")
+        session.execute("INSERT INTO gt VALUES (1, 10), (2, 20)")
+        session.execute("CREATE GLOBAL INDEX gk ON gt (k)")
+        gstore = inst.store("a", "gt$gk")
+        assert gstore.row_count() == 2
+        # rollback: inserted GSI rows vanish, deleted ones return
+        session.execute("BEGIN")
+        session.execute("INSERT INTO gt VALUES (3, 30)")
+        session.execute("DELETE FROM gt WHERE id = 1")
+        session.execute("ROLLBACK")
+        assert gstore.row_count() == 2
+        # commit: visible to other sessions
+        session.execute("BEGIN")
+        session.execute("UPDATE gt SET k = 99 WHERE id = 2")
+        session.execute("COMMIT")
+        s2 = Session(inst, "a")
+        ts = inst.tso.next_timestamp()
+        vals = []
+        for p in gstore.partitions:
+            vis = p.visible_mask(ts)
+            vals += p.lanes["k"][vis].tolist()
+        assert sorted(vals) == [10, 99]
+        s2.close()
+
+    def test_composite_pk_no_cross_product(self, session):
+        inst = session.instance
+        session.execute("CREATE TABLE cp (a BIGINT, b BIGINT, v BIGINT, "
+                        "PRIMARY KEY (a, b)) PARTITION BY HASH(a) PARTITIONS 2")
+        session.execute("INSERT INTO cp VALUES (1,2,0), (3,4,0), (1,4,0), (3,2,0)")
+        session.execute("CREATE GLOBAL INDEX gv ON cp (v)")
+        gstore = inst.store("a", "cp$gv")
+        assert gstore.row_count() == 4
+        session.execute("DELETE FROM cp WHERE a = 1 AND b = 2")
+        session.execute("DELETE FROM cp WHERE a = 3 AND b = 4")
+        # (1,4) and (3,2) must SURVIVE in the GSI (cross-product bug regression)
+        assert gstore.row_count() == 2
