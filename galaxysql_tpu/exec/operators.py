@@ -479,6 +479,39 @@ class HashJoinOp(Operator):
             return jax.jit(run)
         return global_jit(key, build_fn)
 
+    BLOOM_MAX_BUILD = 1 << 20
+
+    def _build_bloom(self, build_batch: ColumnBatch, pf):
+        """Host-built bloom over the build key; probe batches filter on device."""
+        from galaxysql_tpu import native
+        n_build = build_batch.num_live()
+        if n_build == 0 or n_build > self.BLOOM_MAX_BUILD:
+            return None
+        be = self.build_keys[0]
+        benv = {n: (c.np_data(), None if c.valid is None else c.np_valid())
+                for n, c in build_batch.columns.items()}
+        d, v = ExprCompiler(np).compile(be)(benv)
+        live = build_batch.np_live()
+        if v is not None:
+            live = live & v
+        keys = np.asarray(d)[live].astype(np.int64)
+        nwords = 1
+        while nwords < max(2 * keys.size // 8, 64):  # ~16 bits/key
+            nwords *= 2
+        words = native.bloom_build(keys, nwords)
+        words_dev = jnp.asarray(words)
+
+        def apply(batch: ColumnBatch) -> ColumnBatch:
+            env = batch_env(batch)
+            pd, pv = pf(env)
+            hit = K.bloom_query_device(pd.astype(jnp.int64), words_dev)
+            live2 = batch.live_mask() & hit
+            if pv is not None:
+                # NULL keys never match an inner/semi join anyway
+                live2 = live2 & pv
+            return ColumnBatch(batch.columns, live2)
+        return apply
+
     @staticmethod
     def _gather(batch: ColumnBatch, idx, live) -> Dict[str, Column]:
         cols = {}
@@ -511,7 +544,19 @@ class HashJoinOp(Operator):
         residual_pred = (ExprCompiler(jnp).compile_predicate(self.residual)
                          if self.residual is not None else None)
 
+        # runtime bloom filter (reference: RuntimeFilterBuilderExec -> scan pushdown,
+        # SURVEY.md §2.7): for inner/semi joins with one key, probe rows that cannot
+        # match are masked out before pair enumeration.  Bloom-negative rows are
+        # provably unmatched, so semantics are exact for inner/semi; left/anti must
+        # keep unmatched rows and skip the filter.
+        bloom_filter = None
+        if self.join_type in ("inner", "semi") and len(self.build_keys) == 1:
+            _, pk = self._key_compilers()
+            bloom_filter = self._build_bloom(build_batch, pk[0])
+
         for pb in self.probe.batches():
+            if bloom_filter is not None:
+                pb = bloom_filter(pb)
             n_live = pb.num_live()
             cap = bucket_capacity(max(n_live * 2, MIN_BUCKET))
             while True:

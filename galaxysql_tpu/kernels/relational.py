@@ -292,6 +292,19 @@ def probe_matched_from(pair_live: Any, starts: Any, offsets: Any) -> Any:
     return (c[e] - c[s]) > 0
 
 
+def bloom_query_device(keys: Any, words: Any) -> Any:
+    """Device-side bloom membership test; bit layout matches the native builder
+    (galaxystore gx_bloom_build) and this module's _mix64."""
+    h = _mix64(keys.astype(jnp.uint64))
+    nwords = words.shape[0]
+    m = jnp.uint64(nwords - 1)
+    w1 = words[((h >> jnp.uint64(6)) & m).astype(jnp.int32)]
+    w2 = words[((h >> jnp.uint64(38)) & m).astype(jnp.int32)]
+    hit1 = (w1 >> (h & jnp.uint64(63))) & jnp.uint64(1)
+    hit2 = (w2 >> ((h >> jnp.uint64(32)) & jnp.uint64(63))) & jnp.uint64(1)
+    return (hit1 & hit2).astype(jnp.bool_)
+
+
 # ---------------------------------------------------------------------------
 # sort / topn
 # ---------------------------------------------------------------------------

@@ -212,10 +212,10 @@ def _mix64_np(h: np.ndarray) -> np.ndarray:
 
 def hash_partition_of(values: np.ndarray, count: int) -> np.ndarray:
     """Shard id per value — the same mix the device kernels use, so shard-local data
-    stays consistent with device-side repartitioning."""
-    with np.errstate(over="ignore"):
-        h = _mix64_np(values.astype(np.int64).astype(np.uint64))
-    return (h % np.uint64(count)).astype(np.int32)
+    stays consistent with device-side repartitioning.  Routed through the native
+    runtime (libgalaxystore) when available."""
+    from galaxysql_tpu import native
+    return native.hash_partition(np.asarray(values).astype(np.int64), count)
 
 
 def encode_partition_value(v: Any, typ: dt.DataType) -> Any:

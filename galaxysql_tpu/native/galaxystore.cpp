@@ -1,0 +1,131 @@
+// Native storage runtime: host-side hot paths of the DN-analog store.
+//
+// Reference analog: the galaxyengine DN is C++ (SURVEY.md 2.9); the CN-side runtime
+// here keeps the accelerator path in XLA and moves the storage shim's per-row host
+// loops (hash routing, MVCC visibility, compaction, bloom filters, checksums) into
+// native code.  Exposed as a C ABI consumed via ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+extern "C" {
+
+// splitmix64-style finalizer -- MUST match kernels/relational.py::_mix64 and
+// meta/catalog.py::_mix64_np so host routing and device repartitioning agree.
+static inline uint64_t mix64(uint64_t h) {
+    h ^= h >> 33;
+    h *= 0xff51afd7ed558ccdULL;
+    h ^= h >> 33;
+    h *= 0xc4ceb9fe1a85ec53ULL;
+    h ^= h >> 33;
+    return h;
+}
+
+// shard id per key: mix64(key) % nparts
+void gx_hash_partition(const int64_t* keys, int32_t* out, size_t n, int32_t nparts) {
+    const uint64_t m = (uint64_t)nparts;
+    for (size_t i = 0; i < n; i++) {
+        out[i] = (int32_t)(mix64((uint64_t)keys[i]) % m);
+    }
+}
+
+// MVCC visibility: begin/end timestamp lanes, negative = uncommitted (-txn_id)
+void gx_visible_mask(const int64_t* begin_ts, const int64_t* end_ts, uint8_t* out,
+                     size_t n, int64_t snapshot_ts, int64_t txn_id) {
+    const int64_t own = -txn_id;
+    for (size_t i = 0; i < n; i++) {
+        const int64_t b = begin_ts[i], e = end_ts[i];
+        bool ins = (b >= 0 && b <= snapshot_ts) || (txn_id != 0 && b == own);
+        bool del = (e >= 0 && e <= snapshot_ts) || (txn_id != 0 && e == own);
+        out[i] = (uint8_t)(ins && !del);
+    }
+}
+
+// ---- bloom filter (runtime-filter plane; reference operator/util/bloomfilter) ----
+// Standard 2-probe blocked layout: bits array of u64 words, nwords power of two.
+
+void gx_bloom_build(const int64_t* keys, size_t n, uint64_t* words, size_t nwords) {
+    const uint64_t mask = (uint64_t)nwords - 1;
+    for (size_t i = 0; i < n; i++) {
+        uint64_t h = mix64((uint64_t)keys[i]);
+        uint64_t w1 = (h >> 6) & mask;
+        uint64_t w2 = (h >> 38) & mask;
+        words[w1] |= 1ULL << (h & 63);
+        words[w2] |= 1ULL << ((h >> 32) & 63);
+    }
+}
+
+void gx_bloom_query(const int64_t* keys, size_t n, const uint64_t* words,
+                    size_t nwords, uint8_t* out) {
+    const uint64_t mask = (uint64_t)nwords - 1;
+    for (size_t i = 0; i < n; i++) {
+        uint64_t h = mix64((uint64_t)keys[i]);
+        uint64_t w1 = (h >> 6) & mask;
+        uint64_t w2 = (h >> 38) & mask;
+        bool hit = (words[w1] >> (h & 63)) & 1ULL;
+        hit = hit && ((words[w2] >> ((h >> 32) & 63)) & 1ULL);
+        out[i] = (uint8_t)hit;
+    }
+}
+
+// ---- page checksum (persistence integrity; crc32c, software table) ----
+
+static uint32_t crc_table[256];
+static bool crc_init_done = false;
+
+static void crc_init() {
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+        crc_table[i] = c;
+    }
+    crc_init_done = true;
+}
+
+uint32_t gx_crc32c(const uint8_t* data, size_t n, uint32_t seed) {
+    if (!crc_init_done) crc_init();
+    uint32_t c = seed ^ 0xFFFFFFFFu;
+    for (size_t i = 0; i < n; i++)
+        c = crc_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+// ---- delta + zigzag varint codec for int64 lanes (cold persistence pages) ----
+
+static inline uint64_t zigzag(int64_t v) { return ((uint64_t)v << 1) ^ (uint64_t)(v >> 63); }
+static inline int64_t unzigzag(uint64_t v) { return (int64_t)(v >> 1) ^ -(int64_t)(v & 1); }
+
+// dst must have room for 10*n bytes; returns encoded size
+size_t gx_encode_i64(const int64_t* src, size_t n, uint8_t* dst) {
+    size_t o = 0;
+    int64_t prev = 0;
+    for (size_t i = 0; i < n; i++) {
+        uint64_t v = zigzag(src[i] - prev);
+        prev = src[i];
+        while (v >= 0x80) { dst[o++] = (uint8_t)(v | 0x80); v >>= 7; }
+        dst[o++] = (uint8_t)v;
+    }
+    return o;
+}
+
+size_t gx_decode_i64(const uint8_t* src, size_t nbytes, int64_t* dst, size_t n) {
+    size_t o = 0, i = 0;
+    int64_t prev = 0;
+    while (i < n && o < nbytes) {
+        uint64_t v = 0;
+        int shift = 0;
+        while (o < nbytes) {
+            uint8_t b = src[o++];
+            v |= (uint64_t)(b & 0x7F) << shift;
+            if (!(b & 0x80)) break;
+            shift += 7;
+        }
+        prev += unzigzag(v);
+        dst[i++] = prev;
+    }
+    return i;
+}
+
+}  // extern "C"

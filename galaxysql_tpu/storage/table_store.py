@@ -63,21 +63,10 @@ class Partition:
     def visible_mask(self, snapshot_ts: Optional[int], txn_id: int = 0) -> np.ndarray:
         """MVCC visibility.  Uncommitted changes carry NEGATIVE timestamps (-txn_id):
         visible only to the owning transaction; finalized to real TSO values at commit
-        (the in-process analog of the reference's innodb snapshot_seq/commit_seq dance,
-        SURVEY.md §3.4)."""
-        b, e = self.begin_ts, self.end_ts
-        if snapshot_ts is None:
-            inserted_ok = b >= 0
-            deleted = e != INFINITY_TS
-        else:
-            inserted_ok = (b >= 0) & (b <= snapshot_ts)
-            deleted = (e >= 0) & (e <= snapshot_ts)
-        if txn_id:
-            inserted_ok = inserted_ok | (b == -txn_id)
-            deleted = deleted | (e == -txn_id)
-        else:
-            deleted = deleted  # others treat pending deletes (-id) as still visible
-        return inserted_ok & ~deleted
+        (the in-process analog of the reference's innodb snapshot_seq/commit_seq
+        dance, SURVEY.md §3.4).  Computed by the native runtime when available."""
+        from galaxysql_tpu import native
+        return native.visible_mask(self.begin_ts, self.end_ts, snapshot_ts, txn_id)
 
     def delete_rows(self, row_ids: np.ndarray, commit_ts: int):
         with self.lock:
