@@ -740,3 +740,115 @@ class DistinctOp(HashAggOp):
 def run_to_batch(op: Operator) -> ColumnBatch:
     """Drain an operator tree into a single compacted host batch."""
     return concat_batches(list(op.batches()))
+
+
+class WindowOp(Operator):
+    """Window functions: materialize, sort by (partition, order), scan-based frames.
+
+    Output rows come back in window-sort order (SQL imposes no order without an outer
+    ORDER BY); all payload columns are gathered through the same permutation."""
+
+    def __init__(self, child: Operator, partitions, orders, calls,
+                 out_schema=None):
+        self.child = child
+        self.partitions = list(partitions)   # [ir.Expr]
+        self.orders = list(orders)           # [(ir.Expr, desc)]
+        self.calls = list(calls)             # [L.WindowCall]
+        # [(id, DataType, Dictionary)] — needed to shape EMPTY results
+        self.out_schema = out_schema
+
+    def _specs(self):
+        inputs: List[ir.Expr] = []
+        index: Dict[Tuple, int] = {}
+
+        def arg_ix(e):
+            k = expr_cache_key(e)
+            if k not in index:
+                index[k] = len(inputs)
+                inputs.append(e)
+            return index[k]
+
+        lanes = []  # (lane_name, WindowSpec)
+        for c in self.calls:
+            frame = c.frame
+            if c.kind in ("row_number", "rank", "dense_rank"):
+                lanes.append((c.out_id, K.WindowSpec(c.kind, -1, 0, frame)))
+            elif c.kind == "avg":
+                ix = arg_ix(c.arg)
+                lanes.append((c.out_id + "$sum", K.WindowSpec("sum", ix, 0, frame)))
+                lanes.append((c.out_id + "$cnt", K.WindowSpec("count", ix, 0, frame)))
+            else:
+                lanes.append((c.out_id,
+                              K.WindowSpec(c.kind, arg_ix(c.arg), c.offset, frame)))
+        return inputs, lanes
+
+    def batches(self) -> Iterator[ColumnBatch]:
+        merged = concat_batches(list(self.child.batches()))
+        if merged.capacity == 0:
+            cols = dict(merged.columns)
+            for fid, typ, dic in (self.out_schema or []):
+                if fid not in cols:
+                    cols[fid] = Column(np.zeros(0, dtype=typ.lane), None, typ, dic)
+            yield ColumnBatch(cols, None)
+            return
+        padded = merged.pad_to(bucket_capacity(merged.capacity))
+        inputs, lanes = self._specs()
+        specs = tuple(s for _, s in lanes)
+        key = ("window",
+               tuple(expr_cache_key(p) for p in self.partitions),
+               tuple((expr_cache_key(e), d) for e, d in self.orders),
+               tuple(expr_cache_key(e) for e in inputs), specs)
+
+        def build():
+            comp = ExprCompiler(jnp)
+            pfns = [comp.compile(p) for p in self.partitions]
+            ofns = [(comp.compile(e), d) for e, d in self.orders]
+            ifns = [comp.compile(e) for e in inputs]
+
+            def run(batch: ColumnBatch):
+                env = batch_env(batch)
+                n = batch.capacity
+                pk = [broadcast_value(n, *f(env)) for f in pfns]
+                ok = []
+                for f, desc in ofns:
+                    d, v = broadcast_value(n, *f(env))
+                    ok.append((d, v, desc, not desc))
+                ins = [broadcast_value(n, *f(env)) for f in ifns]
+                order, live_s, outs = K.window_eval(pk, ok, ins, specs,
+                                                    batch.live_mask())
+                cols = {}
+                for name, c in batch.columns.items():
+                    cols[name] = Column(c.data[order],
+                                        c.valid[order] if c.valid is not None
+                                        else None, c.dtype, c.dictionary)
+                return cols, live_s, outs
+            return jax.jit(run)
+
+        cols, live_s, outs = global_jit(key, build)(padded)
+        lane_map = {name: outs[i] for i, (name, _) in enumerate(lanes)}
+        for c in self.calls:
+            rt = c.dtype
+            if c.kind == "avg":
+                s, sv = lane_map[c.out_id + "$sum"]
+                cnt, _ = lane_map[c.out_id + "$cnt"]
+                s = np.asarray(s)
+                cnt = np.asarray(cnt)
+                safe = np.where(cnt == 0, 1, cnt)
+                at = c.arg.dtype
+                if rt.clazz == dt.TypeClass.DECIMAL:
+                    shift = rt.scale - (at.scale if at.clazz == dt.TypeClass.DECIMAL
+                                        else 0)
+                    data = _signed_div_round(np, s.astype(np.int64)
+                                             * _pow10(max(shift, 0)), safe)
+                else:
+                    data = (s.astype(np.float64) / safe).astype(np.float32)
+                cols[c.out_id] = Column(jnp.asarray(data), jnp.asarray(cnt > 0),
+                                        rt, None)
+            else:
+                d, v = lane_map[c.out_id]
+                if c.kind == "sum" and rt.clazz == dt.TypeClass.FLOAT:
+                    d = jnp.asarray(np.asarray(d, dtype=np.float32))
+                dic = _find_dictionary(c.arg) if (c.arg is not None and
+                                                  c.arg.dtype.is_string) else None
+                cols[c.out_id] = Column(d, v, rt, dic)
+        yield ColumnBatch(cols, live_s)

@@ -350,3 +350,155 @@ def limit_mask(live: Any, offset: int, count: int) -> Any:
     """LIMIT offset, count over live rows (order = physical order)."""
     rank = jnp.cumsum(live.astype(jnp.int64)) - 1
     return live & (rank >= offset) & (rank < offset + count)
+
+
+# ---------------------------------------------------------------------------
+# window functions
+# ---------------------------------------------------------------------------
+
+class WindowSpec(NamedTuple):
+    kind: str    # row_number | rank | dense_rank | sum | count | min | max |
+                 # lag | lead | first_value | last_value
+    arg: int     # input lane index (-1 for rank-family)
+    offset: int  # lag/lead distance
+    # frame: 'running' (ROWS ..CURRENT), 'range' (RANGE ..CURRENT: ties share the
+    # run-end value), 'whole' (entire partition)
+    frame: str
+
+
+def window_eval(part_keys: Sequence[Tuple[Any, Optional[Any]]],
+                order_keys: Sequence[Tuple[Any, Optional[Any], bool, bool]],
+                inputs: Sequence[Tuple[Any, Optional[Any]]],
+                specs: Sequence[WindowSpec],
+                live: Any):
+    """Evaluate window functions (OverWindowFramesExec analog) scatter-free.
+
+    Rows are sorted by (partition keys, order keys); all computations are cumulative
+    scans + boundary gathers over the contiguous partition/peer runs.  Returns
+    (order permutation, live_sorted, [(data, valid)] per spec) — outputs align to the
+    SORTED order; the operator gathers payload columns with the same permutation."""
+    n = live.shape[0]
+    sort_keys = [(d, v, False, True) for d, v in part_keys] + list(order_keys)
+    order = sort_indices(sort_keys, live)
+    live_s = live[order]
+    arange = jnp.arange(n, dtype=jnp.int64)
+
+    def boundaries(keys):
+        flag = jnp.zeros(n, dtype=jnp.bool_)
+        for d, v in keys:
+            # canonicalize NULLs: the data under an invalid slot is unspecified and
+            # must not split the all-NULLs partition/peer run
+            dc = d if v is None else jnp.where(v, d, jnp.zeros_like(d))
+            d_s = dc[order]
+            flag = flag | jnp.concatenate(
+                [jnp.ones(1, jnp.bool_), d_s[1:] != d_s[:-1]])
+            if v is not None:
+                v_s = v[order]
+                flag = flag | jnp.concatenate(
+                    [jnp.zeros(1, jnp.bool_), v_s[1:] != v_s[:-1]])
+        return flag.at[0].set(True)
+
+    new_part = boundaries(part_keys) if part_keys else \
+        jnp.zeros(n, jnp.bool_).at[0].set(True)
+    new_run = new_part | (boundaries([(d, v) for d, v, _, _ in order_keys])
+                          if order_keys else new_part)
+
+    # per-row partition start / peer-run start (cummax of marked positions)
+    part_start = jax.lax.cummax(jnp.where(new_part, arange, -1))
+    run_start = jax.lax.cummax(jnp.where(new_run, arange, -1))
+    # run/partition END per row: position before the NEXT boundary
+    # dead rows sort to the global end; ends must stop at the last LIVE row or a
+    # whole/range-frame gather would land on a dead padded slot
+    n_live = jnp.sum(live_s.astype(jnp.int64))
+    last_live = jnp.clip(n_live - 1, 0, n - 1)
+    (starts_list,) = jnp.nonzero(new_run, size=n + 1, fill_value=n)
+    run_ix = jnp.cumsum(new_run.astype(jnp.int64)) - 1
+    run_end = jnp.clip(starts_list[jnp.clip(run_ix + 1, 0, n)] - 1, 0, n - 1)
+    run_end = jnp.minimum(run_end, last_live)
+    (pstarts_list,) = jnp.nonzero(new_part, size=n + 1, fill_value=n)
+    part_ix = jnp.cumsum(new_part.astype(jnp.int64)) - 1
+    part_end = jnp.clip(pstarts_list[jnp.clip(part_ix + 1, 0, n)] - 1, 0, n - 1)
+    part_end = jnp.minimum(part_end, last_live)
+
+    out = []
+    for spec in specs:
+        if spec.kind == "row_number":
+            out.append(((arange - part_start + 1).astype(jnp.int64), None))
+            continue
+        if spec.kind == "rank":
+            out.append(((run_start - part_start + 1).astype(jnp.int64), None))
+            continue
+        if spec.kind == "dense_rank":
+            c = jnp.cumsum(new_run.astype(jnp.int64))
+            dr = c - c[jnp.clip(part_start, 0, n - 1)] + 1
+            out.append((dr.astype(jnp.int64), None))
+            continue
+
+        d, v = inputs[spec.arg]
+        d_s = d[order]
+        v_s = v[order] if v is not None else None
+        present = live_s if v_s is None else (live_s & v_s)
+
+        if spec.kind in ("lag", "lead"):
+            idx = arange - spec.offset if spec.kind == "lag" else \
+                arange + spec.offset
+            in_part = (idx >= part_start) & (idx <= part_end)
+            idxc = jnp.clip(idx, 0, n - 1).astype(jnp.int32)
+            data = d_s[idxc]
+            valid = in_part & (present[idxc])
+            out.append((data, valid))
+            continue
+        if spec.kind == "first_value":
+            pos = jnp.clip(part_start, 0, n - 1).astype(jnp.int32)
+            out.append((d_s[pos], present[pos]))
+            continue
+        if spec.kind == "last_value":
+            pos = (run_end if spec.frame == "range" else
+                   part_end if spec.frame == "whole" else arange)
+            pos = jnp.clip(pos, 0, n - 1).astype(jnp.int32)
+            out.append((d_s[pos], present[pos]))
+            continue
+
+        # aggregates over the frame
+        if spec.kind == "count":
+            masked = present.astype(jnp.int64)
+        elif spec.kind == "sum":
+            if jnp.issubdtype(d_s.dtype, jnp.floating):
+                masked = jnp.where(present, d_s, jnp.zeros((), d_s.dtype))
+            else:
+                masked = jnp.where(present, d_s.astype(jnp.int64), 0)
+        elif spec.kind in ("min", "max"):
+            if jnp.issubdtype(d_s.dtype, jnp.floating):
+                neutral = jnp.array(np.inf if spec.kind == "min" else -np.inf,
+                                    d_s.dtype)
+            else:
+                info = jnp.iinfo(d_s.dtype)
+                neutral = jnp.array(info.max if spec.kind == "min" else info.min,
+                                    d_s.dtype)
+            masked = jnp.where(present, d_s, neutral)
+        else:
+            raise ValueError(f"unknown window kind {spec.kind}")
+
+        if spec.kind in ("min", "max"):
+            running = _segmented_scan(masked, new_part, spec.kind == "min")
+            nonempty_run = _segmented_scan(present.astype(jnp.int8), new_part,
+                                           False) > 0
+        else:
+            c = jnp.cumsum(masked)
+            base = jnp.where(part_start > 0,
+                             c[jnp.clip(part_start - 1, 0, n - 1)], 0)
+            running = c - base
+            cp = jnp.cumsum(present.astype(jnp.int64))
+            basep = jnp.where(part_start > 0,
+                              cp[jnp.clip(part_start - 1, 0, n - 1)], 0)
+            nonempty_run = (cp - basep) > 0
+
+        pos = (run_end if spec.frame == "range" else
+               part_end if spec.frame == "whole" else arange)
+        pos = jnp.clip(pos, 0, n - 1).astype(jnp.int32)
+        data = running[pos]
+        if spec.kind == "count":
+            out.append((data, None))
+        else:
+            out.append((data, nonempty_run[pos]))
+    return order, live_s, out

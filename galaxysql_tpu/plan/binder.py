@@ -137,13 +137,23 @@ class Binder:
         display_names: List[str] = []
         out_exprs: List[Tuple[str, ir.Expr]] = []
 
+        # window functions (over the filtered FROM result; not combinable with
+        # GROUP BY in this round)
+        win_rep: Dict[int, ir.Expr] = {}
+        if any(isinstance(n, ast.WindowExpr)
+               for i in sel.items for n in _ast_walk(i.expr)):
+            if has_agg:
+                raise errors.NotSupportedError(
+                    "window functions combined with GROUP BY not supported yet")
+            node, win_rep = self._bind_windows(node, sel, scope)
+
         if has_agg:
             node, out_exprs, display_names = self._bind_aggregate(node, sel, scope)
         else:
             # plain select list
             items = self._expand_stars(sel.items, scope)
             for item in items:
-                e = self._bind_expr(item.expr, scope)
+                e = self._bind_expr(item.expr, scope, dict(win_rep))
                 name = item.alias or self._display_name(item.expr)
                 out_id = name if "." not in name else name.split(".")[-1]
                 out_exprs.append((self.fresh(out_id), e))
@@ -439,6 +449,72 @@ class Binder:
     def _lift_scalar_subqueries(self, node, out_exprs, scope):
         return node, out_exprs  # select-list scalar subqueries: bound via where path later
 
+    # -- window functions ---------------------------------------------------------
+
+    _WINDOW_KINDS = {"row_number", "rank", "dense_rank", "sum", "count", "avg",
+                     "min", "max", "lag", "lead", "first_value", "last_value"}
+
+    def _bind_windows(self, node: L.RelNode, sel: ast.Select, scope: Scope):
+        """One L.Window node per distinct (PARTITION BY, ORDER BY) spec; window
+        expressions in the select list are replaced by output column refs."""
+        groups: Dict[Tuple, Tuple[List, List, List[L.WindowCall]]] = {}
+        rep: Dict[int, ir.Expr] = {}
+        for item in sel.items:
+            for n in _ast_walk(item.expr):
+                if not isinstance(n, ast.WindowExpr):
+                    continue
+                fname = n.func.name
+                if fname not in self._WINDOW_KINDS:
+                    raise errors.NotSupportedError(f"window function {fname}()")
+                parts = [self._bind_expr(p, scope) for p in n.partition_by]
+                orders = [(self._bind_expr(e, scope), desc)
+                          for e, desc in n.order_by]
+                key = (tuple(p.key() for p in parts),
+                       tuple((e.key(), d) for e, d in orders))
+                if key not in groups:
+                    groups[key] = (parts, orders, [])
+                calls = groups[key][2]
+                if n.func.distinct:
+                    raise errors.NotSupportedError(
+                        "DISTINCT in window aggregates is not supported")
+                if n.frame is not None and n.frame[1] == "current":
+                    raise errors.NotSupportedError(
+                        "frames starting at CURRENT ROW are not supported yet")
+                # frame semantics: SQL default with ORDER BY is RANGE ..CURRENT
+                if n.frame is None:
+                    frame = "range" if n.order_by else "whole"
+                elif n.frame[2] == "unbounded_following":
+                    frame = "whole"
+                else:
+                    frame = "running" if n.frame[0] == "rows" else "range"
+                offset = 1
+                arg = None
+                if fname in ("row_number", "rank", "dense_rank"):
+                    if not n.order_by:
+                        raise errors.TddlError(f"{fname}() requires ORDER BY")
+                elif fname == "count" and (n.func.star or not n.func.args):
+                    arg = ir.lit(1)
+                else:
+                    if not n.func.args:
+                        raise errors.TddlError(f"{fname}() needs an argument")
+                    arg = self._bind_expr(n.func.args[0], scope)
+                    if fname in ("lag", "lead") and len(n.func.args) > 1:
+                        off = self._bind_expr(n.func.args[1], scope)
+                        if not isinstance(off, ir.Literal):
+                            raise errors.NotSupportedError(
+                                "lag/lead offset must be a literal")
+                        offset = int(off.value)
+                out_id = self.fresh(fname)
+                call = L.WindowCall(fname, arg, out_id, offset, frame)
+                calls.append(call)
+                rep[id(n)] = ir.ColRef(out_id, call.dtype,
+                                       _find_dictionary(arg) if arg is not None and
+                                       arg.dtype.is_string else None)
+        for parts, orders, calls in groups.values():
+            node = L.Window(node, parts, orders, calls)
+        # window outputs become visible to ORDER BY via the select aliases only
+        return node, rep
+
     # -- aggregation -------------------------------------------------------------
 
     def _contains_agg(self, sel: ast.Select) -> bool:
@@ -446,8 +522,12 @@ class Binder:
         if sel.having is not None:
             exprs.append(sel.having)
         for e in exprs:
+            # sum(x) OVER (...) is a window call, not a grouping aggregate
+            win_funcs = {id(n.func) for n in _ast_walk(e)
+                         if isinstance(n, ast.WindowExpr)}
             for n in _ast_walk(e):
-                if isinstance(n, ast.Func) and n.name in _AGG_FUNCS:
+                if isinstance(n, ast.Func) and n.name in _AGG_FUNCS and \
+                        id(n) not in win_funcs:
                     return True
         return False
 

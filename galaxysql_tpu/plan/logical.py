@@ -170,6 +170,55 @@ class Join(RelNode):
         return f"Join({self.kind}, [{eq}]{res})"
 
 
+@dataclasses.dataclass
+class WindowCall:
+    kind: str                  # row_number|rank|dense_rank|sum|count|avg|min|max|
+                               # lag|lead|first_value|last_value
+    arg: Optional[ir.Expr]
+    out_id: str
+    offset: int = 1            # lag/lead
+    frame: str = "range"       # running | range | whole
+
+    @property
+    def dtype(self) -> dt.DataType:
+        if self.kind in ("row_number", "rank", "dense_rank", "count"):
+            return dt.BIGINT
+        from galaxysql_tpu.exec.operators import AggCall
+        if self.kind in ("sum", "avg", "min", "max"):
+            return AggCall(self.kind, self.arg, self.out_id).dtype
+        return self.arg.dtype  # lag/lead/first/last
+
+
+class Window(RelNode):
+    """Window functions over sorted partitions (OverWindowFramesExec analog, §2.6)."""
+
+    def __init__(self, child: RelNode, partitions: Sequence[ir.Expr],
+                 orders: Sequence[Tuple[ir.Expr, bool]],
+                 calls: Sequence[WindowCall]):
+        self.children = [child]
+        self.partitions = list(partitions)
+        self.orders = list(orders)
+        self.calls = list(calls)
+
+    @property
+    def child(self) -> RelNode:
+        return self.children[0]
+
+    def fields(self) -> List[Field]:
+        from galaxysql_tpu.expr.compiler import _find_dictionary
+        out = list(self.child.fields())
+        for c in self.calls:
+            d = _find_dictionary(c.arg) if (c.arg is not None and
+                                            c.arg.dtype.is_string) else None
+            out.append((c.out_id, c.dtype, d))
+        return out
+
+    def label(self):
+        ps = ",".join(repr(p) for p in self.partitions)
+        cs = ",".join(c.kind for c in self.calls)
+        return f"Window(by=[{ps}], calls=[{cs}])"
+
+
 class Sort(RelNode):
     def __init__(self, child: RelNode, keys: Sequence[Tuple[ir.Expr, bool]],
                  limit: Optional[int] = None, offset: int = 0):

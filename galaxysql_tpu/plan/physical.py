@@ -151,6 +151,9 @@ def build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
         calls = [ops.AggCall(a.kind, a.arg, a.out_id) for a in node.aggs]
         return ops.HashAggOp(build_operator(node.child, ctx),
                              node.groups, calls, max_groups=max_groups)
+    if isinstance(node, L.Window):
+        return ops.WindowOp(build_operator(node.child, ctx), node.partitions,
+                            node.orders, node.calls, out_schema=node.fields())
     if isinstance(node, L.Join):
         return _build_join(node, ctx)
     if isinstance(node, L.Sort):

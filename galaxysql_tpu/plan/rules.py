@@ -329,6 +329,18 @@ def prune_columns(node: L.RelNode, required: Optional[Set[str]] = None) -> L.Rel
             need.update(ir.referenced_columns(e))
         node.children = [prune_columns(node.child, need)]
         return node
+    if isinstance(node, L.Window):
+        need = set(required)
+        for p in node.partitions:
+            need.update(ir.referenced_columns(p))
+        for e, _ in node.orders:
+            need.update(ir.referenced_columns(e))
+        for c in node.calls:
+            if c.arg is not None:
+                need.update(ir.referenced_columns(c.arg))
+        need -= {c.out_id for c in node.calls}
+        node.children = [prune_columns(node.child, need)]
+        return node
     if isinstance(node, (L.Limit,)):
         node.children = [prune_columns(node.child, set(required))]
         return node

@@ -163,6 +163,15 @@ class IsNullExpr(ExprNode):
 
 
 @dataclasses.dataclass
+class WindowExpr(ExprNode):
+    func: "Func"
+    partition_by: List[ExprNode]
+    order_by: List[Tuple[ExprNode, bool]]
+    # (unit 'rows'|'range', start 'unbounded', end 'current'|'unbounded_following')
+    frame: Optional[Tuple[str, str, str]] = None
+
+
+@dataclasses.dataclass
 class ExtractExpr(ExprNode):
     unit: str
     arg: ExprNode

@@ -594,11 +594,11 @@ class Parser:
             name = self.next().text
             self.next()  # (
             if self.accept_op(")"):
-                return ast.Func(name.lower(), [])
+                return self._maybe_over(ast.Func(name.lower(), []))
             if self.at_op("*"):
                 self.next()
                 self.expect_op(")")
-                return ast.Func(name.lower(), [], star=True)
+                return self._maybe_over(ast.Func(name.lower(), [], star=True))
             distinct = self.accept_kw("DISTINCT")
             args = [self._expr()]
             while self.accept_op(","):
@@ -609,7 +609,8 @@ class Parser:
                 if self.accept_kw("FOR"):
                     args.append(self._expr())
             self.expect_op(")")
-            return ast.Func(name.lower(), args, distinct=distinct)
+            f = ast.Func(name.lower(), args, distinct=distinct)
+            return self._maybe_over(f)
 
         # plain (possibly qualified) name
         if self._is_clause_kw(t):
@@ -623,6 +624,43 @@ class Parser:
                 return ast.Star(parts)
             parts.append(self.expect_ident())
         return ast.Name(parts)
+
+    def _maybe_over(self, f: ast.Func) -> ast.ExprNode:
+        if not self.at_kw("OVER"):
+            return f
+        self.next()
+        self.expect_op("(")
+        partition_by = []
+        order_by = []
+        frame = None
+        if self.accept_kw("PARTITION"):
+            self.expect_kw("BY")
+            partition_by.append(self._expr())
+            while self.accept_op(","):
+                partition_by.append(self._expr())
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            order_by = self._order_list()
+        if self.at_kw("ROWS", "RANGE"):
+            unit = self.next().upper.lower()
+            self.expect_kw("BETWEEN")
+            if self.accept_kw("UNBOUNDED"):
+                self.expect_kw("PRECEDING")
+                start = "unbounded"
+            else:
+                self.expect_kw("CURRENT")
+                self.expect_kw("ROW")
+                start = "current"
+            self.expect_kw("AND")
+            if self.accept_kw("UNBOUNDED"):
+                self.expect_kw("FOLLOWING")
+                frame = (unit, start, "unbounded_following")
+            else:
+                self.expect_kw("CURRENT")
+                self.expect_kw("ROW")
+                frame = (unit, start, "current")
+        self.expect_op(")")
+        return ast.WindowExpr(f, partition_by, order_by, frame)
 
     def _case(self) -> ast.ExprNode:
         self.expect_kw("CASE")

@@ -1,0 +1,106 @@
+"""Window functions: differential against sqlite3 + targeted semantics."""
+
+import sqlite3
+
+import pytest
+
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+
+
+@pytest.fixture()
+def env():
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE w; USE w")
+    s.execute("CREATE TABLE sales (region VARCHAR(10), emp BIGINT, amount BIGINT)")
+    rows = [("east", 1, 100), ("east", 2, 200), ("east", 3, 200), ("east", 1, 50),
+            ("west", 4, 300), ("west", 5, 100), ("west", 4, 100), ("north", 6, 10)]
+    s.execute("INSERT INTO sales VALUES " +
+              ", ".join(f"('{r}', {e}, {a})" for r, e, a in rows))
+    db = sqlite3.connect(":memory:")
+    db.execute("CREATE TABLE sales (region TEXT, emp INTEGER, amount INTEGER)")
+    db.executemany("INSERT INTO sales VALUES (?,?,?)", rows)
+    yield s, db
+    s.close()
+    db.close()
+
+
+def same(mine, theirs):
+    a = sorted(tuple(str(x) for x in r) for r in mine)
+    b = sorted(tuple(str(x) for x in r) for r in theirs)
+    assert a == b, f"\nmine:   {a}\nsqlite: {b}"
+
+
+QUERIES = [
+    "SELECT region, amount, row_number() OVER (PARTITION BY region ORDER BY amount) "
+    "AS rn FROM sales",
+    "SELECT region, amount, rank() OVER (PARTITION BY region ORDER BY amount DESC) "
+    "AS r FROM sales",
+    "SELECT region, amount, dense_rank() OVER (PARTITION BY region ORDER BY amount) "
+    "AS dr FROM sales",
+    "SELECT region, amount, sum(amount) OVER (PARTITION BY region ORDER BY amount) "
+    "AS running FROM sales",
+    "SELECT region, amount, sum(amount) OVER (PARTITION BY region) AS total "
+    "FROM sales",
+    "SELECT region, amount, count(*) OVER (PARTITION BY region) AS c FROM sales",
+    "SELECT region, amount, min(amount) OVER (PARTITION BY region) AS mn, "
+    "max(amount) OVER (PARTITION BY region) AS mx FROM sales",
+    "SELECT region, emp, amount, lag(amount) OVER (PARTITION BY region ORDER BY "
+    "amount, emp) AS prev FROM sales",
+    "SELECT region, emp, amount, lead(amount, 2) OVER (PARTITION BY region ORDER BY "
+    "amount, emp) AS nxt FROM sales",
+    "SELECT region, amount, first_value(amount) OVER (PARTITION BY region ORDER BY "
+    "amount) AS fv FROM sales",
+    "SELECT region, amount, sum(amount) OVER (PARTITION BY region ORDER BY amount "
+    "ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS srows FROM sales",
+    "SELECT amount, row_number() OVER (ORDER BY amount DESC) AS rn FROM sales",
+]
+
+
+@pytest.mark.parametrize("q", QUERIES)
+def test_differential(env, q):
+    s, db = env
+    same(s.execute(q).rows, db.execute(q).fetchall())
+
+
+def test_range_default_frame_ties(env):
+    """SQL default RANGE frame: tied order keys share the running value."""
+    s, db = env
+    q = ("SELECT region, amount, sum(amount) OVER (PARTITION BY region "
+         "ORDER BY amount) AS r FROM sales WHERE region = 'east'")
+    same(s.execute(q).rows, db.execute(q).fetchall())
+    # east amounts: 50, 100, 200, 200 -> the two 200s BOTH see 550
+    rows = {tuple(r[:2]): r[2] for r in s.execute(q).rows}
+    assert rows[("east", 200)] == 550
+
+
+class TestReviewRegressions:
+    def test_last_value_whole_partition_with_padding(self, env):
+        s, db = env
+        q = ("SELECT region, amount, last_value(amount) OVER (PARTITION BY region "
+             "ORDER BY amount ROWS BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED "
+             "FOLLOWING) AS lv FROM sales")
+        same(s.execute(q).rows, db.execute(q).fetchall())
+
+    def test_null_partition_keys_form_one_partition(self, env):
+        s, _ = env
+        s.execute("CREATE TABLE np (g BIGINT, v BIGINT)")
+        s.execute("INSERT INTO np VALUES (NULL, 7), (NULL, 9), (1, 1)")
+        r = s.execute("SELECT g, count(v) OVER (PARTITION BY g) c FROM np")
+        by_g = sorted(r.rows, key=lambda t: (t[0] is not None, t[0] or 0))
+        assert by_g == [(None, 2), (None, 2), (1, 1)]
+
+    def test_current_row_frame_rejected(self, env):
+        s, _ = env
+        from galaxysql_tpu.utils.errors import NotSupportedError
+        with pytest.raises(NotSupportedError):
+            s.execute("SELECT sum(amount) OVER (ORDER BY amount ROWS BETWEEN "
+                      "CURRENT ROW AND UNBOUNDED FOLLOWING) FROM sales")
+
+    def test_distinct_window_rejected(self, env):
+        s, _ = env
+        from galaxysql_tpu.utils.errors import NotSupportedError
+        with pytest.raises(NotSupportedError):
+            s.execute("SELECT sum(DISTINCT amount) OVER (PARTITION BY region) "
+                      "FROM sales")
