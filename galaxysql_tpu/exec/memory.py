@@ -1,0 +1,101 @@
+"""Hierarchical memory pools + spill hooks.
+
+Reference analog: `optimizer/memory` (SURVEY.md §2.5) — pools global → query →
+operator with revoke hooks that trigger spilling (`MemoryRevoker`, §2.6 spill
+framework).  Host-side accounting: operators reserve before materializing; a failed
+reservation first asks revocable consumers (spillable operators) to release, then
+raises.  Device HBM is governed separately by the DeviceCache byte budget.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from galaxysql_tpu.utils import errors
+
+
+class MemoryLimitExceeded(errors.TddlError):
+    errno = 1038  # ER_OUT_OF_SORTMEMORY
+    sqlstate = "HY001"
+
+
+class MemoryPool:
+    def __init__(self, name: str, limit: int, parent: Optional["MemoryPool"] = None):
+        self.name = name
+        self.limit = limit
+        self.parent = parent
+        self.reserved = 0
+        self._lock = threading.Lock()
+        self._revokers: List[Callable[[int], int]] = []
+        self.children: List["MemoryPool"] = []
+        if parent is not None:
+            parent.children.append(self)
+
+    def child(self, name: str, limit: Optional[int] = None) -> "MemoryPool":
+        return MemoryPool(name, limit if limit is not None else self.limit, self)
+
+    def add_revoker(self, fn: Callable[[int], int]):
+        """fn(nbytes) -> bytes actually released (spilled)."""
+        with self._lock:
+            self._revokers.append(fn)
+
+    def remove_revoker(self, fn):
+        with self._lock:
+            if fn in self._revokers:
+                self._revokers.remove(fn)
+
+    def try_reserve(self, nbytes: int) -> bool:
+        with self._lock:
+            if self.reserved + nbytes > self.limit:
+                return False
+            self.reserved += nbytes
+        if self.parent is not None:
+            if not self.parent.try_reserve(nbytes):
+                with self._lock:
+                    self.reserved -= nbytes
+                return False
+        return True
+
+    def reserve(self, nbytes: int):
+        """Reserve, revoking (spilling) from registered consumers if needed."""
+        if self.try_reserve(nbytes):
+            return
+        self.revoke(nbytes)
+        if not self.try_reserve(nbytes):
+            raise MemoryLimitExceeded(
+                f"memory pool '{self.name}' exhausted "
+                f"({self.reserved + nbytes} > {self.limit} bytes)")
+
+    def revoke(self, nbytes: int) -> int:
+        """Ask revocable consumers (bottom-up) to release at least nbytes."""
+        released = 0
+        for c in list(self.children):
+            released += c.revoke(nbytes - released)
+            if released >= nbytes:
+                return released
+        with self._lock:
+            revokers = list(self._revokers)
+        for fn in revokers:
+            released += fn(nbytes - released)
+            if released >= nbytes:
+                break
+        return released
+
+    def release(self, nbytes: int):
+        with self._lock:
+            self.reserved = max(self.reserved - nbytes, 0)
+        if self.parent is not None:
+            self.parent.release(nbytes)
+
+    def close(self):
+        self.release(self.reserved)
+        if self.parent is not None and self in self.parent.children:
+            self.parent.children.remove(self)
+
+
+GLOBAL_POOL = MemoryPool("global", 16 << 30)
+
+
+def query_pool(conn_id: int, limit: int = 4 << 30) -> MemoryPool:
+    return GLOBAL_POOL.child(f"query-{conn_id}", limit)

@@ -196,11 +196,15 @@ class HashAggOp(Operator):
     """
 
     def __init__(self, child: Operator, group_exprs: Sequence[Tuple[str, ir.Expr]],
-                 aggs: Sequence[AggCall], max_groups: int = 1 << 16):
+                 aggs: Sequence[AggCall], max_groups: int = 1 << 16,
+                 spill_threshold: int = 256 << 20):
         self.child = child
         self.group_exprs = list(group_exprs)
         self.aggs = list(aggs)
         self.max_groups = max_groups
+        # partial-state bytes above this spill to disk (MemoryRevoker analog)
+        self.spill_threshold = spill_threshold
+        self.spilled_partials = 0
 
     # -- kernel plumbing ---------------------------------------------------
 
@@ -292,79 +296,129 @@ class HashAggOp(Operator):
         inputs, lanes = self._partial_specs()
         lane_names = tuple(name for name, _ in lanes)
         mg = self.max_groups
+        from galaxysql_tpu.exec.spill import Spiller
         # capacity under-estimates retry the whole aggregation with doubled output
         # capacity (children re-iterate; scans re-read from the store)
-        while True:
-            partials: List[K.GroupByResult] = []
-            overflowed = False
-            for b in self.child.batches():
-                f = self._partial_fn(mg)
-                r = f(b)
-                if bool(r.overflow):
-                    overflowed = True
+        spiller = Spiller()
+        try:
+            while True:
+                partials: List[K.GroupByResult] = []
+                spiller.close()
+                partial_bytes = 0
+                overflowed = False
+                for b in self.child.batches():
+                    f = self._partial_fn(mg)
+                    r = f(b)
+                    if bool(r.overflow):
+                        overflowed = True
+                        break
+                    host = jax.tree.map(np.asarray, r)
+                    partials.append(host)
+                    partial_bytes += _groupby_result_bytes(host)
+                    if partial_bytes > self.spill_threshold:
+                        for p in partials:
+                            spiller.spill(_groupby_result_to_arrays(p))
+                        self.spilled_partials += len(partials)
+                        partials = []
+                        partial_bytes = 0
+                if not overflowed:
                     break
-                partials.append(jax.tree.map(np.asarray, r))
-            if not overflowed:
-                break
-            mg *= 2
-            if mg > self.MAX_GROUPS_CEILING:
-                raise RuntimeError("group cardinality exceeds engine ceiling")
+                mg *= 2
+                if mg > self.MAX_GROUPS_CEILING:
+                    raise RuntimeError("group cardinality exceeds engine ceiling")
 
-        if not partials:
-            if self.group_exprs:
-                return
-            # global agg over empty input: one row of neutral values
-            partials = []
+            # hierarchical merge: consume spilled partials in threshold-bounded waves
+            # so peak host memory stays ~spill_threshold + merged-state size
+            out = self._merge_waves(partials, spiller, mg, inputs, lanes, lane_names)
+            if out is not None:
+                yield out
+        finally:
+            spiller.close()
 
-        # concat partial key/agg lanes into one merge input
+
+
+    def _merge_partials(self, parts: List[K.GroupByResult], mg: int,
+                        lane_names, merge_specs) -> Tuple[K.GroupByResult, int]:
+        """Merge a list of host partials into one; returns (result, possibly-grown mg)."""
+
         def cat(arrs):
             return np.concatenate(arrs) if arrs else np.zeros(0)
 
-        if partials:
-            key_lanes = []
-            for i, (_, ge) in enumerate(self.group_exprs):
-                d = cat([p.keys[i][0] for p in partials])
-                vs = [p.keys[i][1] for p in partials]
-                v = None if all(x is None for x in vs) else \
-                    np.concatenate([x if x is not None else
-                                    np.ones(p.keys[i][0].shape[0], np.bool_)
-                                    for x, p in zip(vs, partials)])
-                key_lanes.append((jnp.asarray(d), None if v is None else jnp.asarray(v)))
-            live = jnp.asarray(cat([p.live for p in partials]).astype(np.bool_))
-            agg_lanes = []
-            for j in range(len(lane_names)):
-                d = cat([p.aggs[j][0] for p in partials])
-                vs = [p.aggs[j][1] for p in partials]
-                v = None if all(x is None for x in vs) else \
-                    np.concatenate([x if x is not None else
-                                    np.ones(p.aggs[j][0].shape[0], np.bool_)
-                                    for x, p in zip(vs, partials)])
-                agg_lanes.append((jnp.asarray(d), None if v is None else jnp.asarray(v)))
-        else:
-            key_lanes, agg_lanes, live = [], [], jnp.zeros(1, jnp.bool_)
-            for name, spec in lanes:
-                agg_lanes.append((jnp.zeros(1, jnp.int64), jnp.zeros(1, jnp.bool_)))
+        key_lanes = []
+        for i in range(len(self.group_exprs)):
+            d = cat([np.asarray(p.keys[i][0]) for p in parts])
+            vs = [p.keys[i][1] for p in parts]
+            v = None if all(x is None for x in vs) else \
+                np.concatenate([np.asarray(x) if x is not None else
+                                np.ones(np.asarray(p.keys[i][0]).shape[0], np.bool_)
+                                for x, p in zip(vs, parts)])
+            key_lanes.append((jnp.asarray(d), None if v is None else jnp.asarray(v)))
+        live = jnp.asarray(cat([np.asarray(p.live) for p in parts]).astype(np.bool_))
+        agg_lanes = []
+        for j in range(len(lane_names)):
+            d = cat([np.asarray(p.aggs[j][0]) for p in parts])
+            vs = [p.aggs[j][1] for p in parts]
+            v = None if all(x is None for x in vs) else \
+                np.concatenate([np.asarray(x) if x is not None else
+                                np.ones(np.asarray(p.aggs[j][0]).shape[0], np.bool_)
+                                for x, p in zip(vs, parts)])
+            agg_lanes.append((jnp.asarray(d), None if v is None else jnp.asarray(v)))
+        while True:
+            f = self._merge_fn(mg, len(key_lanes), lane_names, merge_specs)
+            r = f(tuple(key_lanes), tuple(agg_lanes), live)
+            if not bool(r.overflow):
+                return jax.tree.map(np.asarray, r), mg
+            mg *= 2  # distinct groups across partials can exceed one partial's cap
+            if mg > self.MAX_GROUPS_CEILING:
+                raise RuntimeError("group cardinality exceeds engine ceiling")
 
-        # merge semantics: sum/count partials re-sum; min/max re-min/max
+    def _merge_waves(self, partials, spiller, mg, inputs, lanes,
+                     lane_names) -> ColumnBatch:
         merge_specs = []
         for (name, spec) in lanes:
-            if spec.kind in ("count", "count_star"):
-                merge_specs.append(K.AggSpec("sum", len(merge_specs)))
-            elif spec.kind == "sum":
+            if spec.kind in ("count", "count_star", "sum"):
                 merge_specs.append(K.AggSpec("sum", len(merge_specs)))
             else:
                 merge_specs.append(K.AggSpec(spec.kind, len(merge_specs)))
         merge_specs = tuple(merge_specs)
 
-        while True:
-            f = self._merge_fn(mg, len(key_lanes), lane_names, merge_specs)
-            r = f(tuple(key_lanes), tuple(agg_lanes), live)
-            if not bool(r.overflow):
-                break
-            mg *= 2  # distinct groups across partials can exceed any one partial's cap
-            if mg > self.MAX_GROUPS_CEILING:
-                raise RuntimeError("group cardinality exceeds engine ceiling")
-        yield self._finalize(r, lane_names)
+        if not partials and not spiller.spilled_files:
+            if self.group_exprs:
+                return None  # grouped agg over empty input: no rows at all
+            empty = [(jnp.zeros(1, jnp.int64), jnp.zeros(1, jnp.bool_))
+                     for _ in lane_names]
+            r = K.GroupByResult(tuple(), tuple(empty), jnp.zeros(1, jnp.bool_),
+                                jnp.int32(0), jnp.bool_(False))
+            return self._finalize(jax.tree.map(jnp.asarray,
+                                               jax.tree.map(np.asarray, r)),
+                                  lane_names)
+
+        acc: Optional[K.GroupByResult] = None
+        wave: List[K.GroupByResult] = []
+        wave_bytes = 0
+
+        def flush():
+            nonlocal acc, wave, wave_bytes, mg
+            if not wave:
+                return
+            parts = ([acc] if acc is not None else []) + wave
+            acc, mg = self._merge_partials(parts, mg, lane_names, merge_specs)
+            wave = []
+            wave_bytes = 0
+
+        for d in spiller.read_all():
+            p = _groupby_result_from_arrays(d)
+            wave.append(p)
+            wave_bytes += _groupby_result_bytes(p)
+            if wave_bytes > self.spill_threshold:
+                flush()
+        for p in partials:
+            wave.append(p)
+            wave_bytes += _groupby_result_bytes(p)
+            if wave_bytes > self.spill_threshold:
+                flush()
+        flush()
+        return self._finalize(jax.tree.map(jnp.asarray, acc), lane_names)
 
     def _finalize(self, r: K.GroupByResult, lane_names: Tuple[str, ...]) -> ColumnBatch:
         """Materialize final output batch; avg = sum/count with MySQL decimal scale."""
@@ -414,6 +468,43 @@ class HashAggOp(Operator):
                     d = jnp.asarray(order[ranks])
                 cols[a.name] = Column(d, v, rt, dict_)
         return ColumnBatch(cols, n_groups_live)
+
+
+def _groupby_result_bytes(r: K.GroupByResult) -> int:
+    total = 0
+    for d, v in tuple(r.keys) + tuple(r.aggs):
+        total += d.nbytes + (v.nbytes if v is not None else 0)
+    return total + r.live.nbytes
+
+
+def _groupby_result_to_arrays(r: K.GroupByResult) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {"live": np.asarray(r.live),
+                                  "num_groups": np.asarray(r.num_groups),
+                                  "overflow": np.asarray(r.overflow)}
+    for i, (d, v) in enumerate(r.keys):
+        out[f"k{i}_d"] = np.asarray(d)
+        if v is not None:
+            out[f"k{i}_v"] = np.asarray(v)
+    for j, (d, v) in enumerate(r.aggs):
+        out[f"a{j}_d"] = np.asarray(d)
+        if v is not None:
+            out[f"a{j}_v"] = np.asarray(v)
+    return out
+
+
+def _groupby_result_from_arrays(d: Dict[str, np.ndarray]) -> K.GroupByResult:
+    keys = []
+    i = 0
+    while f"k{i}_d" in d:
+        keys.append((d[f"k{i}_d"], d.get(f"k{i}_v")))
+        i += 1
+    aggs = []
+    j = 0
+    while f"a{j}_d" in d:
+        aggs.append((d[f"a{j}_d"], d.get(f"a{j}_v")))
+        j += 1
+    return K.GroupByResult(tuple(keys), tuple(aggs), d["live"], d["num_groups"],
+                           d["overflow"])
 
 
 class HashJoinOp(Operator):

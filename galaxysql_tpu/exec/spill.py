@@ -1,0 +1,93 @@
+"""Disk spill framework.
+
+Reference analog: `executor/operator/spill` + `SpillSpaceManager` (SURVEY.md §2.6,
+§5.4) — operators under memory pressure serialize intermediate state to spill files and
+stream it back; a global manager enforces a disk quota.  Spill files are npz bundles of
+column lanes (the engine's native layout), written to a per-process temp dir.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from galaxysql_tpu.utils import errors
+
+
+class SpillQuotaExceeded(errors.TddlError):
+    errno = 1041
+    sqlstate = "HY000"
+
+
+class SpillSpaceManager:
+    def __init__(self, quota_bytes: int = 64 << 30, directory: Optional[str] = None):
+        self.quota = quota_bytes
+        self.used = 0
+        self._lock = threading.Lock()
+        self._dir = directory
+        self._seq = 0
+
+    @property
+    def directory(self) -> str:
+        if self._dir is None:
+            self._dir = tempfile.mkdtemp(prefix="galaxysql_spill_")
+        return self._dir
+
+    def allocate_path(self) -> str:
+        with self._lock:
+            self._seq += 1
+            return os.path.join(self.directory, f"spill_{self._seq}.npz")
+
+    def charge(self, nbytes: int):
+        with self._lock:
+            if self.used + nbytes > self.quota:
+                raise SpillQuotaExceeded(
+                    f"spill space quota exceeded ({self.used + nbytes} > "
+                    f"{self.quota} bytes)")
+            self.used += nbytes
+
+    def refund(self, nbytes: int):
+        with self._lock:
+            self.used = max(self.used - nbytes, 0)
+
+
+SPILL_MANAGER = SpillSpaceManager()
+
+
+class Spiller:
+    """Writes arrays-dicts to spill files; streams them back; cleans up on close."""
+
+    def __init__(self, manager: SpillSpaceManager = SPILL_MANAGER):
+        self.manager = manager
+        self.files: List[tuple] = []  # (path, nbytes)
+
+    def spill(self, arrays: Dict[str, np.ndarray]) -> int:
+        path = self.manager.allocate_path()
+        np.savez(path, **arrays)
+        nbytes = os.path.getsize(path)
+        self.manager.charge(nbytes)
+        self.files.append((path, nbytes))
+        return nbytes
+
+    def read_all(self) -> Iterator[Dict[str, np.ndarray]]:
+        for path, _ in self.files:
+            with np.load(path, allow_pickle=False) as z:
+                yield {k: z[k] for k in z.files}
+
+    @property
+    def spilled_files(self) -> int:
+        return len(self.files)
+
+    def close(self):
+        for path, nbytes in self.files:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            self.manager.refund(nbytes)
+        self.files.clear()

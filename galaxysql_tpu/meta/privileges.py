@@ -1,0 +1,118 @@
+"""Privilege system: users, grants, authorization checks.
+
+Reference analog: `gms/privilege/PolarPrivManager` (SURVEY.md §2.8) — users and
+schema/table-scoped privileges persisted in the metadb, checked on every statement.
+Passwords are stored as SHA1(SHA1(password)) (the mysql_native_password server-side
+form), so wire auth can verify scrambles without plaintext.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from galaxysql_tpu.utils import errors
+
+_PRIV_SCHEMA = """
+CREATE TABLE IF NOT EXISTS user_priv (
+    user TEXT PRIMARY KEY, password_hash BLOB, is_super INTEGER);
+CREATE TABLE IF NOT EXISTS db_priv (
+    user TEXT, schema_name TEXT, table_name TEXT, priv TEXT,
+    PRIMARY KEY (user, schema_name, table_name, priv));
+"""
+
+ALL_PRIVS = {"SELECT", "INSERT", "UPDATE", "DELETE", "CREATE", "DROP", "ALTER",
+             "INDEX"}
+
+
+def double_sha1(password: str) -> bytes:
+    if not password:
+        return b""
+    return hashlib.sha1(hashlib.sha1(password.encode()).digest()).digest()
+
+
+class PrivilegeManager:
+    def __init__(self, metadb):
+        self.metadb = metadb
+        with metadb._lock:
+            metadb._conn.executescript(_PRIV_SCHEMA)
+            metadb._conn.commit()
+        if not self.metadb.query("SELECT 1 FROM user_priv WHERE user='root'"):
+            self.create_user("root", "", super_user=True, if_not_exists=True)
+
+    # -- user management ---------------------------------------------------------
+
+    def create_user(self, user: str, password: str, super_user: bool = False,
+                    if_not_exists: bool = False):
+        exists = bool(self.metadb.query("SELECT 1 FROM user_priv WHERE user=?",
+                                        (user,)))
+        if exists:
+            if if_not_exists:
+                return
+            raise errors.TddlError(f"User '{user}' already exists")
+        self.metadb.execute("INSERT INTO user_priv VALUES (?,?,?)",
+                            (user, double_sha1(password), int(super_user)))
+
+    def drop_user(self, user: str, if_exists: bool = False):
+        if user == "root":
+            raise errors.TddlError("cannot drop 'root'")
+        n = self.metadb.execute("DELETE FROM user_priv WHERE user=?", (user,)).rowcount
+        if not n and not if_exists:
+            raise errors.TddlError(f"User '{user}' does not exist")
+        self.metadb.execute("DELETE FROM db_priv WHERE user=?", (user,))
+
+    def password_hash(self, user: str) -> Optional[bytes]:
+        rows = self.metadb.query(
+            "SELECT password_hash FROM user_priv WHERE user=?", (user,))
+        return bytes(rows[0][0]) if rows else None
+
+    def user_exists(self, user: str) -> bool:
+        return self.password_hash(user) is not None
+
+    def is_super(self, user: str) -> bool:
+        rows = self.metadb.query("SELECT is_super FROM user_priv WHERE user=?",
+                                 (user,))
+        return bool(rows and rows[0][0])
+
+    # -- grants ------------------------------------------------------------------
+
+    def grant(self, user: str, privs: List[str], schema: str, table: str):
+        if not self.user_exists(user):
+            raise errors.TddlError(f"User '{user}' does not exist")
+        expanded = ALL_PRIVS if privs == ["ALL"] else set(p.upper() for p in privs)
+        for p in expanded:
+            self.metadb.execute(
+                "INSERT OR IGNORE INTO db_priv VALUES (?,?,?,?)",
+                (user, schema.lower(), table.lower(), p))
+
+    def revoke(self, user: str, privs: List[str], schema: str, table: str):
+        expanded = ALL_PRIVS if privs == ["ALL"] else set(p.upper() for p in privs)
+        for p in expanded:
+            self.metadb.execute(
+                "DELETE FROM db_priv WHERE user=? AND schema_name=? AND "
+                "table_name=? AND priv=?", (user, schema.lower(), table.lower(), p))
+
+    def has_privilege(self, user: str, priv: str, schema: str,
+                      table: str = "*") -> bool:
+        if self.is_super(user):
+            return True
+        if schema.lower() == "information_schema" and priv == "SELECT":
+            return True
+        rows = self.metadb.query(
+            "SELECT 1 FROM db_priv WHERE user=? AND priv=? AND "
+            "(schema_name='*' OR schema_name=?) AND "
+            "(table_name='*' OR table_name=?) LIMIT 1",
+            (user, priv.upper(), schema.lower(), table.lower()))
+        return bool(rows)
+
+    def check(self, user: str, priv: str, schema: str, table: str = "*"):
+        if not self.has_privilege(user, priv, schema, table):
+            raise errors.AccessDeniedError(
+                f"{priv} command denied to user '{user}' for "
+                f"'{schema}.{table if table != '*' else '*'}'")
+
+    def grants_for(self, user: str) -> List[Tuple[str, str, str]]:
+        return self.metadb.query(
+            "SELECT priv, schema_name, table_name FROM db_priv WHERE user=? "
+            "ORDER BY schema_name, table_name, priv", (user,))

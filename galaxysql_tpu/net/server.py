@@ -93,7 +93,8 @@ class Connection:
                 pass
 
     async def _run_inner(self):
-        seed = secrets.token_bytes(20)
+        # salt bytes must avoid NUL: clients read the second half null-terminated
+        seed = bytes(secrets.choice(range(1, 256)) for _ in range(20))
         self.send(P.handshake_v10(self.session.conn_id, seed))
         await self.flush()
         payload = await self.read_packet()
@@ -237,18 +238,33 @@ class MySQLServer:
         self.instance = instance
         self.host = host
         self.port = port
-        self.users = users if users is not None else {"root": ""}
+        self.users = users  # None -> authenticate against the metadb user table
         self.pool = ThreadPoolExecutor(max_workers=pool_size,
                                        thread_name_prefix="exec")
         self._server: Optional[asyncio.AbstractServer] = None
 
     def authenticate(self, user: str, auth: bytes, seed: bytes) -> bool:
-        if user not in self.users:
+        # explicit user map (tests) takes precedence; otherwise the metadb
+        # privilege tables decide (PolarPrivManager analog)
+        if self.users is not None and user in self.users:
+            password = self.users[user].encode("utf8")
+            if not password:
+                return auth in (b"", b"\0")
+            return auth == P.native_password_scramble(password, seed)
+        if self.users is not None:
             return False
-        password = self.users[user].encode("utf8")
-        if not password:
+        import hashlib
+        stored = self.instance.privileges.password_hash(user)  # SHA1(SHA1(pw))
+        if stored is None:
+            return False
+        if not stored:
             return auth in (b"", b"\0")
-        return auth == P.native_password_scramble(password, seed)
+        if not auth:
+            return False
+        # scramble = SHA1(pw) XOR SHA1(seed + stored); recover SHA1(pw) and verify
+        h3 = hashlib.sha1(seed + stored).digest()
+        sha1_pw = bytes(a ^ b for a, b in zip(auth, h3))
+        return hashlib.sha1(sha1_pw).digest() == stored
 
     async def start(self):
         async def handler(reader, writer):

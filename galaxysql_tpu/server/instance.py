@@ -37,6 +37,8 @@ class Instance:
         self.ddl_engine = DdlEngine(self)
         from galaxysql_tpu.meta.sequence import SequenceManager
         self.sequences = SequenceManager(self.metadb)
+        from galaxysql_tpu.meta.privileges import PrivilegeManager
+        self.privileges = PrivilegeManager(self.metadb)
         self.node_id = f"cn-{uuid.uuid4().hex[:8]}"
         self.lock = threading.RLock()
         self.next_conn_id = 1

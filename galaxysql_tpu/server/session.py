@@ -125,8 +125,61 @@ class Session:
         stmt = parse(sql)
         return self.execute_statement(stmt, sql, params)
 
+    _PRIV_BY_STMT = {
+        ast.Select: "SELECT", ast.SetOpSelect: "SELECT", ast.Insert: "INSERT",
+        ast.Update: "UPDATE", ast.Delete: "DELETE", ast.CreateTable: "CREATE",
+        ast.DropTable: "DROP", ast.TruncateTable: "DELETE", ast.AlterTable: "ALTER",
+        ast.CreateIndex: "INDEX", ast.DropIndex: "INDEX", ast.LoadData: "INSERT",
+        ast.CreateDatabase: "CREATE", ast.DropDatabase: "DROP",
+    }
+
+    @staticmethod
+    def _stmt_tables(node) -> List[ast.TableName]:
+        """Every TableName referenced by a statement (joins, subqueries included)."""
+        out: List[ast.TableName] = []
+        seen = set()
+
+        def walk(x):
+            if id(x) in seen or x is None:
+                return
+            seen.add(id(x))
+            if isinstance(x, ast.TableName):
+                out.append(x)
+                return
+            if isinstance(x, (ast.Node,)) and hasattr(x, "__dataclass_fields__"):
+                for f in x.__dataclass_fields__:
+                    walk(getattr(x, f))
+            elif isinstance(x, (list, tuple)):
+                for item in x:
+                    walk(item)
+        walk(node)
+        return out
+
+    def _authorize(self, stmt: ast.Statement):
+        pm = self.instance.privileges
+        if isinstance(stmt, (ast.CreateUser, ast.DropUser, ast.GrantStmt,
+                             ast.RevokeStmt)):
+            # account administration requires the super user
+            if not pm.is_super(self.user):
+                raise errors.AccessDeniedError(
+                    f"user administration denied to '{self.user}'")
+            return
+        priv = self._PRIV_BY_STMT.get(type(stmt))
+        if priv is None:
+            return
+        if isinstance(stmt, (ast.CreateDatabase, ast.DropDatabase)):
+            pm.check(self.user, priv, stmt.name)
+            return
+        tables = self._stmt_tables(stmt)
+        if not tables:
+            pm.check(self.user, priv, self.schema or "*")
+            return
+        for t in tables:
+            pm.check(self.user, priv, t.schema or self.schema or "*", t.table)
+
     def execute_statement(self, stmt: ast.Statement, sql: str = "",
                           params: Optional[list] = None) -> ResultSet:
+        self._authorize(stmt)
         if isinstance(stmt, (ast.Select, ast.SetOpSelect)):
             return self._run_query(stmt, sql, params)
         if isinstance(stmt, ast.Insert):
@@ -173,6 +226,25 @@ class Session:
             return self._run_analyze(stmt)
         if isinstance(stmt, ast.KillStmt):
             return ok(info="kill acknowledged")
+        if isinstance(stmt, ast.LoadData):
+            return self._run_load_data(stmt)
+        if isinstance(stmt, ast.CreateUser):
+            self.instance.privileges.create_user(stmt.user, stmt.password,
+                                                 if_not_exists=stmt.if_not_exists)
+            return ok()
+        if isinstance(stmt, ast.DropUser):
+            self.instance.privileges.drop_user(stmt.user, stmt.if_exists)
+            return ok()
+        if isinstance(stmt, ast.GrantStmt):
+            schema = self._require_schema() if stmt.schema == "" else stmt.schema
+            self.instance.privileges.grant(stmt.user, stmt.privileges, schema,
+                                           stmt.table)
+            return ok()
+        if isinstance(stmt, ast.RevokeStmt):
+            schema = self._require_schema() if stmt.schema == "" else stmt.schema
+            self.instance.privileges.revoke(stmt.user, stmt.privileges, schema,
+                                            stmt.table)
+            return ok()
         if isinstance(stmt, ast.AlterTable):
             return self._run_alter(stmt, sql)
         if isinstance(stmt, (ast.CreateIndex, ast.DropIndex)):
@@ -201,6 +273,53 @@ class Session:
                                  stmt.table.table, stmt.name)
         self.instance.ddl_engine.submit_and_run(job)
         return ok()
+
+    def _run_load_data(self, stmt: ast.LoadData) -> ResultSet:
+        """Server-side CSV ingestion (LOAD DATA INFILE; ServerLoadDataHandler analog,
+        SURVEY.md App.E).  LOCAL (client-streamed) arrives via the wire layer later."""
+        import csv
+        schema = stmt.table.schema or self._require_schema()
+        tm = self.instance.catalog.table(schema, stmt.table.table)
+        store = self.instance.store(tm.schema, tm.name)
+        columns = stmt.columns or tm.column_names()
+        ts, txn = self._dml_ts()
+        total = 0
+        batch_size = self.instance.config.get("DML_BATCH_SIZE", self.vars) or 10_000
+        delim = stmt.field_terminator.replace("\\t", "\t") or ","
+        quote = stmt.enclosed_by or '"'
+        try:
+            fh = open(stmt.path, newline="")
+        except OSError as e:
+            raise errors.TddlError(f"Can't read file '{stmt.path}' ({e.strerror})")
+        with fh as f:
+            reader = csv.reader(f, delimiter=delim, quotechar=quote)
+            rows: List[List[Any]] = []
+            for i, row in enumerate(reader):
+                if i < stmt.ignore_lines:
+                    continue
+                rows.append([None if v in ("", "\\N") else v for v in row])
+                if len(rows) >= batch_size:
+                    total += self._load_rows(tm, store, columns, rows, ts, txn)
+                    rows = []
+            if rows:
+                total += self._load_rows(tm, store, columns, rows, ts, txn)
+        tm.bump_version()
+        self.instance.catalog.version += 1
+        return ok(affected=total, info=f"Records: {total}")
+
+    def _load_rows(self, tm, store, columns, rows, ts, txn) -> int:
+        data = {c: [r[i] if i < len(r) else None for r in rows]
+                for i, c in enumerate(columns)}
+        data = {tm.column(c).name: vals for c, vals in data.items()}
+        before = [p.num_rows for p in store.partitions]
+        n = store.insert_pylists(data, ts)
+        for pid, p in enumerate(store.partitions):
+            added = p.num_rows - before[pid]
+            if added:
+                if txn is not None:
+                    txn.inserted.append((store, pid, before[pid], added))
+                self._gsi_write_rows(tm, store, pid, before[pid], added, ts, txn)
+        return n
 
     # -- GSI write maintenance (online index writers, SURVEY.md App.D) -----------
 

@@ -423,6 +423,47 @@ class DropIndex(Statement):
 
 
 @dataclasses.dataclass
+class LoadData(Statement):
+    path: str
+    table: TableName
+    local: bool = False
+    columns: Optional[List[str]] = None
+    field_terminator: str = "\t"
+    enclosed_by: Optional[str] = None
+    line_terminator: str = "\n"
+    ignore_lines: int = 0
+
+
+@dataclasses.dataclass
+class CreateUser(Statement):
+    user: str
+    password: str = ""
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass
+class DropUser(Statement):
+    user: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
+class GrantStmt(Statement):
+    privileges: List[str]        # ["ALL"] or ["SELECT", "INSERT", ...]
+    schema: str                  # "*" for global
+    table: str                   # "*" for schema-wide
+    user: str
+
+
+@dataclasses.dataclass
+class RevokeStmt(Statement):
+    privileges: List[str]
+    schema: str
+    table: str
+    user: str
+
+
+@dataclasses.dataclass
 class KillStmt(Statement):
     conn_id: int
     query_only: bool = False

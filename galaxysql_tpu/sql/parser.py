@@ -153,6 +153,12 @@ class Parser:
             while self.accept_op(","):
                 names.append(self._table_name())
             return ast.AnalyzeTable(names)
+        if t.is_kw("LOAD"):
+            return self._load_data()
+        if t.is_kw("GRANT"):
+            return self._grant(revoke=False)
+        if t.is_kw("REVOKE"):
+            return self._grant(revoke=True)
         if t.is_kw("KILL"):
             self.next()
             query_only = self.accept_kw("QUERY")
@@ -803,6 +809,14 @@ class Parser:
             self.next()
             ine = self._if_not_exists()
             return ast.CreateDatabase(self.expect_ident(), ine)
+        if self.accept_kw("USER"):
+            ine = self._if_not_exists()
+            user = self._user_name()
+            password = ""
+            if self.accept_kw("IDENTIFIED"):
+                self.expect_kw("BY")
+                password = self.next().text
+            return ast.CreateUser(user, password, ine)
         unique = self.accept_kw("UNIQUE")
         global_ = self.accept_kw("GLOBAL")
         if self.accept_kw("INDEX"):
@@ -1014,6 +1028,106 @@ class Parser:
             self.expect_op(")")
         return pd
 
+    def _load_data(self) -> ast.Statement:
+        self.expect_kw("LOAD")
+        self.expect_kw("DATA")
+        local = self.accept_kw("LOCAL")
+        self.expect_kw("INFILE")
+        t = self.next()
+        if t.kind != T.STRING:
+            raise self.error("expected file path string")
+        path = t.text
+        self.accept_kw("REPLACE") or self.accept_kw("IGNORE")
+        self.expect_kw("INTO")
+        self.expect_kw("TABLE")
+        table = self._table_name()
+        stmt = ast.LoadData(path, table, local)
+        if self.accept_kw("FIELDS") or self.accept_kw("COLUMNS"):
+            if self.accept_kw("TERMINATED"):
+                self.expect_kw("BY")
+                stmt.field_terminator = self.next().text
+            if self.accept_kw("OPTIONALLY"):
+                pass
+            if self.accept_kw("ENCLOSED"):
+                self.expect_kw("BY")
+                stmt.enclosed_by = self.next().text
+        if self.accept_kw("LINES"):
+            self.expect_kw("TERMINATED")
+            self.expect_kw("BY")
+            stmt.line_terminator = self.next().text
+        if self.accept_kw("IGNORE"):
+            stmt.ignore_lines = int(self.next().text)
+            self.expect_kw("LINES")
+        if self.at_op("("):
+            self.next()
+            stmt.columns = [self.expect_ident()]
+            while self.accept_op(","):
+                stmt.columns.append(self.expect_ident())
+            self.expect_op(")")
+        return stmt
+
+    _PRIVS = {"SELECT", "INSERT", "UPDATE", "DELETE", "CREATE", "DROP", "ALTER",
+              "INDEX", "ALL"}
+
+    def _grant(self, revoke: bool) -> ast.Statement:
+        self.next()  # GRANT | REVOKE
+        privs = []
+        while True:
+            w = self.next().upper
+            if w == "ALL":
+                self.accept_kw("PRIVILEGES")
+                privs = ["ALL"]
+            elif w in self._PRIVS:
+                privs.append(w)
+            else:
+                raise self.error(f"unknown privilege {w}")
+            if not self.accept_op(","):
+                break
+        self.expect_kw("ON")
+        schema = "*"
+        table = "*"
+        if self.at_op("*"):
+            self.next()
+            if self.accept_op("."):
+                self.expect_op("*")
+        else:
+            name = self.expect_ident()
+            if self.accept_op("."):
+                schema = name
+                if self.at_op("*"):
+                    self.next()
+                else:
+                    table = self.expect_ident()
+            else:
+                # MySQL: a bare name is a TABLE in the current database; the
+                # session resolves "" to its schema at execution
+                schema = ""
+                table = name
+        if revoke:
+            self.expect_kw("FROM")
+        else:
+            self.expect_kw("TO")
+        user = self._user_name()
+        if self.accept_kw("IDENTIFIED"):
+            self.expect_kw("BY")
+            self.next()
+        cls = ast.RevokeStmt if revoke else ast.GrantStmt
+        return cls(privs, schema, table, user)
+
+    def _user_name(self) -> str:
+        t = self.next()
+        if t.kind not in (T.IDENT, T.STRING):
+            raise self.error("expected user name")
+        user = t.text
+        # 'u'@'host' / u@host: the lexer yields the @-part as USERVAR (possibly
+        # empty when the host is quoted); the host is ignored — single-host
+        # authentication domain
+        if self.peek().kind == T.USERVAR:
+            hv = self.next()
+            if hv.text == "" and self.peek().kind in (T.STRING, T.IDENT):
+                self.next()
+        return user
+
     def _alter(self) -> ast.Statement:
         self.expect_kw("ALTER")
         self.expect_kw("TABLE")
@@ -1082,6 +1196,12 @@ class Parser:
                 self.expect_kw("EXISTS")
                 ie = True
             return ast.DropDatabase(self.expect_ident(), ie)
+        if self.accept_kw("USER"):
+            ie = False
+            if self.accept_kw("IF"):
+                self.expect_kw("EXISTS")
+                ie = True
+            return ast.DropUser(self._user_name(), ie)
         if self.accept_kw("INDEX"):
             iname = self.expect_ident()
             self.expect_kw("ON")

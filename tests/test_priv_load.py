@@ -1,0 +1,139 @@
+"""Privileges + LOAD DATA + metadb wire auth."""
+
+import asyncio
+import threading
+
+import pytest
+
+from galaxysql_tpu.net.client import MiniClient, MySQLError
+from galaxysql_tpu.net.server import MySQLServer
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.utils import errors
+
+
+@pytest.fixture()
+def inst():
+    return Instance()
+
+
+class TestPrivileges:
+    def test_grant_revoke_enforcement(self, inst):
+        root = Session(inst)
+        root.execute("CREATE DATABASE shop")
+        root.execute("USE shop")
+        root.execute("CREATE TABLE t (a BIGINT)")
+        root.execute("INSERT INTO t VALUES (1)")
+        root.execute("CREATE USER 'bob' IDENTIFIED BY 'pw'")
+        root.execute("GRANT SELECT ON shop.* TO 'bob'")
+
+        bob = Session(inst, "shop")
+        bob.user = "bob"
+        assert bob.execute("SELECT a FROM t").rows == [(1,)]
+        with pytest.raises(errors.AccessDeniedError):
+            bob.execute("INSERT INTO t VALUES (2)")
+        with pytest.raises(errors.AccessDeniedError):
+            bob.execute("DROP TABLE t")
+
+        root.execute("GRANT INSERT ON shop.t TO 'bob'")
+        assert bob.execute("INSERT INTO t VALUES (2)").affected == 1
+        root.execute("REVOKE SELECT ON shop.* FROM 'bob'")
+        with pytest.raises(errors.AccessDeniedError):
+            bob.execute("SELECT a FROM t")
+        root.close()
+        bob.close()
+
+    def test_wire_auth_against_metadb(self, inst):
+        root = Session(inst)
+        root.execute("CREATE USER 'carol' IDENTIFIED BY 'secret'")
+        root.close()
+        srv = MySQLServer(inst, port=0, users=None)  # metadb-backed auth
+        loop = asyncio.new_event_loop()
+        started = threading.Event()
+
+        def run():
+            asyncio.set_event_loop(loop)
+            loop.run_until_complete(srv.start())
+            started.set()
+            loop.run_forever()
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        started.wait(10)
+        try:
+            c = MiniClient("127.0.0.1", srv.port, user="carol", password="secret")
+            assert c.ping()
+            c.close()
+            with pytest.raises(MySQLError):
+                MiniClient("127.0.0.1", srv.port, user="carol", password="nope")
+            c2 = MiniClient("127.0.0.1", srv.port)  # root, empty password
+            assert c2.ping()
+            c2.close()
+        finally:
+            loop.call_soon_threadsafe(loop.stop)
+
+
+class TestLoadData:
+    def test_csv_ingestion(self, inst, tmp_path):
+        s = Session(inst)
+        s.execute("CREATE DATABASE l; USE l")
+        s.execute("CREATE TABLE t (id BIGINT, name VARCHAR(20), amt DECIMAL(10,2)) "
+                  "PARTITION BY HASH(id) PARTITIONS 4")
+        p = tmp_path / "data.csv"
+        p.write_text("id,name,amt\n1,ann,3.50\n2,bob,4.25\n3,,\n")
+        r = s.execute(f"LOAD DATA INFILE '{p}' INTO TABLE t "
+                      f"FIELDS TERMINATED BY ',' IGNORE 1 LINES (id, name, amt)")
+        assert r.affected == 3
+        rows = s.execute("SELECT id, name, amt FROM t ORDER BY id").rows
+        assert rows == [(1, "ann", 3.5), (2, "bob", 4.25), (3, None, None)]
+        s.close()
+
+
+class TestAuthzRegressions:
+    def test_cross_schema_select_checked(self, inst):
+        root = Session(inst)
+        root.execute("CREATE DATABASE a; CREATE DATABASE b")
+        root.execute("USE b; CREATE TABLE secret (x BIGINT)")
+        root.execute("CREATE USER 'eve'")
+        root.execute("GRANT SELECT ON a.* TO 'eve'")
+        eve = Session(inst, "a")
+        eve.user = "eve"
+        with pytest.raises(errors.AccessDeniedError):
+            eve.execute("SELECT x FROM b.secret")
+        with pytest.raises(errors.AccessDeniedError):
+            eve.execute("DROP TABLE b.secret")
+        root.close(); eve.close()
+
+    def test_table_scoped_select_grant_works(self, inst):
+        root = Session(inst)
+        root.execute("CREATE DATABASE a; USE a")
+        root.execute("CREATE TABLE t1 (x BIGINT); CREATE TABLE t2 (x BIGINT)")
+        root.execute("INSERT INTO t1 VALUES (1)")
+        root.execute("CREATE USER 'tom'")
+        root.execute("GRANT SELECT ON a.t1 TO 'tom'")
+        tom = Session(inst, "a")
+        tom.user = "tom"
+        assert tom.execute("SELECT x FROM t1").rows == [(1,)]
+        with pytest.raises(errors.AccessDeniedError):
+            tom.execute("SELECT x FROM t2")
+        root.close(); tom.close()
+
+    def test_user_admin_requires_super(self, inst):
+        root = Session(inst)
+        root.execute("CREATE USER 'carl'")
+        root.execute("GRANT CREATE ON *.* TO 'carl'")
+        carl = Session(inst)
+        carl.user = "carl"
+        with pytest.raises(errors.AccessDeniedError):
+            carl.execute("CREATE USER 'mallory'")
+        with pytest.raises(errors.AccessDeniedError):
+            carl.execute("GRANT ALL ON *.* TO 'carl'")  # escalation blocked
+        with pytest.raises(errors.AccessDeniedError):
+            carl.execute("DROP USER 'carl'")
+        root.close(); carl.close()
+
+    def test_user_at_host_syntax(self, inst):
+        root = Session(inst)
+        root.execute("CREATE USER 'hh'@'localhost' IDENTIFIED BY 'p'")
+        root.execute("GRANT SELECT ON *.* TO 'hh'@'%'")
+        assert inst.privileges.has_privilege("hh", "SELECT", "x")
+        root.close()
