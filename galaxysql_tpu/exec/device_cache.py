@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-Key = Tuple[int, int, str, int, int]  # (id(store), pid, column, version, row_count)
+Key = Tuple[int, int, str, int, int]  # (store.uid, pid, column, version, row_count)
 
 
 class DeviceCache:
@@ -31,7 +31,7 @@ class DeviceCache:
 
     def get_lane(self, store, pid: int, column: str, version: int,
                  host_data: np.ndarray) -> Any:
-        key = (id(store), pid, column, version, int(host_data.shape[0]))
+        key = (store.uid, pid, column, version, int(host_data.shape[0]))
         with self._lock:
             got = self._map.get(key)
             if got is not None:

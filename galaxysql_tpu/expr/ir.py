@@ -42,7 +42,10 @@ class ColRef(Expr):
     dictionary: Optional[Dictionary] = None
 
     def key(self):
-        return ("col", self.name)
+        # the type is part of the identity: generated ids (agg outputs, derived
+        # columns) repeat across plans with different types, and compiled closures
+        # bake type-dependent behavior (decimal scales, output Column dtypes)
+        return ("col", self.name, self.dtype.sql_name())
 
     def __repr__(self):
         return f"${self.name}"

@@ -65,7 +65,8 @@ class MeshDataCache:
         has_pending = any(((p.begin_ts < 0).any() or
                            (p.end_ts != np.iinfo(np.int64).max).any())
                           for p in store.partitions)
-        key = (id(store), table.version, mesh.shape["shard"], tuple(sorted(columns)),
+        key = (store.uid, table.version, mesh.shape["shard"],
+               tuple(sorted(columns)),
                None if not has_pending else (snapshot_ts, txn_id))
         with self._lock:
             got = self._map.get(key)

@@ -159,7 +159,12 @@ class MppExecutor:
 
     def _scan(self, node: L.Scan) -> DistBatch:
         t = node.table
-        store = self.ctx.stores[f"{t.schema.lower()}.{t.name.lower()}"]
+        key = f"{t.schema.lower()}.{t.name.lower()}"
+        am = getattr(self.ctx, "archive", None)
+        if am is not None and am.files_for(key):
+            # cold parquet rows are not mesh-resident yet: run on the local engine
+            raise errors.NotSupportedError("MPP over archived tables")
+        store = self.ctx.stores[key]
         storage_cols = [c for _, c in node.columns]
         st = GLOBAL_MESH_CACHE.get(store, self.mesh, storage_cols,
                                    self.ctx.snapshot_ts, self.ctx.txn_id)

@@ -31,13 +31,16 @@ class ExecContext:
 
     def __init__(self, stores: Dict[str, TableStore], snapshot_ts: Optional[int] = None,
                  params: Optional[list] = None, batch_rows: int = 1 << 20,
-                 device_cache=None, txn_id: int = 0):
+                 device_cache=None, txn_id: int = 0, archive=None,
+                 archive_instance=None):
         self.stores = stores          # "schema.table" -> TableStore
         self.snapshot_ts = snapshot_ts
         self.params = params or []
         self.batch_rows = batch_rows
         self.device_cache = device_cache  # DeviceCache or None (host-batch scans)
         self.txn_id = txn_id          # owning txn for MVCC visibility (0 = none)
+        self.archive = archive        # ArchiveManager (cold parquet scans)
+        self.archive_instance = archive_instance
         self.trace: List[str] = []
 
 
@@ -55,6 +58,7 @@ class ScanSource(ops.Operator):
         rename = {c: oid for oid, c in self.node.columns}
         self.ctx.trace.append(
             f"scan {t.name} partitions={self.node.partitions or 'all'}")
+        yield from self._archive_batches(t, storage_cols, rename)
         from galaxysql_tpu.exec.operators import bucket_capacity
         cache = self.ctx.device_cache
         if cache is None:
@@ -114,6 +118,21 @@ class ScanSource(ops.Operator):
                 if pad_live is not None:
                     live = live & pad_live
             yield ColumnBatch(cols, live)
+
+
+    def _archive_batches(self, t, storage_cols, rename):
+        """Cold rows from parquet archives (OSSTableScanExec analog)."""
+        am = self.ctx.archive
+        if am is None:
+            return
+        from galaxysql_tpu.exec.operators import bucket_capacity
+        inst_key = f"{t.schema.lower()}.{t.name.lower()}"
+        if not am.files_for(inst_key, self.ctx.snapshot_ts):
+            return
+        for b in am.scan_archive(self.ctx.archive_instance, t.schema, t.name,
+                                 storage_cols, self.ctx.snapshot_ts):
+            self.ctx.trace.append(f"scan-archive {t.name} rows={b.capacity}")
+            yield b.pad_to(bucket_capacity(max(b.capacity, 1))).rename(rename)
 
 
 class ValuesSource(ops.Operator):

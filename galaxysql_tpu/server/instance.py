@@ -39,6 +39,9 @@ class Instance:
         self.sequences = SequenceManager(self.metadb)
         from galaxysql_tpu.meta.privileges import PrivilegeManager
         self.privileges = PrivilegeManager(self.metadb)
+        from galaxysql_tpu.storage.archive import ArchiveManager
+        self.archive = ArchiveManager(
+            os.path.join(data_dir, "archive") if data_dir else None)
         self.node_id = f"cn-{uuid.uuid4().hex[:8]}"
         self.lock = threading.RLock()
         self.next_conn_id = 1
@@ -58,6 +61,7 @@ class Instance:
                 d = os.path.join(self.data_dir, tm.schema.lower(), tm.name.lower())
                 if os.path.isdir(d):
                     store.load(d)
+        self.archive.attach(self.metadb)
         self.metadb.heartbeat(self.node_id, "coordinator", "127.0.0.1", 0)
         self.ddl_engine.recover()
 

@@ -429,7 +429,9 @@ class Session:
             cache = GLOBAL_DEVICE_CACHE
         ctx = ExecContext(self.instance.stores, self._snapshot_ts(), params or [],
                           device_cache=cache,
-                          txn_id=self.txn.txn_id if self.txn is not None else 0)
+                          txn_id=self.txn.txn_id if self.txn is not None else 0,
+                          archive=self.instance.archive,
+                          archive_instance=self.instance)
         batch = None
         if plan.workload == "AP" and \
                 self.instance.config.get("ENABLE_MPP", self.vars) and \
@@ -788,7 +790,9 @@ class Session:
         plan = self.instance.planner.bind_statement(inner, schema, params or [])
         lines = plan.explain().split("\n")
         if stmt.analyze:
-            ctx = ExecContext(self.instance.stores, self._snapshot_ts(), params or [])
+            ctx = ExecContext(self.instance.stores, self._snapshot_ts(),
+                              params or [], archive=self.instance.archive,
+                              archive_instance=self.instance)
             op = build_operator(plan.rel, ctx)
             t0 = time.time()
             batch = run_to_batch(op)

@@ -1,0 +1,207 @@
+"""Cold-data archive: TTL-driven partition archival to Parquet.
+
+Reference analog: the OSS/ORC cold-storage path (SURVEY.md §2.6 archive,
+`OSSTableScanExec`, §2.10 local-partition rotation): rows older than a TTL cutoff move
+out of the hot MVCC store into columnar files (Parquet via pyarrow standing in for
+ORC-on-OSS), and scans transparently union hot + archived data.  Archived rows are
+immutable; DML against them is rejected by absence (they no longer exist in the hot
+store).  Dictionary-encoded string lanes are decoded to Arrow dictionary columns, so
+archive files are self-describing and readable by any Parquet tool.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from galaxysql_tpu.chunk.batch import Column, ColumnBatch
+from galaxysql_tpu.types import datatype as dt
+from galaxysql_tpu.utils import errors
+
+try:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    PARQUET_AVAILABLE = True
+except ImportError:  # pragma: no cover
+    PARQUET_AVAILABLE = False
+
+
+_MANIFEST_SCHEMA = """
+CREATE TABLE IF NOT EXISTS archive_files (
+    path TEXT PRIMARY KEY, table_key TEXT, archive_ts INTEGER, state TEXT);
+"""
+
+
+class ArchiveManager:
+    """Per-instance archive registry backed by the metadb manifest.
+
+    Crash-safe flow: write parquet -> manifest PENDING -> delete hot rows ->
+    manifest LIVE.  Boot recovery (`attach`): LIVE entries load into the registry;
+    PENDING entries mean the hot rows were never deleted, so the orphan file is
+    dropped and the next TTL run re-archives."""
+
+    def __init__(self, directory: Optional[str] = None):
+        self.directory = directory
+        # key -> [(path, archive_ts)]
+        self._files: Dict[str, List] = {}
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.metadb = None
+        self._decoded: Dict[str, object] = {}  # path -> pyarrow table (immutable)
+
+    def attach(self, metadb):
+        """Bind the metadb manifest + recover registry state (boot path)."""
+        self.metadb = metadb
+        with metadb._lock:
+            metadb._conn.executescript(_MANIFEST_SCHEMA)
+            metadb._conn.commit()
+        with self._lock:
+            self._files.clear()
+        for path, key, ats, state in metadb.query(
+                "SELECT path, table_key, archive_ts, state FROM archive_files"):
+            if state == "LIVE" and os.path.exists(path):
+                with self._lock:
+                    self._files.setdefault(key, []).append((path, ats))
+            else:  # PENDING: hot rows were never deleted; discard the orphan
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                metadb.execute("DELETE FROM archive_files WHERE path=?", (path,))
+
+    def _dir_for(self, key: str) -> str:
+        base = self.directory
+        if base is None:
+            import tempfile
+            base = tempfile.mkdtemp(prefix="galaxysql_archive_")
+            self.directory = base
+        d = os.path.join(base, key.replace(".", os.sep))
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def files_for(self, key: str, snapshot_ts: Optional[int] = None) -> List[str]:
+        """Files whose archival committed at-or-before the snapshot (a transaction
+        whose snapshot predates an archival still sees those rows HOT)."""
+        with self._lock:
+            entries = list(self._files.get(key, []))
+        if snapshot_ts is None:
+            return [p for p, _ in entries]
+        return [p for p, ats in entries if ats <= snapshot_ts]
+
+    def archive_older_than(self, instance, schema: str, table: str,
+                           ttl_column: str, cutoff_days: int,
+                           snapshot_ts: Optional[int] = None) -> int:
+        """Move rows with ttl_column < cutoff (epoch days) into a parquet file.
+
+        Returns rows archived.  The move is archive-write-then-delete: a crash
+        between the two leaves rows duplicated in archive + hot, resolved by the
+        idempotent re-run (delete again) — never lost."""
+        if not PARQUET_AVAILABLE:
+            raise errors.NotSupportedError("pyarrow is required for archiving")
+        key = instance.store_key(schema, table)
+        store = instance.store(schema, table)
+        tm = store.table
+        cm = tm.column(ttl_column)
+        if not cm.dtype.clazz == dt.TypeClass.DATE:
+            raise errors.TddlError("TTL column must be a DATE")
+        ts = snapshot_ts or instance.tso.next_timestamp()
+        total = 0
+        tables = []
+        for p in store.partitions:
+            vis = p.visible_mask(ts)
+            # NULL TTL values never expire
+            old = vis & p.valid[cm.name] & (p.lanes[cm.name] < cutoff_days)
+            ids = np.nonzero(old)[0]
+            if not ids.size:
+                continue
+            arrays = {}
+            for c in tm.columns:
+                lane = p.lanes[c.name][ids]
+                valid = p.valid[c.name][ids]
+                if c.dtype.is_string:
+                    d = tm.dictionaries[c.name.lower()]
+                    values = [d.values[code] if ok and 0 <= code < len(d.values)
+                              else None
+                              for code, ok in zip(lane.tolist(), valid.tolist())]
+                    arrays[c.name] = pa.array(values, type=pa.string())
+                else:
+                    arrays[c.name] = pa.array(
+                        [v if ok else None
+                         for v, ok in zip(lane.tolist(), valid.tolist())])
+            tables.append(pa.table(arrays))
+            total += ids.size
+            # delete AFTER the write below; remember ids per partition
+            p._archive_pending = ids  # type: ignore
+        if not tables:
+            return 0
+        merged = pa.concat_tables(tables)
+        with self._lock:
+            self._seq += 1
+            path = os.path.join(self._dir_for(key),
+                                f"archive_{ts}_{self._seq}.parquet")
+        pq.write_table(merged, path)
+        archive_ts = instance.tso.next_timestamp()
+        if self.metadb is not None:
+            self.metadb.execute("INSERT OR REPLACE INTO archive_files VALUES "
+                                "(?,?,?,?)", (path, key, archive_ts, "PENDING"))
+        # drop archived rows from the hot store, THEN publish the file: readers
+        # never observe a row both hot and archived
+        for p in store.partitions:
+            ids = getattr(p, "_archive_pending", None)
+            if ids is not None and len(ids):
+                p.delete_rows(ids, archive_ts)
+                p._archive_pending = None  # type: ignore
+        if self.metadb is not None:
+            self.metadb.execute("UPDATE archive_files SET state='LIVE' "
+                                "WHERE path=?", (path,))
+        with self._lock:
+            self._files.setdefault(key, []).append((path, archive_ts))
+        tm.stats.row_count = store.row_count()
+        tm.bump_version()
+        instance.catalog.version += 1
+        return total
+
+    def scan_archive(self, instance, schema: str, table: str,
+                     columns: List[str],
+                     snapshot_ts: Optional[int] = None) -> Iterator[ColumnBatch]:
+        """Yield archived rows as ColumnBatches (strings re-encoded against the
+        table's live dictionaries so joins/filters stay in code space).  Decoded
+        parquet tables cache by path (archive files are immutable)."""
+        if not PARQUET_AVAILABLE:
+            return
+        key = instance.store_key(schema, table)
+        files = self.files_for(key, snapshot_ts)
+        if not files:
+            return
+        tm = instance.catalog.table(schema, table)
+        for path in files:
+            with self._lock:
+                t = self._decoded.get(path)
+            if t is None:
+                t = pq.read_table(path)
+                with self._lock:
+                    if len(self._decoded) > 64:
+                        self._decoded.clear()
+                    self._decoded[path] = t
+            t = t.select(list(columns))
+            cols = {}
+            for name in columns:
+                cm = tm.column(name)
+                arr = t.column(name)
+                pylist = arr.to_pylist()
+                valid = np.array([v is not None for v in pylist], dtype=np.bool_)
+                if cm.dtype.is_string:
+                    d = tm.dictionaries[name.lower()]
+                    lane = np.fromiter(
+                        (d.encode_one(v) if v is not None else 0 for v in pylist),
+                        dtype=np.int32, count=len(pylist))
+                else:
+                    lane = np.array([v if v is not None else 0 for v in pylist],
+                                    dtype=cm.dtype.lane)
+                cols[name] = Column(lane, None if valid.all() else valid, cm.dtype,
+                                    tm.dictionaries.get(name.lower()))
+            yield ColumnBatch(cols, None)

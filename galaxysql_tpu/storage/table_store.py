@@ -15,6 +15,7 @@ rows with begin_ts <= ts < end_ts.  DML writes go through `txn/` which stamps th
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
@@ -90,11 +91,15 @@ class Partition:
 
 
 class TableStore:
+    _next_uid = itertools.count(1)
+
     def __init__(self, table: TableMeta):
         self.table = table
         self.router = PartitionRouter(table)
         n = table.partition.num_partitions
         self.partitions = [Partition(table, i) for i in range(n)]
+        # process-unique identity for caches (id() can be recycled after GC)
+        self.uid = next(TableStore._next_uid)
 
     # -- write path ----------------------------------------------------------
 

@@ -1,0 +1,46 @@
+"""SSB differential suite vs sqlite3 (BASELINE config 4: fact scan + broadcast
+dimension joins)."""
+
+import sqlite3
+
+import pytest
+
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.storage import ssb
+
+
+@pytest.fixture(scope="module")
+def env():
+    data = ssb.generate(0.002)
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE ssb")
+    s.execute("USE ssb")
+    for t in ssb.TABLE_ORDER:
+        s.execute(ssb.SSB_DDL[t])
+        inst.store("ssb", t).insert_arrays(data[t], inst.tso.next_timestamp())
+    s.execute("ANALYZE TABLE " + ", ".join(ssb.TABLE_ORDER))
+
+    db = sqlite3.connect(":memory:")
+    for t in ssb.TABLE_ORDER:
+        cols = list(data[t].keys())
+        decls = ", ".join(
+            f"{c} {'TEXT' if isinstance(data[t][c][0], str) else 'NUMERIC'}"
+            for c in cols)
+        db.execute(f"CREATE TABLE {t} ({decls})")
+        db.executemany(f"INSERT INTO {t} VALUES ({','.join('?' * len(cols))})",
+                       list(zip(*[data[t][c] for c in cols])))
+    db.commit()
+    yield s, db
+    s.close()
+    db.close()
+
+
+@pytest.mark.parametrize("qid", sorted(ssb.QUERIES))
+def test_ssb_query(env, qid):
+    s, db = env
+    q = ssb.QUERIES[qid]
+    mine = sorted(tuple(str(x) for x in r) for r in s.execute(q).rows)
+    theirs = sorted(tuple(str(x) for x in r) for r in db.execute(q).fetchall())
+    assert mine == theirs, f"SSB {qid}\nmine:   {mine[:4]}\nsqlite: {theirs[:4]}"
