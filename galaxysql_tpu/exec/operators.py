@@ -696,12 +696,31 @@ class CrossJoinOp(Operator):
 
     MAX_CELLS = 1 << 26
 
-    def __init__(self, build: Operator, probe: Operator):
+    def __init__(self, build: Operator, probe: Operator, scalar: bool = False,
+                 build_schema=None):
         self.build = build
         self.probe = probe
+        # scalar subquery semantics: empty build NULL-extends, >1 rows errors
+        self.scalar = scalar
+        self.build_schema = build_schema
 
     def batches(self) -> Iterator[ColumnBatch]:
         build = concat_batches(list(self.build.batches()))
+        nb = build.num_live() if build.capacity else 0
+        if self.scalar and nb > 1:
+            from galaxysql_tpu.utils.errors import TddlError
+            raise TddlError("Subquery returns more than 1 row")
+        if self.scalar and nb == 0:
+            for pb in self.probe.batches():
+                ncols = {}
+                for name, (typ, d_) in (self.build_schema or {}).items():
+                    z = jnp.zeros(pb.capacity, dtype=typ.lane)
+                    ncols[name] = Column(z, jnp.zeros(pb.capacity, jnp.bool_),
+                                         typ, d_)
+                ncols.update(pb.columns)
+                yield ColumnBatch(ncols, pb.live)
+            return
+        build = build.compact().pad_to(build.num_live()) if build.capacity else build
         nb = build.capacity
         for pb in self.probe.batches():
             if nb == 0:

@@ -150,17 +150,18 @@ class Binder:
         if has_agg:
             node, out_exprs, display_names = self._bind_aggregate(node, sel, scope)
         else:
-            # plain select list
+            # plain select list (scalar subqueries attach as joins, like WHERE)
             items = self._expand_stars(sel.items, scope)
             for item in items:
-                e = self._bind_expr(item.expr, scope, dict(win_rep))
+                if self._has_scalar_subquery(item.expr):
+                    node, e = self._bind_with_scalar_subquery(
+                        node, item.expr, scope, seed_rep=win_rep)
+                else:
+                    e = self._bind_expr(item.expr, scope, dict(win_rep))
                 name = item.alias or self._display_name(item.expr)
                 out_id = name if "." not in name else name.split(".")[-1]
                 out_exprs.append((self.fresh(out_id), e))
                 display_names.append(out_id)
-            # subqueries in select expressions
-            node2, out_exprs = self._lift_scalar_subqueries(node, out_exprs, scope)
-            node = node2
 
             if sel.distinct:
                 groups = [(oid, e) for oid, e in out_exprs]
@@ -360,9 +361,12 @@ class Binder:
         return found
 
     def _bind_with_scalar_subquery(self, node: L.RelNode, conj: ast.ExprNode,
-                                   scope: Scope) -> Tuple[L.RelNode, ir.Expr]:
-        """Rewrite a predicate containing scalar subqueries into joins + plain expr."""
-        replacements: Dict[int, ir.Expr] = {}
+                                   scope: Scope,
+                                   seed_rep: Optional[Dict[int, ir.Expr]] = None
+                                   ) -> Tuple[L.RelNode, ir.Expr]:
+        """Rewrite an expression containing scalar subqueries into joins + plain
+        expr (shared by the WHERE and SELECT-list paths)."""
+        replacements: Dict[int, ir.Expr] = dict(seed_rep or {})
         for n in _ast_walk(conj):
             if isinstance(n, ast.SubqueryExpr):
                 node, ref = self._attach_scalar_subquery(node, n.select, scope)
@@ -380,12 +384,17 @@ class Binder:
             raise errors.TddlError("Scalar subquery must return one column")
         fid, typ, d = fields[0]
         if not correlated:
-            # uncorrelated: cross join the 1-row result
-            return L.Join(node, plan, "cross", []), ir.ColRef(fid, typ, d)
-        # correlated scalar aggregate: re-group by correlation keys and equi-join.
-        # The binder re-binds the subquery with correlation equalities extracted.
+            # uncorrelated: scalar cross join — exactly-one-row semantics (empty
+            # result NULL-extends, >1 rows is an error at execution)
+            j = L.Join(node, plan, "cross", [])
+            j.scalar = True
+            return j, ir.ColRef(fid, typ.with_nullable(True), d)
+        # correlated scalar aggregate: re-group by correlation keys and LEFT join
+        # (outer rows with no group must survive with NULL, not vanish)
         plan2, out_ref, equi = self._bind_correlated_agg(sub, scope)
-        return L.Join(node, plan2, "inner", equi), out_ref
+        return L.Join(node, plan2, "left", equi), \
+            ir.ColRef(out_ref.name, out_ref.dtype.with_nullable(True),
+                      _find_dictionary(out_ref))
 
     def _bind_correlated_agg(self, sub: ast.Select, scope: Scope):
         """Q2/Q17/Q20 pattern: SELECT agg(expr) FROM ... WHERE corr-eqs AND local-preds."""
@@ -445,9 +454,6 @@ class Binder:
         equi = [(outer, ir.ColRef(gid, g.dtype, _find_dictionary(g)))
                 for outer, (gid, g) in zip(equi_outer, groups)]
         return proj, ir.ColRef(val_id, val.dtype, _find_dictionary(val)), equi
-
-    def _lift_scalar_subqueries(self, node, out_exprs, scope):
-        return node, out_exprs  # select-list scalar subqueries: bound via where path later
 
     # -- window functions ---------------------------------------------------------
 

@@ -147,6 +147,8 @@ class Join(RelNode):
         self.kind = kind
         self.equi = list(equi)
         self.residual = residual
+        # scalar cross join (uncorrelated scalar subquery): exactly-one-row build
+        self.scalar = False
 
     @property
     def left(self) -> RelNode:

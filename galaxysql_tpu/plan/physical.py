@@ -208,7 +208,9 @@ def _build_join(node: L.Join, ctx: ExecContext) -> ops.Operator:
     left = build_operator(node.left, ctx)
     right = build_operator(node.right, ctx)
     if node.kind == "cross":
-        return ops.CrossJoinOp(right, left)  # build = right side (small by construction)
+        bschema = {fid: (typ, d) for fid, typ, d in node.right.fields()}
+        return ops.CrossJoinOp(right, left, scalar=getattr(node, "scalar", False),
+                               build_schema=bschema)
     lkeys = [a for a, _ in node.equi]
     rkeys = [b for _, b in node.equi]
     right_schema = {fid: (typ, d) for fid, typ, d in node.right.fields()}

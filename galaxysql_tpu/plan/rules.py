@@ -230,7 +230,10 @@ def build_join_tree(node: L.RelNode) -> L.RelNode:
 
 
 def _flatten_crosses(node: L.RelNode) -> List[L.RelNode]:
-    if isinstance(node, L.Join) and node.kind == "cross" and not node.equi:
+    if isinstance(node, L.Join) and node.kind == "cross" and not node.equi and \
+            not getattr(node, "scalar", False):
+        # scalar crosses (uncorrelated scalar subqueries) carry exactly-one-row
+        # semantics and must survive join-tree reconstruction intact
         return _flatten_crosses(node.left) + _flatten_crosses(node.right)
     return [node]
 

@@ -39,6 +39,8 @@ class Instance:
         self.sequences = SequenceManager(self.metadb)
         from galaxysql_tpu.meta.privileges import PrivilegeManager
         self.privileges = PrivilegeManager(self.metadb)
+        from galaxysql_tpu.txn.xa import TwoPhaseCoordinator
+        self.xa_coordinator = TwoPhaseCoordinator(self)
         from galaxysql_tpu.storage.archive import ArchiveManager
         self.archive = ArchiveManager(
             os.path.join(data_dir, "archive") if data_dir else None)

@@ -477,6 +477,16 @@ class Session:
         self.txn = None
         if txn is None:
             return
+        policy = str(self.instance.config.get("TRANSACTION_POLICY", self.vars))
+        if policy.upper() == "XA":
+            # two-phase commit across the touched stores, with a logged commit
+            # point and recovery (TsoTransaction 2PC analog, SURVEY.md §3.4)
+            from galaxysql_tpu.txn.xa import TwoPhaseCoordinator
+            coord = self.instance.xa_coordinator
+            coord.commit(txn)
+            if txn.inserted or txn.deleted:
+                self.instance.catalog.version += 1
+            return
         commit_ts = self.instance.tso.next_timestamp()
         for store, pid, start, n in txn.inserted:
             p = store.partitions[pid]

@@ -1,0 +1,161 @@
+"""XA-style two-phase commit over storage participants + recovery.
+
+Reference analog: `TsoTransaction` 2PC (SURVEY.md §3.4): per-shard XA PREPARE, a commit
+point appended to the global transaction log, a fresh commit timestamp, then per-shard
+commit; `XARecoverTask` resolves in-doubt transactions from the log after a crash.
+
+Here a participant is one TableStore's slice of a transaction (the per-store undo
+entries the session collected).  The commit point is the `global_tx_log` COMMITTED row
+in the metadb: a coordinator death before it means every participant rolls back; after
+it, recovery re-commits idempotently.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from galaxysql_tpu.utils import errors
+from galaxysql_tpu.utils.failpoint import FAIL_POINTS, FP_BEFORE_COMMIT
+
+
+class StoreParticipant:
+    """One store's share of a transaction: the provisional rows it must finalize."""
+
+    def __init__(self, store, txn_id: int):
+        self.store = store
+        self.txn_id = txn_id
+        self.inserted: List = []   # (pid, start, n)
+        self.deleted: List = []    # (pid, row_ids, old_end)
+        self.prepared = False
+
+    def prepare(self) -> bool:
+        """Phase 1: validate every provisional stamp is still ours (a competing
+        writer would have raised earlier; this is the structural XA PREPARE)."""
+        own = -self.txn_id
+        for pid, start, n in self.inserted:
+            p = self.store.partitions[pid]
+            with p.lock:
+                if not (p.begin_ts[start:start + n] == own).all():
+                    return False
+        for pid, row_ids, _old in self.deleted:
+            p = self.store.partitions[pid]
+            with p.lock:
+                cur = p.end_ts[row_ids]
+                if not ((cur == own) | (cur >= 0)).all():
+                    return False
+        self.prepared = True
+        return True
+
+    def commit(self, commit_ts: int):
+        own = -self.txn_id
+        for pid, start, n in self.inserted:
+            p = self.store.partitions[pid]
+            with p.lock:  # append rebinds the lanes under this lock
+                seg = p.begin_ts[start:start + n]
+                p.begin_ts[start:start + n] = np.where(seg == own, commit_ts, seg)
+        for pid, row_ids, _old in self.deleted:
+            p = self.store.partitions[pid]
+            with p.lock:
+                cur = p.end_ts[row_ids]
+                p.end_ts[row_ids] = np.where(cur == own, commit_ts, cur)
+        self.store.table.bump_version()
+
+    def rollback(self):
+        for pid, start, n in reversed(self.inserted):
+            p = self.store.partitions[pid]
+            with p.lock:
+                keep = start
+                for c in self.store.table.columns:
+                    p.lanes[c.name] = p.lanes[c.name][:keep]
+                    p.valid[c.name] = p.valid[c.name][:keep]
+                p.begin_ts = p.begin_ts[:keep]
+                p.end_ts = p.end_ts[:keep]
+        for pid, row_ids, old_end in reversed(self.deleted):
+            p = self.store.partitions[pid]
+            with p.lock:
+                p.end_ts[row_ids] = old_end
+        self.store.table.bump_version()
+
+
+def participants_of(txn) -> List[StoreParticipant]:
+    """Group a session Transaction's undo entries by store (one participant each)."""
+    by_store: Dict[int, StoreParticipant] = {}
+
+    def get(store):
+        sp = by_store.get(store.uid)
+        if sp is None:
+            sp = StoreParticipant(store, txn.txn_id)
+            by_store[store.uid] = sp
+        return sp
+
+    for store, pid, start, n in txn.inserted:
+        get(store).inserted.append((pid, start, n))
+    for store, pid, row_ids, old_end in txn.deleted:
+        get(store).deleted.append((pid, row_ids, old_end))
+    return list(by_store.values())
+
+
+class TwoPhaseCoordinator:
+    """The TSO+2PC commit protocol (TsoTransaction.commit analog)."""
+
+    def __init__(self, instance):
+        self.instance = instance
+        # in-doubt registry: txn_id -> participants (cleared when resolved)
+        self._in_doubt: Dict[int, List[StoreParticipant]] = {}
+        self._lock = threading.Lock()
+
+    def commit(self, txn) -> int:
+        parts = participants_of(txn)
+        if not parts:
+            return self.instance.tso.next_timestamp()
+        metadb = self.instance.metadb
+        # phase 1: prepare every participant
+        for sp in parts:
+            if not sp.prepare():
+                for done in parts:
+                    done.rollback()
+                metadb.tx_log_put(txn.txn_id, "ABORTED")
+                raise errors.TransactionError("XA PREPARE failed; rolled back")
+        metadb.tx_log_put(txn.txn_id, "PREPARED")
+        with self._lock:
+            self._in_doubt[txn.txn_id] = parts
+        FAIL_POINTS.inject(FP_BEFORE_COMMIT, f"txn {txn.txn_id}")
+        # commit point: a fresh TSO value logged durably BEFORE any participant
+        # commits (the reference's GlobalTxLogManager.append + commitTimestamp)
+        commit_ts = self.instance.tso.next_timestamp()
+        metadb.tx_log_put(txn.txn_id, "COMMITTED", commit_ts)
+        for sp in parts:
+            sp.commit(commit_ts)
+        metadb.tx_log_put(txn.txn_id, "DONE", commit_ts)
+        with self._lock:
+            self._in_doubt.pop(txn.txn_id, None)
+        return commit_ts
+
+    def recover(self) -> Dict[int, str]:
+        """Resolve in-doubt transactions (XARecoverTask analog).
+
+        PREPARED without a commit point rolls back; COMMITTED re-commits
+        idempotently.  Returns {txn_id: resolution}."""
+        out: Dict[int, str] = {}
+        with self._lock:
+            pending = dict(self._in_doubt)
+        for txn_id, parts in pending.items():
+            state = self.instance.metadb.tx_log_get(txn_id)
+            if state is None or state[0] in ("PREPARED", "ABORTED"):
+                for sp in parts:
+                    sp.rollback()
+                self.instance.metadb.tx_log_put(txn_id, "ABORTED")
+                out[txn_id] = "rolled_back"
+            elif state[0] in ("COMMITTED",):
+                for sp in parts:
+                    sp.commit(state[1])
+                self.instance.metadb.tx_log_put(txn_id, "DONE", state[1])
+                out[txn_id] = "committed"
+            else:
+                out[txn_id] = "done"
+            with self._lock:
+                self._in_doubt.pop(txn_id, None)
+        return out

@@ -146,3 +146,41 @@ class TestBasics:
         assert p.cache.hits >= 1
         # different values reuse the cached AST (no reparse), same key
         assert p.cache.misses == before + 1
+
+
+class TestScalarSubqueryInSelect:
+    def test_select_list_subqueries(self, session):
+        session.execute("CREATE TABLE sq1 (a BIGINT)")
+        session.execute("CREATE TABLE sq2 (b BIGINT)")
+        session.execute("INSERT INTO sq1 VALUES (1), (2); INSERT INTO sq2 VALUES (10)")
+        r = session.execute(
+            "SELECT (SELECT count(*) FROM sq1) + (SELECT sum(b) FROM sq2) AS n")
+        assert r.rows == [(12,)]
+        r = session.execute(
+            "SELECT a, (SELECT max(b) FROM sq2) AS mx FROM sq1 ORDER BY a")
+        assert r.rows == [(1, 10), (2, 10)]
+
+    def test_correlated_select_subquery_left_semantics(self, session):
+        session.execute("CREATE TABLE rt1 (a BIGINT)")
+        session.execute("CREATE TABLE rt2 (k BIGINT, b BIGINT)")
+        session.execute("INSERT INTO rt1 VALUES (1), (2); "
+                        "INSERT INTO rt2 VALUES (1, 10)")
+        r = session.execute(
+            "SELECT a, (SELECT max(b) FROM rt2 WHERE rt2.k = rt1.a) AS mx "
+            "FROM rt1 ORDER BY a")
+        assert r.rows == [(1, 10), (2, None)]  # unmatched row survives with NULL
+
+    def test_empty_scalar_subquery_null_extends(self, session):
+        session.execute("CREATE TABLE e1 (a BIGINT)")
+        session.execute("CREATE TABLE e2 (b BIGINT)")
+        session.execute("INSERT INTO e1 VALUES (1), (2)")
+        r = session.execute("SELECT a, (SELECT max(b) FROM e2 WHERE b > 100) AS m "
+                            "FROM e1 ORDER BY a")
+        assert r.rows == [(1, None), (2, None)]
+
+    def test_multirow_scalar_subquery_errors(self, session):
+        session.execute("CREATE TABLE m1 (a BIGINT)")
+        session.execute("CREATE TABLE m2 (b BIGINT)")
+        session.execute("INSERT INTO m1 VALUES (1); INSERT INTO m2 VALUES (1), (2)")
+        with pytest.raises(errors.TddlError):
+            session.execute("SELECT a, (SELECT b FROM m2) FROM m1")

@@ -1,0 +1,78 @@
+"""XA two-phase commit + in-doubt recovery."""
+
+import pytest
+
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.utils.failpoint import FAIL_POINTS, FP_BEFORE_COMMIT, \
+    FailPointError
+
+
+@pytest.fixture()
+def session():
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE x; USE x")
+    s.execute("SET TRANSACTION_POLICY = 'XA'")
+    s.execute("CREATE TABLE a (id BIGINT, v BIGINT) PARTITION BY HASH(id) PARTITIONS 2")
+    s.execute("CREATE TABLE b (id BIGINT, v BIGINT) PARTITION BY HASH(id) PARTITIONS 2")
+    s.execute("INSERT INTO a VALUES (1, 10); INSERT INTO b VALUES (1, 100)")
+    yield s
+    FAIL_POINTS.clear()
+    s.close()
+
+
+class TestXa:
+    def test_two_store_commit(self, session):
+        s = session
+        s.execute("BEGIN")
+        s.execute("UPDATE a SET v = 11 WHERE id = 1")
+        s.execute("INSERT INTO b VALUES (2, 200)")
+        s.execute("COMMIT")
+        s2 = Session(s.instance, "x")
+        assert s2.execute("SELECT v FROM a WHERE id = 1").rows == [(11,)]
+        assert s2.execute("SELECT count(*) FROM b").rows == [(2,)]
+        # commit point logged as DONE
+        logs = s.instance.metadb.query(
+            "SELECT state FROM global_tx_log ORDER BY txn_id DESC LIMIT 1")
+        assert logs[0][0] == "DONE"
+        s2.close()
+
+    def test_crash_before_commit_point_rolls_back(self, session):
+        s = session
+        s.execute("BEGIN")
+        s.execute("INSERT INTO a VALUES (5, 50)")
+        s.execute("DELETE FROM b WHERE id = 1")
+        FAIL_POINTS.arm(FP_BEFORE_COMMIT)
+        with pytest.raises(FailPointError):
+            s.execute("COMMIT")
+        FAIL_POINTS.clear()
+        # in-doubt: PREPARED logged, no commit point -> recovery rolls back
+        resolved = s.instance.xa_coordinator.recover()
+        assert list(resolved.values()) == ["rolled_back"]
+        s2 = Session(s.instance, "x")
+        assert s2.execute("SELECT count(*) FROM a").rows == [(1,)]
+        assert s2.execute("SELECT count(*) FROM b").rows == [(1,)]
+        s2.close()
+
+    def test_recovery_after_commit_point_commits(self, session):
+        s = session
+        inst = s.instance
+        s.execute("BEGIN")
+        s.execute("INSERT INTO a VALUES (7, 70)")
+        txn = s.txn
+        from galaxysql_tpu.txn.xa import participants_of
+        parts = participants_of(txn)
+        for sp in parts:
+            assert sp.prepare()
+        inst.metadb.tx_log_put(txn.txn_id, "PREPARED")
+        commit_ts = inst.tso.next_timestamp()
+        inst.metadb.tx_log_put(txn.txn_id, "COMMITTED", commit_ts)
+        # simulate coordinator death here: register in-doubt + recover
+        inst.xa_coordinator._in_doubt[txn.txn_id] = parts
+        s.txn = None  # session forgets; recovery owns resolution
+        resolved = inst.xa_coordinator.recover()
+        assert resolved[txn.txn_id] == "committed"
+        s2 = Session(inst, "x")
+        assert s2.execute("SELECT count(*) FROM a").rows == [(2,)]
+        s2.close()
