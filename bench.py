@@ -22,6 +22,9 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 
+if os.environ.get("BENCH_PLATFORM"):
+    # explicit platform override (e.g. BENCH_PLATFORM=cpu when no accelerator)
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 jax.config.update("jax_enable_x64", True)
 try:
     jax.config.update("jax_compilation_cache_dir",

@@ -29,6 +29,19 @@ class DeviceCache:
         self.hits = 0
         self.misses = 0
 
+    def get_lane_built(self, store, pid: int, column: str, version: int,
+                       length: int, builder) -> Any:
+        """Like get_lane, but the host array is built lazily: cache hits skip the
+        (possibly O(table)) host-side materialization entirely."""
+        key = (store.uid, pid, column, version, length)
+        with self._lock:
+            got = self._map.get(key)
+            if got is not None:
+                self._map.move_to_end(key)
+                self.hits += 1
+                return got
+        return self._insert(key, builder())
+
     def get_lane(self, store, pid: int, column: str, version: int,
                  host_data: np.ndarray) -> Any:
         key = (store.uid, pid, column, version, int(host_data.shape[0]))
@@ -38,6 +51,10 @@ class DeviceCache:
                 self._map.move_to_end(key)
                 self.hits += 1
                 return got
+        return self._insert(key, host_data)
+
+    def _insert(self, key, host_data: np.ndarray):
+        with self._lock:
             self.misses += 1
         dev = jnp.asarray(host_data)
         nbytes = host_data.nbytes

@@ -44,6 +44,17 @@ class ExecContext:
         self.trace: List[str] = []
 
 
+def _device_visibility(begin, end, ts, txn_id):
+    """Device-side MVCC visibility — the jnp twin of native.visible_mask (one
+    semantic change must touch exactly these two implementations)."""
+    ins_ok = (begin >= 0) & (begin <= ts)
+    dele = (end >= 0) & (end <= ts)
+    if txn_id:
+        ins_ok = ins_ok | (begin == -txn_id)
+        dele = dele | (end == -txn_id)
+    return ins_ok & ~dele
+
+
 class ScanSource(ops.Operator):
     """Storage scan renamed into plan field-id space."""
 
@@ -71,6 +82,13 @@ class ScanSource(ops.Operator):
         # device-resident path: whole column lanes pinned in HBM keyed by table
         # version; MVCC visibility computed on device from cached ts lanes
         import jax.numpy as jnp
+        if self.node.partitions is None:
+            # full-table scans fuse all partitions into ONE cached device batch:
+            # one kernel dispatch per operator instead of one per partition
+            b = self._fused_table_batch(t, store, cache, jnp)
+            if b is not None:
+                yield b.rename(rename)
+                return
         pids = (range(len(store.partitions)) if self.node.partitions is None
                 else self.node.partitions)
         ts = self.ctx.snapshot_ts
@@ -108,13 +126,7 @@ class ScanSource(ops.Operator):
                                        padded(p.begin_ts))
                 end = cache.get_lane(store, pid, "::end_ts", t.version,
                                      padded(p.end_ts, -1))
-                txn_id = self.ctx.txn_id
-                ins_ok = (begin >= 0) & (begin <= ts)
-                dele = (end >= 0) & (end <= ts)
-                if txn_id:
-                    ins_ok = ins_ok | (begin == -txn_id)
-                    dele = dele | (end == -txn_id)
-                live = ins_ok & ~dele
+                live = _device_visibility(begin, end, ts, self.ctx.txn_id)
                 if pad_live is not None:
                     live = live & pad_live
             yield ColumnBatch(cols, live)
@@ -133,6 +145,53 @@ class ScanSource(ops.Operator):
                                  storage_cols, self.ctx.snapshot_ts):
             self.ctx.trace.append(f"scan-archive {t.name} rows={b.capacity}")
             yield b.pad_to(bucket_capacity(max(b.capacity, 1))).rename(rename)
+
+
+    def _fused_table_batch(self, t, store, cache, jnp):
+        from galaxysql_tpu.exec.operators import bucket_capacity
+        ts = self.ctx.snapshot_ts
+        total = sum(p.num_rows for p in store.partitions)
+        if total == 0 or total > (1 << 27):
+            return None  # empty: old per-partition loop yields nothing
+        cap = bucket_capacity(total)
+
+        def fused(name, parts, fill=0):
+            def build():
+                lane = np.full(cap, fill, dtype=parts[0].dtype)
+                off = 0
+                for arr in parts:
+                    lane[off:off + arr.shape[0]] = arr
+                    off += arr.shape[0]
+                return lane
+            # lazy: a cache hit must not pay the O(table) host concatenation
+            return cache.get_lane_built(store, -1, name, t.version, cap, build)
+
+        cols = {}
+        for oid, cname in self.node.columns:
+            cm = t.column(cname)
+            data = fused(cname, [p.lanes[cname] for p in store.partitions])
+            valid = None
+            if not all(bool(p.valid[cname].all()) for p in store.partitions):
+                valid = fused(f"valid::{cname}",
+                              [p.valid[cname] for p in store.partitions], False)
+            cols[oid] = Column(data, valid, cm.dtype,
+                               t.dictionaries.get(cname.lower()))
+        all_current = all(bool((p.end_ts == np.iinfo(np.int64).max).all()) and
+                          bool((p.begin_ts >= 0).all()) and
+                          (ts is None or
+                           (p.num_rows and int(p.begin_ts.max()) <= ts) or
+                           p.num_rows == 0)
+                          for p in store.partitions)
+        pad_live = jnp.arange(cap) < total if cap != total else None
+        if all_current:
+            live = pad_live
+        else:
+            begin = fused("::begin_ts", [p.begin_ts for p in store.partitions])
+            end = fused("::end_ts", [p.end_ts for p in store.partitions], -1)
+            live = _device_visibility(begin, end, ts, self.ctx.txn_id)
+            if pad_live is not None:
+                live = live & pad_live
+        return ColumnBatch(cols, live)
 
 
 class ValuesSource(ops.Operator):
