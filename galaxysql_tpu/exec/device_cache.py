@@ -59,6 +59,11 @@ class DeviceCache:
         dev = jnp.asarray(host_data)
         nbytes = host_data.nbytes
         with self._lock:
+            existing = self._map.get(key)
+            if existing is not None:
+                # concurrent miss on the same key: keep the first entry so the
+                # byte accounting stays exact
+                return existing
             self._map[key] = dev
             self._bytes += nbytes
             while self._bytes > self.budget and len(self._map) > 1:

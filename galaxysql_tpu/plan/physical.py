@@ -44,6 +44,33 @@ class ExecContext:
         self.trace: List[str] = []
 
 
+# per-(store, version) scan metadata: O(table) host reductions must run once per
+# version, not per query (the lanes themselves are cached the same way)
+_SCAN_META: Dict = {}
+
+
+def _scan_meta(store, version: int) -> Dict:
+    key = (store.uid, version)
+    meta = _SCAN_META.get(key)
+    if meta is None:
+        all_current = True
+        max_begin = 0
+        for p in store.partitions:
+            if p.num_rows == 0:
+                continue
+            if not (bool((p.end_ts == np.iinfo(np.int64).max).all()) and
+                    bool((p.begin_ts >= 0).all())):
+                all_current = False
+            else:
+                max_begin = max(max_begin, int(p.begin_ts.max()))
+        meta = {"all_current": all_current, "max_begin": max_begin,
+                "valid_all": {}}
+        if len(_SCAN_META) > 512:
+            _SCAN_META.clear()
+        _SCAN_META[key] = meta
+    return meta
+
+
 def _device_visibility(begin, end, ts, txn_id):
     """Device-side MVCC visibility — the jnp twin of native.visible_mask (one
     semantic change must touch exactly these two implementations)."""
@@ -87,7 +114,7 @@ class ScanSource(ops.Operator):
             # one kernel dispatch per operator instead of one per partition
             b = self._fused_table_batch(t, store, cache, jnp)
             if b is not None:
-                yield b.rename(rename)
+                yield b.rename(rename)  # fused cols are storage-name keyed
                 return
         pids = (range(len(store.partitions)) if self.node.partitions is None
                 else self.node.partitions)

@@ -41,6 +41,8 @@ class Instance:
         self.privileges = PrivilegeManager(self.metadb)
         from galaxysql_tpu.txn.xa import TwoPhaseCoordinator
         self.xa_coordinator = TwoPhaseCoordinator(self)
+        from galaxysql_tpu.server.scheduler import ScheduledJobManager
+        self.scheduler = ScheduledJobManager(self)
         from galaxysql_tpu.storage.archive import ArchiveManager
         self.archive = ArchiveManager(
             os.path.join(data_dir, "archive") if data_dir else None)
