@@ -25,10 +25,31 @@ import jax
 if os.environ.get("BENCH_PLATFORM"):
     # explicit platform override (e.g. BENCH_PLATFORM=cpu when no accelerator)
     jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+else:
+    # Probe the default backend with a bounded timeout (subprocess — an in-process
+    # hang in backend init is unkillable) and fall back to cpu if it is dead.  The
+    # sitecustomize clobbers JAX_PLATFORMS, so the fallback must be in-process.
+    import __graft_entry__ as _ge
+    if not _ge._default_backend_alive():
+        jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+# Scope the cache by host CPU identity: XLA:CPU AOT artifacts are machine-specific,
+# and reusing a cache written on a different host risks SIGILL.
 try:
+    import hashlib
+    import platform as _plat
+    _STABLE = ("flags", "Features", "model name", "vendor_id", "cpu family",
+               "model\t", "stepping", "CPU implementer", "CPU part")
+    try:
+        with open("/proc/cpuinfo") as f:
+            # only ISA-identifying lines — fields like "cpu MHz" vary per read
+            cpu_desc = _plat.machine() + "".join(
+                sorted({l for l in f if l.startswith(_STABLE)}))
+    except OSError:
+        cpu_desc = _plat.machine() + _plat.processor()
+    host_id = hashlib.md5(cpu_desc.encode()).hexdigest()[:8]
     jax.config.update("jax_compilation_cache_dir",
-                      os.path.expanduser("~/.galaxysql_tpu_jax_cache"))
+                      os.path.expanduser(f"~/.galaxysql_tpu_jax_cache/{host_id}"))
 except Exception:
     pass
 
@@ -108,6 +129,7 @@ def main():
         "value": round(rows_per_sec, 1),
         "unit": "rows/s",
         "vs_baseline": round(rows_per_sec / base_rows_per_sec, 3),
+        "platform": jax.devices()[0].platform,
     }
     print(json.dumps(out))
 
