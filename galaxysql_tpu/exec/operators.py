@@ -243,8 +243,44 @@ class HashAggOp(Operator):
                        expr_cache_key(a.arg) if a.arg is not None else None)
                       for a in self.aggs))
 
+    MATMUL_AGG_MAX_DOMAIN = 64
+
+    def _matmul_domains(self) -> Optional[List[int]]:
+        """Static key domains if the MXU one-hot matmul agg applies, else None.
+
+        Eligible when every group key has a small statically known domain
+        (dictionary string or boolean — dict codes are guaranteed < len(dict)),
+        and no SUM runs over floats (byte-limb decomposition is integer-exact
+        only).  Global aggregation (no keys) is domain 1 and always eligible:
+        it turns the lexsort into plain masked reductions."""
+        inputs, lanes = self._partial_specs()
+        for _name, spec in lanes:
+            if spec.kind == "sum" and spec.arg >= 0:
+                e = inputs[spec.arg]
+                if e.dtype.clazz == dt.TypeClass.FLOAT:
+                    return None
+        domains: List[int] = []
+        total = 1
+        for _n, e in self.group_exprs:
+            if e.dtype.clazz == dt.TypeClass.BOOL:
+                dom = 2
+            elif e.dtype.is_string:
+                d = _find_dictionary(e)
+                if d is None or len(d) == 0:
+                    return None
+                dom = len(d)
+            else:
+                return None
+            domains.append(dom)
+            total *= dom + 1  # +1: a NULL slot may be added per nullable key
+            if total > self.MATMUL_AGG_MAX_DOMAIN:
+                return None
+        return domains
+
     def _partial_fn(self, max_groups: int):
-        key = ("agg_partial", self._cache_key(), max_groups)
+        domains = self._matmul_domains()
+        key = ("agg_partial", self._cache_key(), max_groups,
+               tuple(domains) if domains is not None else None)
 
         def build():
             comp = ExprCompiler(jnp)
@@ -271,6 +307,10 @@ class HashAggOp(Operator):
                 n = batch.capacity
                 keys = [broadcast_value(n, *f(env)) for f in gfns]
                 ins = [broadcast_value(n, *f(env)) for f in ifns]
+                if domains is not None:
+                    # small-domain MXU path: one-hot int8 matmul, no lexsort
+                    return K.matmul_groupby(keys, ins, specs, batch.live_mask(),
+                                            domains)
                 return K.sort_groupby(keys, ins, specs, batch.live_mask(), max_groups)
             return jax.jit(run)
         return global_jit(key, build)

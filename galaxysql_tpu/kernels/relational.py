@@ -188,6 +188,139 @@ def sort_groupby(keys: Sequence[Tuple[Any, Optional[Any]]],
                          jnp.minimum(num_groups, max_groups).astype(jnp.int32), overflow)
 
 
+def matmul_groupby(keys: Sequence[Tuple[Any, Optional[Any]]],
+                   inputs: Sequence[Tuple[Any, Optional[Any]]],
+                   specs: Sequence[AggSpec],
+                   live: Any,
+                   domains: Sequence[int]) -> GroupByResult:
+    """Small-domain grouped aggregation on the MXU: one-hot int8 matmul, no sort.
+
+    When every group key has a statically known small domain (dictionary-encoded
+    strings, booleans), the group id enumerates the full key cross product and the
+    aggregation becomes `A^T @ onehot(gid)` — an int8 x int8 -> int32 matmul that
+    runs on the MXU systolic array instead of the O(n log n) lexsort of
+    `sort_groupby` (reference seam: `HashAggExec.java:37` + `AggOpenHashMap`).
+
+    Exact int64 sums via byte-limb decomposition: each 64-bit value contributes 8
+    bias-corrected byte lanes (byte - 128 fits int8); per-group limb sums are
+    recombined with shifts mod 2**64, so two's-complement wraparound reproduces
+    int64 arithmetic exactly.  min/max use masked reductions over the (tiny)
+    domain.  Floats are NOT supported for sum (caller falls back to sort_groupby).
+
+    Output slots enumerate the domain in (major key .. minor key) order with NULL
+    sorting last — the same group order sort_groupby produces — but live groups
+    are NOT compacted to a prefix; `live` marks the non-empty slots.  `overflow`
+    is always False (capacity is the static domain).
+    """
+    n = live.shape[0]
+    sizes: List[int] = []
+    effs: List[Any] = []
+    for (data, valid), dom in zip(keys, domains):
+        d = jnp.clip(data.astype(jnp.int32), 0, dom - 1)
+        size = dom + (1 if valid is not None else 0)
+        effs.append(d if valid is None else jnp.where(valid, d, jnp.int32(dom)))
+        sizes.append(size)
+    D = 1
+    for s in sizes:
+        D *= s
+    gid = jnp.zeros(n, dtype=jnp.int32)
+    for eff, size in zip(effs, sizes):
+        gid = gid * size + eff
+
+    # lane plan: [ones] + [present per distinct input] + [8 limbs per sum input]
+    present_lane: dict = {}
+    present_of: List[Any] = []
+    for spec in specs:
+        if spec.arg >= 0 and spec.arg not in present_lane:
+            dta, val = inputs[spec.arg]
+            present_lane[spec.arg] = len(present_of)
+            present_of.append(live if val is None else (live & val))
+    sum_args = sorted({s.arg for s in specs if s.kind in ("sum",) and s.arg >= 0})
+    lanes: List[Any] = [live.astype(jnp.int8)]
+    for a in present_of:
+        lanes.append(a.astype(jnp.int8))
+    limb_base: dict = {}
+    for a in sum_args:
+        dta, val = inputs[a]
+        pres = present_of[present_lane[a]]
+        v = jnp.where(pres, dta.astype(jnp.int64), jnp.int64(0))
+        limb_base[a] = len(lanes)
+        for j in range(8):
+            byte = ((v >> jnp.int64(8 * j)) & jnp.int64(0xFF)).astype(jnp.int32)
+            lanes.append((byte - 128).astype(jnp.int8))
+    A = jnp.stack(lanes, axis=1)  # [n, L] int8
+
+    # blocked contraction: int32 accumulators stay exact while n_chunk*127 < 2^31
+    CHUNK = 4_000_000
+    acc = jnp.zeros((A.shape[1], D), dtype=jnp.int64)
+    for s0 in range(0, max(n, 1), CHUNK):
+        s1 = min(s0 + CHUNK, n)
+        if s1 <= s0:
+            break
+        oh = (gid[s0:s1, None] == jnp.arange(D, dtype=jnp.int32)[None, :])
+        oh = (oh & live[s0:s1, None]).astype(jnp.int8)
+        part = jax.lax.dot_general(
+            A[s0:s1], oh, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        acc = acc + part.astype(jnp.int64)
+
+    # ones/present lanes were appended as raw 0/1 int8 (no bias): no correction
+    live_cnt = acc[0]
+    out_live = live_cnt > 0
+    num_groups = jnp.sum(out_live.astype(jnp.int32))
+
+    def decode_sum(a: int) -> Any:
+        base = limb_base[a]
+        total = jnp.zeros(D, dtype=jnp.int64)
+        for j in range(8):
+            byte_sum = acc[base + j] + 128 * live_cnt
+            total = total + (byte_sum << jnp.int64(8 * j))
+        return total
+
+    # output key lanes decode the slot index back into per-key codes
+    idx = jnp.arange(D, dtype=jnp.int32)
+    out_keys: List[Tuple[Any, Any]] = []
+    stride = D
+    for (data, valid), dom, size in zip(keys, domains, sizes):
+        stride //= size
+        slot = (idx // stride) % size
+        kd = jnp.clip(slot, 0, dom - 1).astype(data.dtype)
+        kv = None if valid is None else (slot < dom)
+        out_keys.append((kd, kv))
+
+    out_aggs: List[Tuple[Any, Any]] = []
+    for spec in specs:
+        if spec.kind == "count_star":
+            out_aggs.append((live_cnt.astype(jnp.int64), None))
+            continue
+        pres = present_of[present_lane[spec.arg]]
+        pres_cnt = acc[1 + present_lane[spec.arg]]
+        if spec.kind == "count":
+            out_aggs.append((pres_cnt.astype(jnp.int64), None))
+        elif spec.kind == "sum":
+            out_aggs.append((decode_sum(spec.arg), pres_cnt > 0))
+        elif spec.kind in ("min", "max"):
+            dta, _val = inputs[spec.arg]
+            if jnp.issubdtype(dta.dtype, jnp.floating):
+                neutral = jnp.array(np.inf if spec.kind == "min" else -np.inf,
+                                    dta.dtype)
+            else:
+                info = jnp.iinfo(dta.dtype)
+                neutral = jnp.array(info.max if spec.kind == "min" else info.min,
+                                    dta.dtype)
+            # masked reduce over the domain: [n, D] is generated, fused into the
+            # reduction by XLA (never materialized at full n x D for small D)
+            sel = (gid[:, None] == idx[None, :]) & pres[:, None]
+            m = jnp.where(sel, dta[:, None], neutral)
+            red = jnp.min(m, axis=0) if spec.kind == "min" else jnp.max(m, axis=0)
+            out_aggs.append((red, pres_cnt > 0))
+        else:
+            raise ValueError(f"unsupported matmul agg kind {spec.kind}")
+
+    return GroupByResult(tuple(out_keys), tuple(out_aggs), out_live,
+                         num_groups.astype(jnp.int32), jnp.bool_(False))
+
+
 def _segmented_scan(x, reset, is_min: bool):
     """Running min/max that restarts where `reset` is True (log-depth, no scatter).
 

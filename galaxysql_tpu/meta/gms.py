@@ -199,6 +199,14 @@ class MetaDb:
 
     # -- global transaction log ----------------------------------------------------
 
+    def kv_put(self, key: str, val: str):
+        self.execute("INSERT OR REPLACE INTO inst_config VALUES (?,?)", (key, val))
+
+    def kv_get(self, key: str) -> Optional[str]:
+        rows = self.query("SELECT param_val FROM inst_config WHERE param_key=?",
+                          (key,))
+        return rows[0][0] if rows else None
+
     def tx_log_put(self, txn_id: int, state: str, commit_ts: int = 0):
         self.execute("INSERT OR REPLACE INTO global_tx_log VALUES (?,?,?,?)",
                      (txn_id, state, commit_ts, time.time()))

@@ -66,6 +66,10 @@ class Instance:
                 if os.path.isdir(d):
                     store.load(d)
         self.archive.attach(self.metadb)
+        # resolve provisional ±txn_id MVCC stamps left by a crash against the
+        # durable tx log BEFORE anything reads the loaded partitions
+        from galaxysql_tpu.txn.xa import recover_persisted
+        recover_persisted(self)
         self.metadb.heartbeat(self.node_id, "coordinator", "127.0.0.1", 0)
         self.ddl_engine.recover()
 
@@ -94,9 +98,15 @@ class Instance:
         """Flush all table data + metadata to disk (checkpoint)."""
         if not self.data_dir:
             return
+        # marker time is captured BEFORE the store snapshots: a txn committing
+        # while save() runs may have provisional stamps in an already-written
+        # npz, so tx-log purge may only drop entries resolved before this point
+        import time
+        t0 = time.time()
         for key, store in self.stores.items():
             store.save(os.path.join(self.data_dir, key.replace(".", os.sep)))
             self.metadb.save_table(store.table)
+        self.metadb.kv_put("last_checkpoint_at", repr(t0))
 
     def allocate_conn_id(self) -> int:
         with self.lock:

@@ -55,9 +55,19 @@ def _run_analyze(instance, schema: str, table: str, params: dict) -> str:
 @job_kind("purge_tx_log")
 def _run_purge_tx_log(instance, schema: str, table: str, params: dict) -> str:
     keep_s = float(params.get("keep_seconds", 86400))
+    cutoff = time.time() - keep_s
+    if instance.data_dir:
+        # presumed-abort boot recovery resolves provisional stamps in the LAST
+        # CHECKPOINT against this log: an entry may only be purged once a later
+        # checkpoint has persisted the txn's final stamps — wall clock alone
+        # would let recovery roll back a committed txn from a stale npz
+        mark = instance.metadb.kv_get("last_checkpoint_at")
+        if mark is None:
+            return "purged 0 entries (no checkpoint yet)"
+        cutoff = min(cutoff, float(mark))
     cur = instance.metadb.execute(
-        "DELETE FROM global_tx_log WHERE state='DONE' AND updated < ?",
-        (time.time() - keep_s,))
+        "DELETE FROM global_tx_log WHERE state IN ('DONE','ABORTED') "
+        "AND updated < ?", (cutoff,))
     return f"purged {cur.rowcount} entries"
 
 

@@ -488,19 +488,18 @@ class Session:
                 self.instance.catalog.version += 1
             return
         commit_ts = self.instance.tso.next_timestamp()
-        for store, pid, start, n in txn.inserted:
-            p = store.partitions[pid]
-            with p.lock:
-                seg = p.begin_ts[start:start + n]
-                p.begin_ts[start:start + n] = np.where(seg == -txn.txn_id,
-                                                       commit_ts, seg)
-        for store, pid, row_ids, _old in txn.deleted:
-            p = store.partitions[pid]
-            with p.lock:
-                cur = p.end_ts[row_ids]
-                p.end_ts[row_ids] = np.where(cur == -txn.txn_id, commit_ts, cur)
-        for store in txn.touched_tables():
-            store.table.bump_version()  # invalidates device-cached ts lanes
+        # stamp via the XA participant helper (single home for the commit/rollback
+        # stamping invariants; bump_version per store included).  The commit point
+        # is logged FIRST: a crash mid-stamping would otherwise be resolved by
+        # boot recovery as presumed-abort on the not-yet-stamped stores only —
+        # a half-committed txn (base table vs GSI diverging).
+        from galaxysql_tpu.txn.xa import participants_of
+        parts = participants_of(txn)
+        if parts:
+            self.instance.metadb.tx_log_put(txn.txn_id, "COMMITTED", commit_ts)
+            for sp in parts:
+                sp.commit(commit_ts)
+            self.instance.metadb.tx_log_put(txn.txn_id, "DONE", commit_ts)
         if txn.inserted or txn.deleted:
             self.instance.catalog.version += 1
 
@@ -509,22 +508,12 @@ class Session:
         self.txn = None
         if txn is None:
             return
-        # undo: remove appended rows, restore end_ts on provisionally deleted rows
-        for store, pid, start, n in reversed(txn.inserted):
-            p = store.partitions[pid]
-            with p.lock:
-                keep = start
-                for c in store.table.columns:
-                    p.lanes[c.name] = p.lanes[c.name][:keep]
-                    p.valid[c.name] = p.valid[c.name][:keep]
-                p.begin_ts = p.begin_ts[:keep]
-                p.end_ts = p.end_ts[:keep]
-        for store, pid, row_ids, old_end in reversed(txn.deleted):
-            p = store.partitions[pid]
-            with p.lock:
-                p.end_ts[row_ids] = old_end
-        for store in txn.touched_tables():
-            store.table.bump_version()
+        # undo via the XA participant helper: stamps own appended rows permanently
+        # dead and restores provisional delete stamps — lanes never shrink (see
+        # StoreParticipant.rollback for the concurrent-writer invariant)
+        from galaxysql_tpu.txn.xa import participants_of
+        for sp in participants_of(txn):
+            sp.rollback()
 
     def _dml_ts(self) -> Tuple[int, Optional[Transaction]]:
         """Timestamp to stamp writes with: provisional (-txn_id) inside a transaction,
@@ -593,14 +582,7 @@ class Session:
                 continue
             if pred is None:
                 ids0 = np.nonzero(vis)[0]
-                own = -self.txn.txn_id if self.txn is not None else None
-                pend = p.end_ts[ids0]
-                conflict = (pend < 0)
-                if own is not None:
-                    conflict &= (pend != own)
-                if conflict.any():
-                    raise errors.TransactionError(
-                        "write conflict: row locked by a concurrent transaction")
+                self._check_write_conflict(p, ids0)
                 yield store, pid, ids0
                 continue
             env = {}
@@ -609,18 +591,24 @@ class Session:
             mask = pred(env) & vis
             ids = np.nonzero(mask)[0]
             if ids.size:
-                # first-writer-wins: a row provisionally deleted by ANOTHER live txn
-                # may not be written again (no lock waits -> no deadlocks; the
-                # reference's DeadlockDetectionTask becomes unnecessary by design)
-                own = -self.txn.txn_id if self.txn is not None else None
-                pend = p.end_ts[ids]
-                conflict = (pend < 0)
-                if own is not None:
-                    conflict &= (pend != own)
-                if conflict.any():
-                    raise errors.TransactionError(
-                        "write conflict: row locked by a concurrent transaction")
+                self._check_write_conflict(p, ids)
                 yield store, pid, ids
+
+    def _check_write_conflict(self, p, ids: np.ndarray):
+        """First-writer-wins SI: a row may be re-written only while its end stamp
+        is INFINITY (or our own provisional stamp).  A provisional -txn stamp means
+        a live txn holds it; a committed end_ts > our snapshot means a later
+        committer already deleted it — overwriting either would lose that write
+        (no lock waits -> no deadlocks; the reference's DeadlockDetectionTask
+        becomes unnecessary by design)."""
+        own = -self.txn.txn_id if self.txn is not None else None
+        pend = p.end_ts[ids]
+        conflict = pend != INFINITY_TS
+        if own is not None:
+            conflict &= (pend != own)
+        if conflict.any():
+            raise errors.TransactionError(
+                "write conflict: row locked or deleted by a concurrent transaction")
 
     def _run_delete(self, stmt: ast.Delete, params: Optional[list]) -> ResultSet:
         schema = self._require_schema()
@@ -629,9 +617,14 @@ class Session:
         alias = (stmt.table.alias or stmt.table.table).lower()
         n = 0
         for store, pid, ids in self._dml_match(tm, stmt.where, params, alias):
-            old_end = store.partitions[pid].end_ts[ids].copy()
-            self._gsi_delete(tm, store, pid, ids, ts, txn)
-            store.partitions[pid].delete_rows(ids, ts)
+            p = store.partitions[pid]
+            with p.lock:
+                # re-check under the lock: the check in _dml_match and this stamp
+                # are otherwise not atomic against the archiver/other sessions
+                self._check_write_conflict(p, ids)
+                old_end = p.end_ts[ids].copy()
+                self._gsi_delete(tm, store, pid, ids, ts, txn)
+                p.delete_rows(ids, ts)
             if txn is not None:
                 txn.deleted.append((store, pid, ids, old_end))
             n += ids.size
@@ -664,27 +657,33 @@ class Session:
         n = 0
         for store, pid, ids in self._dml_match(tm, stmt.where, params, alias):
             p = store.partitions[pid]
-            env = {}
-            for c in tm.columns:
-                env[f"{alias}.{c.name}"] = (p.lanes[c.name][ids], p.valid[c.name][ids])
-            new_lanes: Dict[str, np.ndarray] = {}
-            new_valid: Dict[str, np.ndarray] = {}
-            for cname, fn in sets:
-                cm = tm.column(cname)
-                d, v = fn(env)
-                d = np.broadcast_to(np.asarray(d), (ids.size,)).astype(cm.dtype.lane)
-                vm = np.ones(ids.size, np.bool_) if v is None else \
-                    np.broadcast_to(np.asarray(v), (ids.size,))
-                new_lanes[cm.name] = d
-                new_valid[cm.name] = vm.copy()
-            old_end = p.end_ts[ids].copy()
-            self._gsi_delete(tm, store, pid, ids, ts, txn)
-            start = p.num_rows
-            p.update_rows(ids, new_lanes, new_valid, ts)
-            if txn is not None:
-                txn.deleted.append((store, pid, ids, old_end))
-                txn.inserted.append((store, pid, start, ids.size))
-            self._gsi_write_rows(tm, store, pid, start, ids.size, ts, txn)
+            with p.lock:
+                # re-check under the lock (see _run_delete) and read the lanes at
+                # a consistent length with the stamp we are about to write
+                self._check_write_conflict(p, ids)
+                env = {}
+                for c in tm.columns:
+                    env[f"{alias}.{c.name}"] = (p.lanes[c.name][ids],
+                                                p.valid[c.name][ids])
+                new_lanes: Dict[str, np.ndarray] = {}
+                new_valid: Dict[str, np.ndarray] = {}
+                for cname, fn in sets:
+                    cm = tm.column(cname)
+                    d, v = fn(env)
+                    d = np.broadcast_to(np.asarray(d),
+                                        (ids.size,)).astype(cm.dtype.lane)
+                    vm = np.ones(ids.size, np.bool_) if v is None else \
+                        np.broadcast_to(np.asarray(v), (ids.size,))
+                    new_lanes[cm.name] = d
+                    new_valid[cm.name] = vm.copy()
+                old_end = p.end_ts[ids].copy()
+                self._gsi_delete(tm, store, pid, ids, ts, txn)
+                start = p.num_rows
+                p.update_rows(ids, new_lanes, new_valid, ts)
+                if txn is not None:
+                    txn.deleted.append((store, pid, ids, old_end))
+                    txn.inserted.append((store, pid, start, ids.size))
+                self._gsi_write_rows(tm, store, pid, start, ids.size, ts, txn)
             n += ids.size
         tm.bump_version()
         self.instance.catalog.version += 1

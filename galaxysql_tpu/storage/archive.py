@@ -32,7 +32,8 @@ except ImportError:  # pragma: no cover
 
 _MANIFEST_SCHEMA = """
 CREATE TABLE IF NOT EXISTS archive_files (
-    path TEXT PRIMARY KEY, table_key TEXT, archive_ts INTEGER, state TEXT);
+    path TEXT PRIMARY KEY, table_key TEXT, archive_ts INTEGER, state TEXT,
+    arc_txn INTEGER DEFAULT 0);
 """
 
 
@@ -58,15 +59,39 @@ class ArchiveManager:
         self.metadb = metadb
         with metadb._lock:
             metadb._conn.executescript(_MANIFEST_SCHEMA)
+            cols = [r[1] for r in metadb._conn.execute(
+                "PRAGMA table_info(archive_files)")]
+            if "arc_txn" not in cols:  # migrate pre-arc_txn manifests
+                metadb._conn.execute("ALTER TABLE archive_files "
+                                     "ADD COLUMN arc_txn INTEGER DEFAULT 0")
             metadb._conn.commit()
         with self._lock:
             self._files.clear()
-        for path, key, ats, state in metadb.query(
-                "SELECT path, table_key, archive_ts, state FROM archive_files"):
+        for path, key, ats, state, arc_txn in metadb.query(
+                "SELECT path, table_key, archive_ts, state, arc_txn "
+                "FROM archive_files"):
             if state == "LIVE" and os.path.exists(path):
                 with self._lock:
                     self._files.setdefault(key, []).append((path, ats))
-            else:  # PENDING: hot rows were never deleted; discard the orphan
+                continue
+            # PENDING: decided by the archive txn's commit point in the tx log
+            # (recover_persisted re-commits/rolls back the hot-store stamps the
+            # same way, so file and store stay consistent)
+            log = metadb.tx_log_get(arc_txn) if arc_txn else None
+            if log is not None and log[0] in ("COMMITTED", "DONE") and \
+                    os.path.exists(path):
+                metadb.execute("UPDATE archive_files SET state='LIVE' "
+                               "WHERE path=?", (path,))
+                with self._lock:
+                    self._files.setdefault(key, []).append((path, ats))
+            else:
+                # no commit point — or a commit point whose file did not survive
+                # the crash (parquet unsynced at power loss): discard the file
+                # and force the txn ABORTED so recover_persisted (which runs
+                # after attach) rolls the hot-row stamps back instead of
+                # re-committing a delete whose archive copy no longer exists
+                if arc_txn and log is not None and log[0] in ("COMMITTED",):
+                    metadb.tx_log_put(arc_txn, "ABORTED")
                 try:
                     os.unlink(path)
                 except OSError:
@@ -108,61 +133,99 @@ class ArchiveManager:
         cm = tm.column(ttl_column)
         if not cm.dtype.clazz == dt.TypeClass.DATE:
             raise errors.TddlError("TTL column must be a DATE")
+        from galaxysql_tpu.storage.table_store import INFINITY_TS
         ts = snapshot_ts or instance.tso.next_timestamp()
         total = 0
-        tables = []
+        # One file per partition, archived as a mini 2PC with the hot store as the
+        # participant and the parquet file as the other, so the slow encode runs
+        # WITHOUT the partition lock while staying race-free against session DML
+        # (this job runs on the scheduler thread):
+        #   1. under lock: select expired rows, stamp a provisional write intent
+        #      (-arc_txn) on them, copy their lanes.  The intent makes concurrent
+        #      DML on those rows a write conflict (sessions re-check under the
+        #      lock); readers still see them hot.
+        #   2. no lock: encode + write the parquet, manifest PENDING (+arc_txn),
+        #      then log the commit point (tx_log COMMITTED @ archive_ts).
+        #   3. commit the intent to archive_ts via StoreParticipant (bumps the
+        #      table version -> invalidates device-cached ts lanes), THEN flip
+        #      the manifest LIVE — readers never observe a row hot and archived.
+        # Crash recovery: before the commit point, recover_persisted rolls the
+        # -arc_txn stamps back and attach() discards the PENDING file; after it,
+        # recover_persisted re-commits the stamps at archive_ts and attach()
+        # promotes the PENDING file to LIVE — both sides always agree with the
+        # logged decision.
+        from galaxysql_tpu.txn.xa import StoreParticipant
         for p in store.partitions:
-            vis = p.visible_mask(ts)
-            # NULL TTL values never expire
-            old = vis & p.valid[cm.name] & (p.lanes[cm.name] < cutoff_days)
-            ids = np.nonzero(old)[0]
-            if not ids.size:
-                continue
-            arrays = {}
-            for c in tm.columns:
-                lane = p.lanes[c.name][ids]
-                valid = p.valid[c.name][ids]
-                if c.dtype.is_string:
-                    d = tm.dictionaries[c.name.lower()]
-                    values = [d.values[code] if ok and 0 <= code < len(d.values)
-                              else None
-                              for code, ok in zip(lane.tolist(), valid.tolist())]
-                    arrays[c.name] = pa.array(values, type=pa.string())
-                else:
-                    arrays[c.name] = pa.array(
-                        [v if ok else None
-                         for v, ok in zip(lane.tolist(), valid.tolist())])
-            tables.append(pa.table(arrays))
+            arc_txn = instance.tso.next_timestamp()
+            with p.lock:
+                vis = p.visible_mask(ts)
+                # NULL TTL values never expire.  Rows with ANY pending end stamp
+                # (provisional -txn delete, or a delete committed after our
+                # snapshot) stay hot: archiving them and then having the delete
+                # resolve the other way would resurrect/duplicate the row.
+                old = (vis & (p.end_ts == INFINITY_TS) & p.valid[cm.name]
+                       & (p.lanes[cm.name] < cutoff_days))
+                ids = np.nonzero(old)[0]
+                if not ids.size:
+                    continue
+                p.end_ts[ids] = -arc_txn
+                snap = {c.name: (p.lanes[c.name][ids].copy(),
+                                 p.valid[c.name][ids].copy())
+                        for c in tm.columns}
+            sp = StoreParticipant(store, arc_txn)
+            sp.deleted.append((p.pid, ids,
+                               np.full(ids.size, INFINITY_TS, dtype=np.int64)))
+            try:
+                arrays = {}
+                for c in tm.columns:
+                    lane, valid = snap[c.name]
+                    if c.dtype.is_string:
+                        d = tm.dictionaries[c.name.lower()]
+                        values = [d.values[code]
+                                  if ok and 0 <= code < len(d.values) else None
+                                  for code, ok in zip(lane.tolist(),
+                                                      valid.tolist())]
+                        arrays[c.name] = pa.array(values, type=pa.string())
+                    else:
+                        arrays[c.name] = pa.array(
+                            [v if ok else None
+                             for v, ok in zip(lane.tolist(), valid.tolist())])
+                with self._lock:
+                    self._seq += 1
+                    path = os.path.join(
+                        self._dir_for(key), f"archive_{ts}_{self._seq}.parquet")
+                pq.write_table(pa.table(arrays), path)
+                fd = os.open(path, os.O_RDONLY)  # durable BEFORE the commit point
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+                archive_ts = instance.tso.next_timestamp()
+                if self.metadb is not None:
+                    self.metadb.execute(
+                        "INSERT OR REPLACE INTO archive_files VALUES (?,?,?,?,?)",
+                        (path, key, archive_ts, "PENDING", arc_txn))
+                    # commit point: from here the archival is decided
+                    self.metadb.tx_log_put(arc_txn, "COMMITTED", archive_ts)
+            except Exception:
+                sp.rollback()  # release the write intent; rows stay hot
+                if self.metadb is not None:
+                    self.metadb.tx_log_put(arc_txn, "ABORTED")
+                try:  # drop the partial parquet: nothing references it
+                    os.unlink(path)
+                except (OSError, UnboundLocalError):
+                    pass
+                raise
+            sp.commit(archive_ts)
+            tm.stats.row_count = store.row_count()
+            instance.catalog.version += 1
+            if self.metadb is not None:
+                self.metadb.execute("UPDATE archive_files SET state='LIVE' "
+                                    "WHERE path=?", (path,))
+                self.metadb.tx_log_put(arc_txn, "DONE", archive_ts)
+            with self._lock:
+                self._files.setdefault(key, []).append((path, archive_ts))
             total += ids.size
-            # delete AFTER the write below; remember ids per partition
-            p._archive_pending = ids  # type: ignore
-        if not tables:
-            return 0
-        merged = pa.concat_tables(tables)
-        with self._lock:
-            self._seq += 1
-            path = os.path.join(self._dir_for(key),
-                                f"archive_{ts}_{self._seq}.parquet")
-        pq.write_table(merged, path)
-        archive_ts = instance.tso.next_timestamp()
-        if self.metadb is not None:
-            self.metadb.execute("INSERT OR REPLACE INTO archive_files VALUES "
-                                "(?,?,?,?)", (path, key, archive_ts, "PENDING"))
-        # drop archived rows from the hot store, THEN publish the file: readers
-        # never observe a row both hot and archived
-        for p in store.partitions:
-            ids = getattr(p, "_archive_pending", None)
-            if ids is not None and len(ids):
-                p.delete_rows(ids, archive_ts)
-                p._archive_pending = None  # type: ignore
-        if self.metadb is not None:
-            self.metadb.execute("UPDATE archive_files SET state='LIVE' "
-                                "WHERE path=?", (path,))
-        with self._lock:
-            self._files.setdefault(key, []).append((path, archive_ts))
-        tm.stats.row_count = store.row_count()
-        tm.bump_version()
-        instance.catalog.version += 1
         return total
 
     def scan_archive(self, instance, schema: str, table: str,

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from galaxysql_tpu.storage.table_store import INFINITY_TS
 from galaxysql_tpu.utils import errors
 from galaxysql_tpu.utils.failpoint import FAIL_POINTS, FP_BEFORE_COMMIT
 
@@ -64,19 +65,25 @@ class StoreParticipant:
         self.store.table.bump_version()
 
     def rollback(self):
+        """Stamp own provisional inserts permanently dead (begin=INF, end=0) —
+        never truncate lanes: concurrent writers hold offsets into the same
+        partition and physical shrink would destroy their committed rows."""
+        own = -self.txn_id
         for pid, start, n in reversed(self.inserted):
             p = self.store.partitions[pid]
             with p.lock:
-                keep = start
-                for c in self.store.table.columns:
-                    p.lanes[c.name] = p.lanes[c.name][:keep]
-                    p.valid[c.name] = p.valid[c.name][:keep]
-                p.begin_ts = p.begin_ts[:keep]
-                p.end_ts = p.end_ts[:keep]
+                seg = p.begin_ts[start:start + n]
+                mine = seg == own
+                p.begin_ts[start:start + n] = np.where(mine, INFINITY_TS, seg)
+                end = p.end_ts[start:start + n]
+                p.end_ts[start:start + n] = np.where(mine, 0, end)
         for pid, row_ids, old_end in reversed(self.deleted):
             p = self.store.partitions[pid]
             with p.lock:
-                p.end_ts[row_ids] = old_end
+                # only where the provisional stamp is still ours: an own
+                # insert-then-delete row was already stamped dead above
+                cur = p.end_ts[row_ids]
+                p.end_ts[row_ids] = np.where(cur == own, old_end, cur)
         self.store.table.bump_version()
 
 
@@ -96,6 +103,63 @@ def participants_of(txn) -> List[StoreParticipant]:
     for store, pid, row_ids, old_end in txn.deleted:
         get(store).deleted.append((pid, row_ids, old_end))
     return list(by_store.values())
+
+
+def recover_persisted(instance) -> Dict[int, str]:
+    """Boot-time XA recovery: scan loaded partitions for provisional ±txn_id stamps
+    left behind by a crash and resolve each against the durable global_tx_log
+    (XARecoverTask analog — reference `transaction/async/XARecoverTask.java` scans
+    DN `XA RECOVER` output against the trx log, SURVEY.md §3.4).
+
+    A txn with a logged COMMITTED/DONE commit point is re-committed at that
+    commit_ts; anything else (PREPARED, ABORTED, or absent from the log) rolls
+    back: provisional deletes are restored to INFINITY first, then provisional
+    inserts are stamped permanently dead — that order makes insert-then-delete
+    rows end as (INF, 0), invisible on every visibility path."""
+    out: Dict[int, str] = {}
+    resolutions: Dict[int, Optional[int]] = {}  # txn_id -> commit_ts or None
+
+    def resolve(txn_id: int) -> Optional[int]:
+        if txn_id not in resolutions:
+            state = instance.metadb.tx_log_get(txn_id)
+            if state is not None and state[0] in ("COMMITTED", "DONE") and state[1]:
+                resolutions[txn_id] = state[1]
+            else:
+                resolutions[txn_id] = None
+        return resolutions[txn_id]
+
+    for store in instance.stores.values():
+        for p in store.partitions:
+            with p.lock:
+                bneg = p.begin_ts < 0
+                eneg = p.end_ts < 0
+                if not (bneg.any() or eneg.any()):
+                    continue
+                ids = np.unique(np.concatenate(
+                    [-p.begin_ts[bneg], -p.end_ts[eneg]])).astype(np.int64)
+                for txn_id in (int(t) for t in ids):
+                    own = -txn_id
+                    commit_ts = resolve(txn_id)
+                    if commit_ts is not None:
+                        p.begin_ts[p.begin_ts == own] = commit_ts
+                        p.end_ts[p.end_ts == own] = commit_ts
+                        out[txn_id] = "committed"
+                    else:
+                        p.end_ts[p.end_ts == own] = INFINITY_TS
+                        mine = p.begin_ts == own
+                        p.begin_ts[mine] = INFINITY_TS
+                        p.end_ts[mine] = 0
+                        out[txn_id] = "rolled_back"
+    for txn_id, res in out.items():
+        if res == "committed":
+            instance.metadb.tx_log_put(txn_id, "DONE", resolutions[txn_id])
+        else:
+            instance.metadb.tx_log_put(txn_id, "ABORTED")
+    if out:
+        for store in instance.stores.values():
+            store.table.bump_version()
+        instance.catalog.version += 1
+    return out
 
 
 class TwoPhaseCoordinator:

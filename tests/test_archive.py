@@ -68,10 +68,12 @@ class TestArchive:
         store, base = self.load(s, n=100)
         s.instance.archive.archive_older_than(s.instance, "c", "ev", "d",
                                               base + 1000)
-        t = pq.read_table(s.instance.archive.files_for("c.ev")[0])
-        assert t.num_rows == 100
-        assert set(t.column_names) == {"id", "d", "tag", "v"}
-        assert t.column("tag").to_pylist()[0] in ("a", "b")
+        # one file per partition (written under the partition lock)
+        tabs = [pq.read_table(f) for f in s.instance.archive.files_for("c.ev")]
+        assert sum(t.num_rows for t in tabs) == 100
+        for t in tabs:
+            assert set(t.column_names) == {"id", "d", "tag", "v"}
+            assert t.column("tag").to_pylist()[0] in ("a", "b")
 
 
 class TestArchiveCrashSafety:
@@ -93,6 +95,43 @@ class TestArchiveCrashSafety:
         s2 = Session(inst2, "c")
         assert s2.execute("SELECT count(*) FROM ev").rows == [(100,)]
         assert inst2.archive.files_for("c.ev")
+        s2.close()
+
+    def test_pending_with_commit_point_promotes_on_boot(self, tmp_path):
+        """Crash between the tx-log commit point and the LIVE manifest flip:
+        boot must promote the PENDING file and re-commit the hot-store stamps
+        (file and store always agree with the logged decision)."""
+        d = str(tmp_path / "data")
+        inst = Instance(data_dir=d)
+        s = Session(inst)
+        s.execute("CREATE DATABASE c; USE c")
+        s.execute("CREATE TABLE ev (id BIGINT, d DATE)")
+        base = temporal.parse_date("2020-01-01")
+        inst.store("c", "ev").insert_arrays(
+            {"id": np.arange(100), "d": base + np.arange(100)},
+            inst.tso.next_timestamp())
+        n = inst.archive.archive_older_than(inst, "c", "ev", "d", base + 50)
+        assert n == 50
+        # simulate the crash window: demote the manifest to PENDING + tx log
+        # rewound to COMMITTED, stamps rewound to the provisional intent
+        rows = inst.metadb.query(
+            "SELECT path, arc_txn, archive_ts FROM archive_files")
+        for path, arc_txn, ats in rows:
+            inst.metadb.execute(
+                "UPDATE archive_files SET state='PENDING' WHERE path=?", (path,))
+            inst.metadb.tx_log_put(arc_txn, "COMMITTED", ats)
+            for p in inst.store("c", "ev").partitions:
+                mine = p.end_ts == ats
+                p.end_ts[mine] = -arc_txn
+        inst.save()
+        s.close()
+        inst2 = Instance(data_dir=d)
+        s2 = Session(inst2, "c")
+        # no lost rows, no duplicates: 50 hot + 50 archived exactly once
+        assert s2.execute("SELECT count(*) FROM ev").rows == [(100,)]
+        states = {st for (st,) in inst2.metadb.query(
+            "SELECT state FROM archive_files")}
+        assert states == {"LIVE"}
         s2.close()
 
     def test_snapshot_never_double_counts(self, session):
