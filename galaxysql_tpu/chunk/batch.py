@@ -108,6 +108,27 @@ def dictionary_translation(target: Dictionary, source: Dictionary) -> np.ndarray
                     dtype=np.int32)
 
 
+_UNION_TRANS_CACHE: Dict[Tuple[int, int, int], np.ndarray] = {}
+
+
+def dictionary_union_translation(target: Dictionary,
+                                 source: Dictionary) -> np.ndarray:
+    """trans[source_code] = target_code, EXTENDING target with values it lacks
+    (UNION semantics: every source string must exist in the output dictionary).
+
+    Cached by (target uid, source uid, len(source)): codes never change once
+    assigned, so a cached table stays valid as either dictionary grows."""
+    key = (target.uid, source.uid, len(source))
+    t = _UNION_TRANS_CACHE.get(key)
+    if t is None:
+        t = np.array([target.encode_one(v) for v in source.values] or [0],
+                     dtype=np.int32)
+        if len(_UNION_TRANS_CACHE) > 4096:
+            _UNION_TRANS_CACHE.clear()
+        _UNION_TRANS_CACHE[key] = t
+    return t
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class Column:

@@ -975,6 +975,12 @@ class WindowOp(Operator):
             return jax.jit(run)
 
         cols, live_s, outs = global_jit(key, build)(padded)
+        yield self.finalize_calls(cols, live_s, outs, lanes)
+
+    def finalize_calls(self, cols, live_s, outs, lanes) -> ColumnBatch:
+        """Attach the window-call outputs to the permuted payload columns;
+        avg = sum/count with MySQL decimal scale (shared with the MPP engine)."""
+        cols = dict(cols)
         lane_map = {name: outs[i] for i, (name, _) in enumerate(lanes)}
         for c in self.calls:
             rt = c.dtype
@@ -1001,4 +1007,4 @@ class WindowOp(Operator):
                 dic = _find_dictionary(c.arg) if (c.arg is not None and
                                                   c.arg.dtype.is_string) else None
                 cols[c.out_id] = Column(d, v, rt, dic)
-        yield ColumnBatch(cols, live_s)
+        return ColumnBatch(cols, live_s)

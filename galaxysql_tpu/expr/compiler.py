@@ -779,6 +779,8 @@ def _find_dictionary(e: ir.Expr) -> Optional[Dictionary]:
         return e.dictionary
     if isinstance(e, ir.ColRef):
         return e.dictionary
+    if isinstance(e, ir.Literal) and e.dictionary is not None:
+        return e.dictionary
     for c in e.children():
         if c.dtype.is_string:
             d = _find_dictionary(c)

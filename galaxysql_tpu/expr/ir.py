@@ -55,9 +55,15 @@ class ColRef(Expr):
 class Literal(Expr):
     value: Any  # python-domain value (Decimal scaled NOT applied; raw int/float/str/None)
     dtype: dt.DataType
+    # typed NULL group-key slots (grouping-sets expansion) carry the column's
+    # dictionary so the unioned output decodes sibling branches' codes
+    dictionary: Optional[Dictionary] = None
 
     def key(self):
-        return ("lit", self.value, self.dtype.sql_name())
+        if self.dictionary is None:
+            return ("lit", self.value, self.dtype.sql_name())
+        return ("lit", self.value, self.dtype.sql_name(),
+                self.dictionary.uid, len(self.dictionary))
 
     def __repr__(self):
         return repr(self.value)

@@ -122,10 +122,24 @@ class TableMeta:
         self.stats.version += 1
 
 
+@dataclasses.dataclass
+class ViewDef:
+    """A stored view: name + optional explicit column names + the SELECT text.
+
+    Expanded at bind time like the reference's `DrdsViewExpander` — the stored
+    SQL re-parses and re-binds per reference, so views always reflect current
+    base-table metadata."""
+    schema: str
+    name: str
+    columns: Optional[List[str]]
+    sql: str
+
+
 class SchemaMeta:
     def __init__(self, name: str):
         self.name = name
         self.tables: Dict[str, TableMeta] = {}
+        self.views: Dict[str, ViewDef] = {}
 
     def table(self, name: str) -> TableMeta:
         t = self.tables.get(name.lower())
@@ -169,6 +183,31 @@ class Catalog:
 
     def table(self, schema: str, name: str) -> TableMeta:
         return self.schema(schema).table(name)
+
+    def view(self, schema: str, name: str) -> Optional[ViewDef]:
+        s = self.schemas.get(schema.lower())
+        return s.views.get(name.lower()) if s is not None else None
+
+    def add_view(self, v: ViewDef, or_replace: bool = False) -> None:
+        s = self.schema(v.schema)
+        key = v.name.lower()
+        if key in s.views and not or_replace:
+            raise errors.TableExistsError(f"View '{v.name}' already exists")
+        if key in s.tables:
+            raise errors.TableExistsError(f"'{v.name}' is a base table")
+        s.views[key] = v
+        self.version += 1
+
+    def drop_view(self, schema: str, name: str, if_exists: bool = False) -> bool:
+        s = self.schema(schema)
+        key = name.lower()
+        if key not in s.views:
+            if if_exists:
+                return False
+            raise errors.UnknownTableError(f"Unknown view '{schema}.{name}'")
+        del s.views[key]
+        self.version += 1
+        return True
 
     def add_table(self, tm: TableMeta, if_not_exists: bool = False) -> bool:
         s = self.schema(tm.schema)

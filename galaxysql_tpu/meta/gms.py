@@ -51,6 +51,9 @@ CREATE TABLE IF NOT EXISTS node_info (
     node_id TEXT PRIMARY KEY, role TEXT, host TEXT, port INTEGER, heartbeat REAL);
 CREATE TABLE IF NOT EXISTS global_tx_log (
     txn_id INTEGER PRIMARY KEY, state TEXT, commit_ts INTEGER, updated REAL);
+CREATE TABLE IF NOT EXISTS views (
+    schema_name TEXT, view_name TEXT, columns_json TEXT, view_sql TEXT,
+    PRIMARY KEY (schema_name, view_name));
 """
 
 
@@ -116,6 +119,15 @@ class MetaDb:
         self.execute("DELETE FROM tables WHERE schema_name=? AND table_name=?",
                      (schema.lower(), name.lower()))
 
+    def save_view(self, v):
+        self.execute("INSERT OR REPLACE INTO views VALUES (?,?,?,?)",
+                     (v.schema.lower(), v.name.lower(),
+                      json.dumps(v.columns), v.sql))
+
+    def drop_view(self, schema: str, name: str):
+        self.execute("DELETE FROM views WHERE schema_name=? AND view_name=?",
+                     (schema.lower(), name.lower()))
+
     def save_schema(self, name: str):
         self.execute("INSERT OR IGNORE INTO schemata VALUES (?,?)",
                      (name.lower(), time.time()))
@@ -151,6 +163,12 @@ class MetaDb:
             catalog.create_schema(sname, if_not_exists=True)
             catalog.add_table(tm, if_not_exists=True)
             loaded.append(tm)
+        from galaxysql_tpu.meta.catalog import ViewDef
+        for sname, vname, cols_json, vsql in self.query(
+                "SELECT schema_name, view_name, columns_json, view_sql FROM views"):
+            catalog.create_schema(sname, if_not_exists=True)
+            catalog.add_view(ViewDef(sname, vname, json.loads(cols_json), vsql),
+                             or_replace=True)
         return loaded
 
     # -- config listener ------------------------------------------------------

@@ -24,7 +24,8 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from galaxysql_tpu.chunk.batch import Column, ColumnBatch, dictionary_translation
+from galaxysql_tpu.chunk.batch import (Column, ColumnBatch, Dictionary,
+                                       dictionary_translation)
 from galaxysql_tpu.exec.operators import (AggCall, HashAggOp, SortOp, SourceOp,
                                           broadcast_value, bucket_capacity,
                                           expr_cache_key, global_jit)
@@ -153,6 +154,10 @@ class MppExecutor:
             return self._sort(node)
         if isinstance(node, L.Limit):
             return self._limit(node)
+        if isinstance(node, L.Window):
+            return self._window(node)
+        if isinstance(node, L.Union):
+            return self._union(node)
         raise errors.NotSupportedError(f"MPP: {type(node).__name__}")
 
     # -- scan ---------------------------------------------------------------------
@@ -160,17 +165,53 @@ class MppExecutor:
     def _scan(self, node: L.Scan) -> DistBatch:
         t = node.table
         key = f"{t.schema.lower()}.{t.name.lower()}"
-        am = getattr(self.ctx, "archive", None)
-        if am is not None and am.files_for(key):
-            # cold parquet rows are not mesh-resident yet: run on the local engine
-            raise errors.NotSupportedError("MPP over archived tables")
         store = self.ctx.stores[key]
         storage_cols = [c for _, c in node.columns]
         st = GLOBAL_MESH_CACHE.get(store, self.mesh, storage_cols,
                                    self.ctx.snapshot_ts, self.ctx.txn_id)
         cols = {oid: st.columns[cname] for oid, cname in node.columns}
         self.ctx.trace.append(f"mpp-scan {t.name} shards={self.S}")
-        return DistBatch(cols, st.live, False)
+        hot = DistBatch(cols, st.live, False)
+        am = getattr(self.ctx, "archive", None)
+        if am is None or not am.files_for(key, self.ctx.snapshot_ts):
+            return hot
+        return self._concat_shards([hot, self._archive_scan(node, am, key)])
+
+    def _archive_scan(self, node: L.Scan, am, key: str) -> DistBatch:
+        """Cold parquet rows row-sharded over the mesh: host-read, padded to a
+        multiple of S, laid out so shard s owns slice s (OSSTableScanExec analog;
+        archive scans join the same MPP plan as hot data)."""
+        from galaxysql_tpu.exec.operators import concat_batches
+        inst = getattr(self.ctx, "archive_instance", None)
+        t = node.table
+        storage_cols = [c for _, c in node.columns]
+        batches = list(am.scan_archive(inst, t.schema, t.name, storage_cols,
+                                       self.ctx.snapshot_ts))
+        merged = concat_batches(batches)
+        n = merged.capacity
+        Ra = max((n + self.S - 1) // self.S, 1)
+        cols = {}
+        for oid, cname in node.columns:
+            c = merged.columns.get(cname) if n else None
+            cm = t.column(cname)
+            if c is None:
+                data = np.zeros(self.S * Ra, dtype=cm.dtype.lane)
+                valid = None
+            else:
+                data = np.zeros(self.S * Ra, dtype=np.asarray(c.np_data()).dtype)
+                data[:n] = c.np_data()
+                valid = None
+                if c.valid is not None:
+                    valid = np.zeros(self.S * Ra, dtype=np.bool_)
+                    valid[:n] = c.np_valid()
+            dic = t.dictionaries.get(cname.lower()) if cm.dtype.is_string else None
+            cols[oid] = Column(jnp.asarray(data),
+                               None if valid is None else jnp.asarray(valid),
+                               cm.dtype, dic)
+        live = np.zeros(self.S * Ra, dtype=np.bool_)
+        live[:n] = True
+        self.ctx.trace.append(f"mpp-scan-archive {t.name} rows={n}")
+        return DistBatch(cols, jnp.asarray(live), False)
 
     # -- stateless row ops ---------------------------------------------------------
 
@@ -213,7 +254,12 @@ class MppExecutor:
     def _aggregate(self, node: L.Aggregate) -> DistBatch:
         child = self.run(node.child)
         calls = [AggCall(a.kind, a.arg, a.out_id) for a in node.aggs]
-        helper = HashAggOp(None, node.groups, calls)  # spec decomposition + finalize
+        return self._aggregate_batch(child, node.groups, calls,
+                                     estimate_rows(node))
+
+    def _aggregate_batch(self, child: DistBatch, groups, calls,
+                         est: float) -> DistBatch:
+        helper = HashAggOp(None, groups, calls)  # spec decomposition + finalize
         inputs, lanes = helper._partial_specs()
         lane_names = tuple(name for name, _ in lanes)
         specs = tuple(s for _, s in lanes)
@@ -221,10 +267,10 @@ class MppExecutor:
             K.AggSpec("sum" if s.kind in ("count", "count_star", "sum") else s.kind, i)
             for i, (_, s) in enumerate(lanes))
 
-        est = estimate_rows(node)
         G = 1 << max(int(est * 2).bit_length(), 8)
         while True:
-            r, overflow = self._agg_round(node, child, inputs, specs, merge_specs, G)
+            r, overflow = self._agg_round(groups, child, inputs, specs,
+                                          merge_specs, G)
             if not overflow:
                 break
             G *= 2
@@ -233,14 +279,14 @@ class MppExecutor:
         batch = helper._finalize(jax.tree.map(jnp.asarray, r), lane_names)
         return DistBatch(batch.columns, batch.live_mask(), True)
 
-    def _agg_round(self, node, child, inputs, specs, merge_specs, G):
-        key = ("mpp_agg", tuple((n, expr_cache_key(e)) for n, e in node.groups),
+    def _agg_round(self, groups, child, inputs, specs, merge_specs, G):
+        key = ("mpp_agg", tuple((n, expr_cache_key(e)) for n, e in groups),
                tuple(expr_cache_key(e) for e in inputs), specs, G,
                child.replicated, self.S)
 
         def build():
             comp = ExprCompiler(jnp)
-            gfns = [comp.compile(e) for _, e in node.groups]
+            gfns = [comp.compile(e) for _, e in groups]
             ifns = []
             for e in inputs:
                 f = comp.compile(e)
@@ -298,11 +344,18 @@ class MppExecutor:
 
     def _join(self, node: L.Join) -> DistBatch:
         if node.kind == "cross":
+            left = self.run(node.left)
             right = self.run(node.right)
+            # cross product is symmetric: keep a distributed side as the "left"
+            # (stays sharded), replicate the other (small: scalar subqueries,
+            # aggregated views — the reference's NestedLoopJoinExec analog)
+            if left.replicated and not right.replicated:
+                left, right = right, left
             if not right.replicated:
                 right = self._gather(right)
-            left = self.run(node.left)
-            return self._cross_attach(left, right)
+            if int(np.asarray(right.live).sum()) == 1:
+                return self._cross_attach(left, right)
+            return self._cross_product(left, right)
 
         # build = right side by default; inner joins may flip to the smaller side
         build_node, probe_node = node.right, node.left
@@ -485,8 +538,6 @@ class MppExecutor:
     def _cross_attach(self, left: DistBatch, right: DistBatch) -> DistBatch:
         # 1-row replicated right side (uncorrelated scalar subquery): broadcast columns
         live_np = np.asarray(right.live)
-        if int(live_np.sum()) != 1:
-            raise errors.NotSupportedError("MPP cross join needs a 1-row build side")
         idx = int(live_np.argmax())
         cols = dict(left.columns)
         shape = left.live.shape
@@ -496,10 +547,262 @@ class MppExecutor:
             cols[name] = Column(d, v, c.dtype, c.dictionary)
         return DistBatch(cols, left.live, left.replicated)
 
+    # -- window ---------------------------------------------------------------------
+
+    def _window(self, node: L.Window) -> DistBatch:
+        """Window functions distribute by hash-repartitioning rows on the
+        PARTITION BY keys, then running the scan-based window kernel per shard —
+        partitions are wholly shard-local after the shuffle, so the frames are
+        exact (reference: window under MPP repartitions on the partition spec)."""
+        from galaxysql_tpu.exec.operators import SourceOp, WindowOp, bucket_capacity
+        child = self.run(node.child)
+        if child.replicated or not node.partitions:
+            # a global window needs every row in one place: run the local kernel
+            child = child if child.replicated else self._gather(child)
+            batch = ColumnBatch(dict(child.columns), child.live)
+            op = WindowOp(SourceOp([batch.pad_to(
+                bucket_capacity(max(batch.capacity, 1)))]),
+                node.partitions, node.orders, node.calls, out_schema=node.fields())
+            out = next(iter(op.batches()))
+            return DistBatch(dict(out.columns), out.live_mask(), True)
+
+        helper = WindowOp(None, node.partitions, node.orders, node.calls)
+        inputs, lanes = helper._specs()
+        specs = tuple(s for _, s in lanes)
+        R = int(child.live.shape[0]) // self.S
+        quota = max(2 * R // self.S, 128)
+        cids = list(child.columns.keys())
+        while True:
+            key = ("mpp_window",
+                   tuple(expr_cache_key(p) for p in node.partitions),
+                   tuple((expr_cache_key(e), d) for e, d in node.orders),
+                   tuple(expr_cache_key(e) for e in inputs), specs,
+                   tuple(cids), self.S, quota)
+
+            def builder():
+                comp = ExprCompiler(jnp)
+                pfns = [comp.compile(p) for p in node.partitions]
+                ofns = [(comp.compile(e), d) for e, d in node.orders]
+                ifns = [comp.compile(e) for e in inputs]
+                _q = quota
+
+                def spmd(env, live):
+                    # shuffle rows so each partition-key group lands on one shard
+                    pk0 = [f(env) for f in pfns]
+                    h = K.hash_columns([broadcast_value(live.shape[0], *kv)
+                                        for kv in pk0])
+                    lanes_in = [env[i][0] for i in cids]
+                    vlanes = [env[i][1] for i in cids]
+                    payload = lanes_in + [v for v in vlanes if v is not None]
+                    out_lanes, live_x, over = exchange.repartition_by_hash(
+                        payload, live, h, _q)
+                    new_env = {}
+                    vix = len(lanes_in)
+                    for k2, i in enumerate(cids):
+                        v = None
+                        if vlanes[k2] is not None:
+                            v = out_lanes[vix]
+                            vix += 1
+                        new_env[i] = (out_lanes[k2], v)
+                    n = live_x.shape[0]
+                    pk = [broadcast_value(n, *f(new_env)) for f in pfns]
+                    ok = []
+                    for f, desc in ofns:
+                        d, v = broadcast_value(n, *f(new_env))
+                        ok.append((d, v, desc, not desc))
+                    ins = [broadcast_value(n, *f(new_env)) for f in ifns]
+                    order, live_s, outs = K.window_eval(pk, ok, ins, specs, live_x)
+                    cols = {}
+                    for i in cids:
+                        d, v = new_env[i]
+                        cols[i] = (d[order], None if v is None else v[order])
+                    over = jax.lax.pmax(over.astype(jnp.int32),
+                                        "shard").astype(jnp.bool_)
+                    return (cols, live_s, outs), over
+
+                fn = shard_map(spmd, mesh=self.mesh, in_specs=(SHARD, SHARD),
+                               out_specs=((SHARD, SHARD, SHARD), REP),
+                               check_vma=False)
+                return jax.jit(fn)
+
+            (cols, live_s, outs), over = global_jit(key, builder)(child.env(),
+                                                                  child.live)
+            if not bool(over):
+                break
+            quota *= 2
+            if quota > (1 << 24):
+                raise errors.TddlError("MPP window shuffle exceeds capacity")
+
+        out_cols = {}
+        for i in cids:
+            c = child.columns[i]
+            d, v = cols[i]
+            out_cols[i] = Column(d, v, c.dtype, c.dictionary)
+        batch = helper.finalize_calls(out_cols, live_s, outs, lanes)
+        return DistBatch(batch.columns, live_s, False)
+
+    # -- union ----------------------------------------------------------------------
+
+    def _union(self, node: L.Union) -> DistBatch:
+        """UNION [ALL]: per-shard concatenation of the children (no data movement);
+        UNION DISTINCT adds a group-by-all-columns dedup on top."""
+        outs = [self.run(c) for c in node.children]
+        first = node.children[0]
+        first_ids = first.field_ids()
+        fields = first.fields()
+        # align column ids + dictionaries to the first child (fresh merged
+        # dictionaries when children encode strings against different tables)
+        aligned: List[DistBatch] = []
+        out_dicts: Dict[str, Any] = {}
+        for fid, typ, dic in fields:
+            out_dicts[fid] = dic
+        for child, b in zip(node.children, outs):
+            mapping = dict(zip(child.field_ids(), first_ids))
+            cols = {}
+            for i, c in b.columns.items():
+                fid = mapping[i]
+                target = out_dicts.get(fid)
+                if c.dictionary is not None and target is not None and \
+                        c.dictionary is not target:
+                    # translate codes into the first child's dictionary (grown
+                    # with any values only the other children carry) — raw code
+                    # concatenation would silently decode wrong strings
+                    from galaxysql_tpu.chunk.batch import \
+                        dictionary_union_translation
+                    trans = dictionary_union_translation(target, c.dictionary)
+                    c = Column(jnp.asarray(trans)[c.data], c.valid, c.dtype,
+                               target)
+                else:
+                    c = Column(c.data, c.valid, c.dtype, target)
+                cols[fid] = c
+            aligned.append(DistBatch(cols, b.live, b.replicated))
+
+        if any(b.replicated for b in aligned):
+            host = [self._to_host(b) for b in aligned]
+            from galaxysql_tpu.exec.operators import concat_batches
+            merged = concat_batches(host)
+            cols = {fid: Column(jnp.asarray(c.np_data()),
+                                None if c.valid is None else
+                                jnp.asarray(c.np_valid()), c.dtype, out_dicts[fid])
+                    for fid, c in merged.columns.items()}
+            result = DistBatch(cols, jnp.ones(merged.capacity, jnp.bool_)
+                               if merged.capacity else jnp.zeros(0, jnp.bool_),
+                               True)
+        else:
+            result = self._concat_shards(aligned)
+
+        if node.all:
+            return result
+        groups = [(fid, ir.ColRef(fid, typ, out_dicts[fid]))
+                  for fid, typ, _d in fields]
+        est = sum(estimate_rows(c) for c in node.children)
+        return self._aggregate_batch(result, groups, [], est)
+
+    def _concat_shards(self, batches: List[DistBatch]) -> DistBatch:
+        """Per-shard concatenation of distributed batches with identical column
+        ids: shard s of the result is the concat of every input's shard s —
+        a zero-communication UNION ALL."""
+        ids = list(batches[0].columns.keys())
+        key = ("mpp_concat", tuple(ids), len(batches), self.S,
+               tuple(int(b.live.shape[0]) for b in batches))
+
+        def builder():
+            def spmd(*args):
+                envs = args[::2]
+                lives = args[1::2]
+                cols = {}
+                for fid in ids:
+                    ds = [e[fid][0] for e in envs]
+                    vs = [e[fid][1] for e in envs]
+                    d = jnp.concatenate(ds)
+                    v = None if all(x is None for x in vs) else \
+                        jnp.concatenate([x if x is not None else
+                                         jnp.ones(ds[k].shape[0], jnp.bool_)
+                                         for k, x in enumerate(vs)])
+                    cols[fid] = (d, v)
+                return cols, jnp.concatenate(lives)
+
+            n = len(batches)
+            fn = shard_map(spmd, mesh=self.mesh, in_specs=(SHARD,) * (2 * n),
+                           out_specs=(SHARD, SHARD), check_vma=False)
+            return jax.jit(fn)
+
+        flat = []
+        for b in batches:
+            flat += [b.env(), b.live]
+        cols_o, live = global_jit(key, builder)(*flat)
+        ref = batches[0].columns
+        cols = {fid: Column(cols_o[fid][0], cols_o[fid][1], ref[fid].dtype,
+                            ref[fid].dictionary) for fid in ids}
+        return DistBatch(cols, live, False)
+
+    def _cross_product(self, left: DistBatch, right: DistBatch) -> DistBatch:
+        """General cartesian: each shard pairs its left rows with the (compacted)
+        replicated right side — the filter above extracts any join predicate."""
+        # compact the right side so M is the true row count, not the padding
+        rb = ColumnBatch(dict(right.columns), right.live).compact()
+        if rb.capacity == 0:  # empty right side: empty product, shapes kept
+            shape = left.live.shape
+            cols = dict(left.columns)
+            for i, c in right.columns.items():
+                cols[i] = Column(jnp.zeros(shape, dtype=c.data.dtype),
+                                 jnp.zeros(shape, jnp.bool_), c.dtype,
+                                 c.dictionary)
+            return DistBatch(cols, jnp.zeros(shape, jnp.bool_), left.replicated)
+        M = rb.capacity
+        R = int(left.live.shape[0]) // (1 if left.replicated else self.S)
+        if R * M > (1 << 22):
+            raise errors.NotSupportedError(
+                f"MPP cross product too large ({R}x{M} per shard)")
+        lids = list(left.columns.keys())
+        rids = list(rb.columns.keys())
+        key = ("mpp_cross", tuple(lids), tuple(rids), R, M,
+               left.replicated, self.S)
+
+        def builder():
+            def block(lenv, llive, renv, rlive):
+                out = {}
+                for i in lids:
+                    d, v = lenv[i]
+                    out[i] = (jnp.repeat(d, M),
+                              None if v is None else jnp.repeat(v, M))
+                for i in rids:
+                    d, v = renv[i]
+                    out[i] = (jnp.tile(d, R), None if v is None else
+                              jnp.tile(v, R))
+                live = jnp.repeat(llive, M) & jnp.tile(rlive, R)
+                return out, live
+
+            if left.replicated:
+                return jax.jit(block)
+            fn = shard_map(block, mesh=self.mesh,
+                           in_specs=(SHARD, SHARD, REP, REP),
+                           out_specs=(SHARD, SHARD), check_vma=False)
+            return jax.jit(fn)
+
+        renv = {i: (jnp.asarray(c.np_data()),
+                    None if c.valid is None else jnp.asarray(c.np_valid()))
+                for i, c in rb.columns.items()}
+        rlive = jnp.ones(M, jnp.bool_) if rb.capacity else jnp.zeros(1, jnp.bool_)
+        cols, live = global_jit(key, builder)(left.env(), left.live, renv, rlive)
+        out_cols = {}
+        for i, c in left.columns.items():
+            d, v = cols[i]
+            out_cols[i] = Column(d, v, c.dtype, c.dictionary)
+        for i, c in rb.columns.items():
+            d, v = cols[i]
+            out_cols[i] = Column(d, v, c.dtype, c.dictionary)
+        return DistBatch(out_cols, live, left.replicated)
+
     # -- sort / limit ----------------------------------------------------------------
 
     def _sort(self, node: L.Sort) -> DistBatch:
         child = self.run(node.child)
+        if not child.replicated and node.limit is not None:
+            # distributed top-n: each shard keeps only its local top
+            # (limit+offset) rows before the gather — the global winners are a
+            # subset of the per-shard winners (MergeSort/SpilledTopN analog)
+            child = self._local_topn(node, child)
         if not child.replicated:
             child = self._gather(child)
         batch = ColumnBatch(dict(child.columns), child.live)
@@ -507,6 +810,43 @@ class MppExecutor:
                     node.keys, node.limit, node.offset)
         out = next(iter(op.batches()))
         return DistBatch(out.columns, out.live_mask(), True)
+
+    def _local_topn(self, node: L.Sort, child: DistBatch) -> DistBatch:
+        R = int(child.live.shape[0]) // self.S
+        k = min(node.limit + node.offset, R)
+        if k >= R:  # nothing to cut
+            return child
+        cids = list(child.columns.keys())
+        key = ("mpp_topn", tuple((expr_cache_key(e), d) for e, d in node.keys),
+               tuple(cids), self.S, R, k)
+
+        def builder():
+            comp = ExprCompiler(jnp)
+            kfns = [(comp.compile(e), d) for e, d in node.keys]
+
+            def spmd(env, live):
+                n = live.shape[0]
+                keys = []
+                for f, desc in kfns:
+                    d, v = broadcast_value(n, *f(env))
+                    # MySQL default: NULLs first ascending, last descending
+                    keys.append((d, v, desc, not desc))
+                order = K.sort_indices(keys, live)
+                top = order[:k]
+                cols = {i: (env[i][0][top],
+                            None if env[i][1] is None else env[i][1][top])
+                        for i in cids}
+                return cols, live[top]
+
+            fn = shard_map(spmd, mesh=self.mesh, in_specs=(SHARD, SHARD),
+                           out_specs=(SHARD, SHARD), check_vma=False)
+            return jax.jit(fn)
+
+        cols_o, live = global_jit(key, builder)(child.env(), child.live)
+        cols = {i: Column(cols_o[i][0], cols_o[i][1], c.dtype, c.dictionary)
+                for i, c in child.columns.items()}
+        self.ctx.trace.append(f"mpp-topn k={k}")
+        return DistBatch(cols, live, False)
 
     def _limit(self, node: L.Limit) -> DistBatch:
         child = self.run(node.child)

@@ -18,6 +18,7 @@ the plan use these ids.
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -111,15 +112,98 @@ class Binder:
         # session hooks (sequences, connection id) — set by the caller when available
         self.sequence_hook = None
         self.connection_id = None
+        # CTE scopes: a stack of {name: ast.Cte}; bodies re-bind per reference
+        # (fresh column ids per occurrence, like the reference's view expansion)
+        self._ctes: List[Dict[str, ast.Cte]] = []
+        self._cte_in_progress: set = set()
+        self._views_in_progress: set = set()
 
     def fresh(self, prefix: str) -> str:
         return f"{prefix}${next(self._ids)}"
+
+    # --------------------------------------------------------------- queries
+
+    def bind_query(self, stmt: ast.Statement,
+                   scope_parent: Optional[Scope] = None
+                   ) -> Tuple[L.RelNode, List[str]]:
+        """Bind a SELECT or a UNION chain (the statement-level entry point)."""
+        if isinstance(stmt, ast.Select):
+            rel, names, _ = self.bind_select(stmt, scope_parent)
+            return rel, names
+        assert isinstance(stmt, ast.SetOpSelect)
+        pushed = bool(stmt.ctes)
+        if pushed:
+            self._ctes.append({c.name.lower(): c for c in stmt.ctes})
+        try:
+            parts: List[Tuple[L.RelNode, List[str]]] = []
+
+            def flatten(s):
+                if isinstance(s, ast.SetOpSelect) and not s.ctes and \
+                        s.op == stmt.op:
+                    flatten(s.left)
+                    flatten(s.right)
+                else:
+                    parts.append(self.bind_query(s, scope_parent))
+            flatten(stmt.left)
+            flatten(stmt.right)
+            rels = [r for r, _ in parts]
+            names = parts[0][1]
+            node: L.RelNode = L.Union(rels, stmt.op == "union_all")
+            if stmt.order_by:
+                node = self._bind_union_order(node, stmt, names)
+            if stmt.limit is not None:
+                off = self._limit_value(stmt.offset) if stmt.offset else 0
+                if isinstance(node, L.Sort):
+                    node.limit = self._limit_value(stmt.limit)
+                    node.offset = off
+                else:
+                    node = L.Limit(node, self._limit_value(stmt.limit), off)
+            return node, names
+        finally:
+            if pushed:
+                self._ctes.pop()
+
+    def _bind_union_order(self, node: L.RelNode, stmt: ast.SetOpSelect,
+                          names: List[str]) -> L.RelNode:
+        """Trailing ORDER BY of a union chain: output aliases or ordinals only."""
+        fields = node.fields()
+        keys = []
+        for e, desc in stmt.order_by:
+            ref = None
+            if isinstance(e, ast.NumberLit):
+                ix = int(e.value) - 1
+                if not (0 <= ix < len(fields)):
+                    raise errors.TddlError(f"ORDER BY position {ix + 1} invalid")
+                ref = fields[ix]
+            elif isinstance(e, ast.Name):
+                nm = e.simple.lower()
+                for n, f in zip(names, fields):
+                    if n.lower() == nm:
+                        ref = f
+                        break
+            if ref is None:
+                raise errors.NotSupportedError(
+                    "UNION ORDER BY supports output aliases and ordinals only")
+            fid, typ, d = ref
+            keys.append((ir.ColRef(fid, typ, d), desc))
+        return L.Sort(node, keys)
 
     # ------------------------------------------------------------------ SELECT
 
     def bind_select(self, sel: ast.Select, scope_parent: Optional[Scope] = None
                     ) -> Tuple[L.RelNode, List[str], Scope]:
         """Returns (plan, output display names, the FROM scope used)."""
+        if sel.ctes:
+            self._ctes.append({c.name.lower(): c for c in sel.ctes})
+            try:
+                return self._bind_select_body(sel, scope_parent)
+            finally:
+                self._ctes.pop()
+        return self._bind_select_body(sel, scope_parent)
+
+    def _bind_select_body(self, sel: ast.Select,
+                          scope_parent: Optional[Scope] = None
+                          ) -> Tuple[L.RelNode, List[str], Scope]:
         scope = Scope(scope_parent)
         if sel.from_ is None:
             # SELECT without FROM: one anonymous row
@@ -193,7 +277,14 @@ class Binder:
 
     def _bind_from(self, t: ast.TableExpr, scope: Scope) -> L.RelNode:
         if isinstance(t, ast.TableName):
+            if t.schema is None:
+                cte = self._lookup_cte(t.table)
+                if cte is not None:
+                    return self._bind_cte_ref(cte, t, scope)
             schema = t.schema or self.default_schema
+            view = self.catalog.view(schema, t.table)
+            if view is not None:
+                return self._bind_view_ref(view, t, scope)
             tm = self.catalog.table(schema, t.table)
             alias = (t.alias or t.table).lower()
             cols = [(f"{alias}.{c.name}", c.name) for c in tm.columns]
@@ -201,7 +292,7 @@ class Binder:
             scope.add(alias, scan.fields())
             return scan
         if isinstance(t, ast.SubqueryRef):
-            sub, names, _ = self.bind_select(t.select, scope.parent)
+            sub, names = self.bind_query(t.select, scope.parent)
             alias = t.alias.lower()
             # re-expose subquery outputs under the derived alias
             fields = sub.fields()
@@ -213,36 +304,108 @@ class Binder:
         if isinstance(t, ast.Join):
             left = self._bind_from(t.left, scope)
             right = self._bind_from(t.right, scope)
-            if t.kind == "cross":
-                # comma joins: conditions live in WHERE; bind as unconditional cross,
-                # the rewriter turns cross+filter into equi joins
-                return L.Join(left, right, "cross", [])
-            cond = None
-            if t.using:
-                eqs = []
-                for c in t.using:
-                    le = self._resolve_in(left, c, scope)
-                    re = self._resolve_in(right, c, scope)
-                    eqs.append(ir.call("eq", le, re))
-                cond = ir.and_(*eqs)
-            elif t.on is not None:
-                cond = self._bind_expr(t.on, scope)
-            if t.kind == "right":
-                left, right = right, left
-                kind = "left"
-            else:
-                kind = t.kind
-            if kind == "full":
-                raise errors.NotSupportedError("FULL OUTER JOIN not supported")
-            equi, residual, leftover = self._split_join_condition(cond, left, right)
-            node = L.Join(left, right, kind, equi, residual)
-            if leftover is not None:
-                if kind == "left":
-                    raise errors.NotSupportedError(
-                        "LEFT JOIN ON condition too complex to decompose")
-                node = L.Filter(node, leftover)
-            return node
-        raise errors.NotSupportedError(f"unsupported FROM item {type(t).__name__}")
+            return self._bind_join_expr(t, left, right, scope)
+        raise errors.NotSupportedError(f"FROM item {type(t).__name__}")
+
+    def _lookup_cte(self, name: str) -> Optional[ast.Cte]:
+        key = name.lower()
+        for frame in reversed(self._ctes):
+            c = frame.get(key)
+            if c is not None:
+                return c
+        return None
+
+    def _bind_cte_ref(self, cte: ast.Cte, t: ast.TableName,
+                      scope: Scope) -> L.RelNode:
+        """Expand a CTE reference: the body re-binds per occurrence (fresh ids),
+        exposed under the reference alias like a derived table."""
+        key = id(cte)
+        if key in self._cte_in_progress:
+            raise errors.NotSupportedError(
+                f"CTE '{cte.name}' references itself (recursion unsupported)")
+        self._cte_in_progress.add(key)
+        try:
+            sub, names = self.bind_query(cte.select, scope.parent)
+        finally:
+            self._cte_in_progress.discard(key)
+        if cte.columns:
+            if len(cte.columns) != len(names):
+                raise errors.TddlError(
+                    f"CTE '{cte.name}' column list length mismatch")
+            names = cte.columns
+        alias = (t.alias or cte.name).lower()
+        fields = sub.fields()
+        renames = [(f"{alias}.{n}", ir.ColRef(fid, typ, d))
+                   for n, (fid, typ, d) in zip(names, fields)]
+        proj = L.Project(sub, renames)
+        scope.add(alias, proj.fields())
+        return proj
+
+    def _bind_view_ref(self, view, t: ast.TableName, scope: Scope) -> L.RelNode:
+        """Expand a view reference (DrdsViewExpander analog,
+        `optimizer/view/DrdsViewExpander.java`): parse the stored SELECT and bind
+        it as a derived table under the reference alias.  The body binds in the
+        VIEW's schema (unqualified names resolve where the view was defined),
+        with a cycle guard — OR REPLACE can create self/mutual references."""
+        from galaxysql_tpu.sql.parser import parse
+        vkey = (view.schema.lower(), view.name.lower())
+        if vkey in self._views_in_progress:
+            raise errors.TddlError(
+                f"View '{view.schema}.{view.name}' references itself "
+                "(directly or through another view)")
+        stmt = parse(view.sql)
+        saved_schema = self.default_schema
+        self._views_in_progress.add(vkey)
+        self.default_schema = view.schema
+        try:
+            sub, names = self.bind_query(stmt)
+        finally:
+            self.default_schema = saved_schema
+            self._views_in_progress.discard(vkey)
+        if view.columns:
+            if len(view.columns) != len(names):
+                raise errors.TddlError(
+                    f"View '{view.name}' column list length mismatch")
+            names = list(view.columns)
+        alias = (t.alias or view.name).lower()
+        fields = sub.fields()
+        renames = [(f"{alias}.{n}", ir.ColRef(fid, typ, d))
+                   for n, (fid, typ, d) in zip(names, fields)]
+        proj = L.Project(sub, renames)
+        scope.add(alias, proj.fields())
+        return proj
+
+    def _bind_join_expr(self, t: ast.Join, left: L.RelNode, right: L.RelNode,
+                        scope: Scope) -> L.RelNode:
+        if t.kind == "cross":
+            # comma joins: conditions live in WHERE; bind as unconditional cross,
+            # the rewriter turns cross+filter into equi joins
+            return L.Join(left, right, "cross", [])
+        cond = None
+        if t.using:
+            eqs = []
+            for c in t.using:
+                le = self._resolve_in(left, c, scope)
+                re = self._resolve_in(right, c, scope)
+                eqs.append(ir.call("eq", le, re))
+            cond = ir.and_(*eqs)
+        elif t.on is not None:
+            cond = self._bind_expr(t.on, scope)
+        if t.kind == "right":
+            left, right = right, left
+            kind = "left"
+        else:
+            kind = t.kind
+        if kind == "full":
+            raise errors.NotSupportedError("FULL OUTER JOIN not supported")
+        equi, residual, leftover = self._split_join_condition(cond, left, right)
+        node = L.Join(left, right, kind, equi, residual)
+        if leftover is not None:
+            if kind == "left":
+                raise errors.NotSupportedError(
+                    "LEFT JOIN ON condition too complex to decompose")
+            node = L.Filter(node, leftover)
+        return node
 
     def _resolve_in(self, node: L.RelNode, col: str, scope: Scope) -> ir.ColRef:
         for fid, typ, d in node.fields():
@@ -302,8 +465,10 @@ class Binder:
             node = L.Filter(node, ir.and_(*plain))
         return node
 
-    def _bind_exists(self, node: L.RelNode, sub: ast.Select, negated: bool,
+    def _bind_exists(self, node: L.RelNode, sub: ast.Statement, negated: bool,
                      scope: Scope) -> L.RelNode:
+        if not isinstance(sub, ast.Select):
+            raise errors.NotSupportedError("EXISTS over a UNION is not supported")
         subscope = Scope(scope)
         # bind the subquery's FROM + WHERE only (EXISTS ignores the select list)
         inner = self._bind_from(sub.from_, subscope)
@@ -341,7 +506,8 @@ class Binder:
     def _bind_in_subquery(self, node: L.RelNode, e: ast.InExpr, scope: Scope
                           ) -> L.RelNode:
         arg = self._bind_expr(e.arg, scope)
-        sub, names, _ = self.bind_select(e.select, scope)
+        # bind_query handles both plain SELECT and UNION chains
+        sub, _names = self.bind_query(e.select, scope)
         fields = sub.fields()
         if len(fields) != 1:
             raise errors.TddlError("Operand should contain 1 column")
@@ -374,9 +540,11 @@ class Binder:
         e = self._bind_expr(conj, scope, replacements)
         return node, e
 
-    def _attach_scalar_subquery(self, node: L.RelNode, sub: ast.Select, scope: Scope
-                                ) -> Tuple[L.RelNode, ir.Expr]:
-        subscope = Scope(scope)
+    def _attach_scalar_subquery(self, node: L.RelNode, sub: ast.Statement,
+                                scope: Scope) -> Tuple[L.RelNode, ir.Expr]:
+        if not isinstance(sub, ast.Select):
+            raise errors.NotSupportedError(
+                "scalar subquery over a UNION is not supported")
         plan, names, used_scope = self.bind_select(sub, scope)
         correlated = used_scope.correlated
         fields = plan.fields()
@@ -537,12 +705,53 @@ class Binder:
                     return True
         return False
 
+    def _expand_grouping_sets(self, node: L.RelNode, sel: ast.Select,
+                              groups, aggs) -> L.RelNode:
+        """ROLLUP/CUBE/GROUPING SETS as a UNION ALL of one Aggregate per grouping
+        set over the shared child — absent keys project as typed NULLs carrying
+        the column's dictionary (the extra-lexsort-pass-per-set strategy; MySQL
+        WITH ROLLUP semantics: subtotal rows have NULL in rolled-up columns)."""
+        n = len(groups)
+        if sel.grouping_sets is not None:
+            sets = self._gs_membership
+        elif sel.group_modifier == "rollup":
+            sets = [list(range(k)) for k in range(n, -1, -1)]
+        else:  # cube
+            sets = []
+            for size in range(n, -1, -1):
+                for comb in itertools.combinations(range(n), size):
+                    sets.append(list(comb))
+        branches = []
+        for s in sets:
+            member = set(s)
+            # clone the shared child per branch: optimizer rules mutate subtrees
+            # in place (column pruning), and branches prune differently
+            agg_b = L.Aggregate(L.clone_tree(node), [groups[i] for i in s],
+                                list(aggs))
+            proj = []
+            for i, (gid, ge) in enumerate(groups):
+                if i in member:
+                    proj.append((gid, ir.ColRef(gid, ge.dtype,
+                                                _find_dictionary(ge))))
+                else:
+                    proj.append((gid, ir.Literal(
+                        None, ge.dtype.with_nullable(True),
+                        _find_dictionary(ge))))
+            for a in aggs:
+                d = _find_dictionary(a.arg) if (
+                    a.arg is not None and a.arg.dtype.is_string and
+                    a.kind in ("min", "max")) else None
+                proj.append((a.out_id, ir.ColRef(a.out_id, a.dtype, d)))
+            branches.append(L.Project(agg_b, proj))
+        return L.Union(branches, True)
+
     def _bind_aggregate(self, node: L.RelNode, sel: ast.Select, scope: Scope):
         # 1. bind group keys
         groups: List[Tuple[str, ir.Expr]] = []
         group_map: Dict[Tuple, ir.ColRef] = {}
         alias_map = {i.alias.lower(): i.expr for i in sel.items if i.alias}
-        for g in sel.group_by:
+
+        def bind_group_expr(g: ast.ExprNode) -> ir.Expr:
             gexpr = g
             if isinstance(g, ast.NumberLit):
                 ix = int(g.value) - 1
@@ -552,10 +761,29 @@ class Binder:
             elif isinstance(g, ast.Name) and len(g.parts) == 1 and \
                     g.parts[0].lower() in alias_map and scope.resolve(g.parts) is None:
                 gexpr = alias_map[g.parts[0].lower()]
-            e = self._bind_expr(gexpr, scope)
+            return self._bind_expr(gexpr, scope)
+
+        def add_group(e: ir.Expr) -> int:
+            k = e.key()
+            ref = group_map.get(k)
+            if ref is not None:
+                return next(i for i, (gid, _) in enumerate(groups)
+                            if gid == ref.name)
             gid = self.fresh("g")
             groups.append((gid, e))
-            group_map[e.key()] = ir.ColRef(gid, e.dtype, _find_dictionary(e))
+            group_map[k] = ir.ColRef(gid, e.dtype, _find_dictionary(e))
+            return len(groups) - 1
+
+        self._gs_membership = None
+        if sel.grouping_sets is not None:
+            # GROUP BY GROUPING SETS: groups = ordered union of all set exprs;
+            # remember each set's membership for the union expansion
+            self._gs_membership = [
+                sorted({add_group(bind_group_expr(g)) for g in s_ast})
+                for s_ast in sel.grouping_sets]
+        else:
+            for g in sel.group_by:
+                add_group(bind_group_expr(g))
 
         # 2. collect aggregate calls from select list + having + order by
         aggs: List[L.AggSpec] = []
@@ -591,27 +819,56 @@ class Binder:
         for e, _ in sel.order_by:
             collect(e)
 
-        # 3. count(distinct x): rewrite through a pre-distinct when it's the only agg kind
+        # 3. DISTINCT aggregates: rewrite through a pre-aggregate on
+        # (groups + distinct arg).  min/max(DISTINCT) == min/max, so their flag
+        # drops.  Plain aggregates ride through the pre-aggregate as partials and
+        # re-aggregate in the final pass (sum of sums / sum of counts / min of
+        # mins), so ANY mix of one DISTINCT argument with plain aggregates works
+        # — the reference's two-phase distinct-agg expansion without a join.
+        aggs = [dataclasses.replace(a, distinct=False)
+                if a.distinct and a.kind in ("min", "max") else a for a in aggs]
         distinct_aggs = [a for a in aggs if a.distinct]
         if distinct_aggs:
-            if len(aggs) != len(distinct_aggs) or len(distinct_aggs) > 1:
+            bad = [a for a in distinct_aggs if a.kind not in ("count", "sum")]
+            if bad:
                 raise errors.NotSupportedError(
-                    "mixing DISTINCT and plain aggregates is not supported yet")
-            da = distinct_aggs[0]
-            if da.kind != "count":
-                raise errors.NotSupportedError(f"{da.kind}(DISTINCT) not supported yet")
-            pre_groups = list(groups) + [(self.fresh("d"), da.arg)]
-            pre = L.Aggregate(node, pre_groups, [])
-            did, darg = pre_groups[-1]
+                    f"{bad[0].kind}(DISTINCT) not supported yet")
+            if len({a.arg.key() for a in distinct_aggs}) > 1:
+                raise errors.NotSupportedError(
+                    "multiple different DISTINCT arguments in one aggregate")
+            darg = distinct_aggs[0].arg
+            did = self.fresh("d")
+            pre_groups = list(groups) + [(did, darg)]
+            pre_aggs: List[L.AggSpec] = []
+            final_aggs: List[L.AggSpec] = []
+            dref = ir.ColRef(did, darg.dtype, _find_dictionary(darg))
+            merge_kind = {"sum": "sum", "count": "sum", "count_star": "sum",
+                          "min": "min", "max": "max"}
+            for a in aggs:
+                if a.distinct:
+                    # each pre-group holds one distinct (group, value): counting/
+                    # summing the pre-group keys IS the distinct aggregate
+                    final_aggs.append(L.AggSpec(a.kind, dref, a.out_id))
+                    continue
+                if a.kind == "avg":
+                    raise errors.NotSupportedError(
+                        "AVG mixed with DISTINCT aggregates not supported yet")
+                pid = self.fresh(a.kind)
+                pre_aggs.append(L.AggSpec(a.kind, a.arg, pid))
+                pref = ir.ColRef(pid, pre_aggs[-1].dtype, None)
+                final_aggs.append(L.AggSpec(merge_kind[a.kind], pref, a.out_id))
+            pre = L.Aggregate(node, pre_groups, pre_aggs)
             regrouped = [(gid, ir.ColRef(gid, e.dtype, _find_dictionary(e)))
                          for gid, e in groups]
-            count_spec = L.AggSpec("count", ir.ColRef(did, darg.dtype,
-                                                      _find_dictionary(darg)),
-                                   da.out_id)
-            node = L.Aggregate(pre, regrouped, [count_spec])
+            node = L.Aggregate(pre, regrouped, final_aggs)
             groups = regrouped
             # group_map keeps the ORIGINAL group-expression keys: select items still
             # reference the source expressions, which map to the re-grouped ids
+            if sel.group_modifier or sel.grouping_sets:
+                raise errors.NotSupportedError(
+                    "DISTINCT aggregates with ROLLUP/CUBE/GROUPING SETS")
+        elif sel.group_modifier or sel.grouping_sets:
+            node = self._expand_grouping_sets(node, sel, groups, aggs)
         else:
             node = L.Aggregate(node, groups, aggs)
 
@@ -654,6 +911,9 @@ class Binder:
         replacements: Dict[int, ir.Expr] = {}
         for n in _ast_walk(conj):
             if isinstance(n, ast.SubqueryExpr):
+                if not isinstance(n.select, ast.Select):
+                    raise errors.NotSupportedError(
+                        "UNION subquery in HAVING not supported")
                 plan, names, used = self.bind_select(n.select, scope)
                 if used.correlated:
                     raise errors.NotSupportedError(

@@ -33,6 +33,20 @@ class AggSpec:
         return AggCall(self.kind, self.arg, self.out_id).dtype
 
 
+def clone_tree(n: "RelNode") -> "RelNode":
+    """Structural copy of a plan subtree: fresh RelNodes and fresh list attrs
+    (optimizer rules mutate Scan.columns / Project.exprs in place), while ir
+    expressions, TableMetas and dictionaries stay shared (immutable identities).
+    Needed wherever one bound subtree feeds several parents (grouping sets)."""
+    import copy
+    c = copy.copy(n)
+    for attr, v in vars(c).items():
+        if attr != "children" and isinstance(v, list):
+            setattr(c, attr, list(v))
+    c.children = [clone_tree(ch) for ch in n.children]
+    return c
+
+
 class RelNode:
     children: List["RelNode"]
 

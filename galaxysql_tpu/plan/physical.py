@@ -270,6 +270,7 @@ def build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
         children = [build_operator(c, ctx) for c in node.children]
         # align column ids across inputs: rename every child to the first child's ids
         first_ids = node.children[0].field_ids()
+        target_dicts = {fid: d for fid, _t, d in node.children[0].fields()}
 
         class UnionOp(ops.Operator):
             def __init__(self, children, id_lists):
@@ -280,7 +281,23 @@ def build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
                 for op, ids in zip(self.children_ops, self.id_lists):
                     rename = dict(zip(ids, first_ids))
                     for b in op.batches():
-                        yield b.rename(rename)
+                        yield self._align(b.rename(rename))
+
+            def _align(self, b):
+                """Translate string codes into the first child's dictionary —
+                children from different tables encode against different dicts,
+                and concatenating raw codes would silently decode wrong values."""
+                from galaxysql_tpu.chunk.batch import dictionary_union_translation
+                cols = {}
+                for fid, c in b.columns.items():
+                    tgt = target_dicts.get(fid)
+                    if c.dictionary is None or tgt is None or c.dictionary is tgt:
+                        cols[fid] = c
+                        continue
+                    trans = dictionary_union_translation(tgt, c.dictionary)
+                    cols[fid] = Column(trans[np.asarray(c.data)], c.valid,
+                                       c.dtype, tgt)
+                return ColumnBatch(cols, b.live)
 
         u = UnionOp(children, [c.field_ids() for c in node.children])
         if node.all:

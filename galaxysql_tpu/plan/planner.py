@@ -141,10 +141,8 @@ class Planner:
             binder.sequence_hook = \
                 lambda nm: session.instance.sequences.next_value(schema, nm)
             binder.connection_id = session.conn_id
-        if isinstance(stmt, ast.Select):
-            rel, names, _ = binder.bind_select(stmt)
-        elif isinstance(stmt, ast.SetOpSelect):
-            rel, names = self._bind_setop(binder, stmt)
+        if isinstance(stmt, (ast.Select, ast.SetOpSelect)):
+            rel, names = binder.bind_query(stmt)
         else:
             raise ValueError(f"not a plannable statement: {type(stmt).__name__}")
         rel = optimize(rel)
@@ -152,25 +150,5 @@ class Planner:
         plan.bound_params = list(params)
         return plan
 
-    def _bind_setop(self, binder: Binder, stmt: ast.SetOpSelect):
-        parts: List[Tuple[L.RelNode, List[str]]] = []
-
-        def flatten(s):
-            if isinstance(s, ast.SetOpSelect):
-                if s.op != stmt.op:
-                    rel, names = self._bind_setop(binder, s)
-                    parts.append((rel, names))
-                    return
-                flatten(s.left)
-                flatten(s.right)
-            else:
-                rel, names, _ = binder.bind_select(s)
-                parts.append((rel, names))
-        flatten(stmt.left)
-        flatten(stmt.right)
-        rels = [r for r, _ in parts]
-        names = parts[0][1]
-        union = L.Union(rels, stmt.op == "union_all")
-        return union, names
 
 

@@ -42,6 +42,7 @@ _DEFS: Dict[str, List] = {
     "node_info": [("node_id", _V), ("role", _V), ("host", _V), ("port", _I)],
     "plan_cache": [("schema_name", _V), ("cache_key", _V), ("workload", _V),
                    ("hit_count", _I)],
+    "engine_counters": [("counter_name", _V), ("value", _I)],
 }
 
 
@@ -132,3 +133,5 @@ def refresh(instance, session=None):
     with pc._lock:
         entries = [[k[0], k[1][:120], p.workload, 0] for k, p in pc._map.items()]
     fill("plan_cache", entries)
+    fill("engine_counters", ([k, int(v)] for k, v in
+                             sorted(getattr(instance, "counters", {}).items())))

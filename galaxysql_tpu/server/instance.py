@@ -47,6 +47,8 @@ class Instance:
         self.archive = ArchiveManager(
             os.path.join(data_dir, "archive") if data_dir else None)
         self.node_id = f"cn-{uuid.uuid4().hex[:8]}"
+        import collections
+        self.counters = collections.Counter()  # engine_counters virtual table
         self.lock = threading.RLock()
         self.next_conn_id = 1
         self.sessions: Dict[int, object] = {}

@@ -129,6 +129,7 @@ class Session:
         ast.Select: "SELECT", ast.SetOpSelect: "SELECT", ast.Insert: "INSERT",
         ast.Update: "UPDATE", ast.Delete: "DELETE", ast.CreateTable: "CREATE",
         ast.DropTable: "DROP", ast.TruncateTable: "DELETE", ast.AlterTable: "ALTER",
+        ast.CreateView: "CREATE", ast.DropView: "DROP",
         ast.CreateIndex: "INDEX", ast.DropIndex: "INDEX", ast.LoadData: "INSERT",
         ast.CreateDatabase: "CREATE", ast.DropDatabase: "DROP",
     }
@@ -192,6 +193,10 @@ class Session:
             return self._run_create_table(stmt)
         if isinstance(stmt, ast.DropTable):
             return self._run_drop_table(stmt)
+        if isinstance(stmt, ast.CreateView):
+            return self._run_create_view(stmt)
+        if isinstance(stmt, ast.DropView):
+            return self._run_drop_view(stmt)
         if isinstance(stmt, ast.TruncateTable):
             return self._run_truncate(stmt)
         if isinstance(stmt, ast.CreateDatabase):
@@ -444,8 +449,13 @@ class Session:
                 from galaxysql_tpu.parallel.mpp import MppExecutor
                 try:
                     batch = MppExecutor(ctx, mesh).execute(plan.rel)
-                except errors.NotSupportedError:
-                    batch = None  # plan shape not yet distributed: local engine
+                    self.instance.counters["mpp_queries"] += 1
+                except errors.NotSupportedError as e:
+                    # plan shape not yet distributed: local engine — NEVER
+                    # silent (trace tag + information_schema.engine_counters)
+                    batch = None
+                    self.instance.counters["mpp_fallback_local"] += 1
+                    ctx.trace.append(f"mpp-fallback {e}")
         if batch is None:
             op = build_operator(plan.rel, ctx)
             # TP fast path: pin execution to the host CPU backend — point queries must
@@ -690,6 +700,29 @@ class Session:
         return ok(affected=n)
 
     # -- DDL ----------------------------------------------------------------------
+
+    def _run_create_view(self, stmt: ast.CreateView) -> ResultSet:
+        from galaxysql_tpu.meta.catalog import ViewDef
+        schema = stmt.name.schema or self._require_schema()
+        # validate now: the view must bind against current metadata, and an
+        # explicit column list must match the SELECT's output arity
+        plan = self.instance.planner.bind_statement(stmt.select, schema, [], self)
+        if stmt.columns is not None and \
+                len(stmt.columns) != len(plan.display_names):
+            raise errors.TddlError(
+                f"View '{stmt.name.table}' column list length mismatch")
+        v = ViewDef(schema, stmt.name.table, stmt.columns, stmt.select_sql)
+        self.instance.catalog.add_view(v, or_replace=stmt.or_replace)
+        self.instance.metadb.save_view(v)
+        return ok()
+
+    def _run_drop_view(self, stmt: ast.DropView) -> ResultSet:
+        schema_default = self._require_schema()
+        for nm in stmt.names:
+            schema = nm.schema or schema_default
+            if self.instance.catalog.drop_view(schema, nm.table, stmt.if_exists):
+                self.instance.metadb.drop_view(schema, nm.table)
+        return ok()
 
     def _run_create_table(self, stmt: ast.CreateTable) -> ResultSet:
         schema = stmt.name.schema or self._require_schema()

@@ -229,6 +229,14 @@ class SelectItem(Node):
 
 
 @dataclasses.dataclass
+class Cte(Node):
+    """One WITH item: name [(columns)] AS (select)."""
+    name: str
+    columns: Optional[List[str]]
+    select: "Statement"
+
+
+@dataclasses.dataclass
 class Select(Statement):
     items: List[SelectItem]
     from_: Optional[TableExpr] = None
@@ -240,6 +248,9 @@ class Select(Statement):
     offset: Optional[ExprNode] = None
     distinct: bool = False
     for_update: bool = False
+    ctes: List[Cte] = dataclasses.field(default_factory=list)
+    group_modifier: Optional[str] = None       # 'rollup' | 'cube'
+    grouping_sets: Optional[List[List[ExprNode]]] = None
 
 
 @dataclasses.dataclass
@@ -250,6 +261,8 @@ class SetOpSelect(Statement):
     right: Statement
     order_by: List[Tuple[ExprNode, bool]] = dataclasses.field(default_factory=list)
     limit: Optional[ExprNode] = None
+    offset: Optional[ExprNode] = None
+    ctes: List[Cte] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -345,6 +358,21 @@ class DropTable(Statement):
 @dataclasses.dataclass
 class TruncateTable(Statement):
     name: TableName
+
+
+@dataclasses.dataclass
+class CreateView(Statement):
+    name: TableName
+    columns: Optional[List[str]]
+    select: Statement
+    select_sql: str              # original SELECT text, persisted in the metadb
+    or_replace: bool = False
+
+
+@dataclasses.dataclass
+class DropView(Statement):
+    names: List[TableName]
+    if_exists: bool = False
 
 
 @dataclasses.dataclass

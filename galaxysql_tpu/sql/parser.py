@@ -99,7 +99,7 @@ class Parser:
         if t.kind == T.OP and t.text.startswith("/*"):
             self.next()  # skip hint comment at statement head
             t = self.peek()
-        if t.is_kw("SELECT") or self.at_op("("):
+        if t.is_kw("SELECT") or t.is_kw("WITH") or self.at_op("("):
             return self._select_with_setops()
         if t.is_kw("INSERT", "REPLACE"):
             return self._insert()
@@ -171,13 +171,22 @@ class Parser:
     # -- SELECT -------------------------------------------------------------
 
     def _select_with_setops(self) -> ast.Statement:
+        ctes: list = []
+        if self.accept_kw("WITH"):
+            if self.accept_kw("RECURSIVE"):
+                raise self.error("recursive CTEs are not supported")
+            ctes.append(self._cte_item())
+            while self.accept_op(","):
+                ctes.append(self._cte_item())
         left = self._select_core_or_paren()
         while self.at_kw("UNION"):
             self.next()
             all_ = self.accept_kw("ALL")
             if not all_:
                 self.accept_kw("DISTINCT")
-            right = self._select_core_or_paren()
+            # an unparenthesized arm must NOT swallow the trailing ORDER BY/LIMIT:
+            # in MySQL they bind to the whole union chain
+            right = self._select_core_or_paren(no_tail=True)
             left = ast.SetOpSelect("union_all" if all_ else "union", left, right)
         # trailing ORDER BY / LIMIT of a union chain
         if isinstance(left, ast.SetOpSelect):
@@ -185,17 +194,34 @@ class Parser:
                 self.expect_kw("BY")
                 left.order_by = self._order_list()
             if self.accept_kw("LIMIT"):
-                left.limit, _ = self._limit_clause()
+                left.limit, left.offset = self._limit_clause()
+        if ctes:
+            # CTEs scope over the WHOLE union chain: attach to the top statement
+            left.ctes = list(ctes) + list(getattr(left, "ctes", []))
         return left
 
-    def _select_core_or_paren(self) -> ast.Statement:
+    def _cte_item(self) -> ast.Cte:
+        name = self.expect_ident()
+        cols = None
+        if self.accept_op("("):
+            cols = [self.expect_ident()]
+            while self.accept_op(","):
+                cols.append(self.expect_ident())
+            self.expect_op(")")
+        self.expect_kw("AS")
+        self.expect_op("(")
+        sel = self._select_with_setops()
+        self.expect_op(")")
+        return ast.Cte(name, cols, sel)
+
+    def _select_core_or_paren(self, no_tail: bool = False) -> ast.Statement:
         if self.accept_op("("):
             s = self._select_with_setops()
             self.expect_op(")")
             return s
-        return self._select_core()
+        return self._select_core(no_tail=no_tail)
 
-    def _select_core(self) -> ast.Select:
+    def _select_core(self, no_tail: bool = False) -> ast.Select:
         self.expect_kw("SELECT")
         while self.peek().kind == T.OP and self.peek().text.startswith("/*"):
             self.next()
@@ -214,12 +240,33 @@ class Parser:
             sel.where = self._expr()
         if self.accept_kw("GROUP"):
             self.expect_kw("BY")
-            sel.group_by.append(self._expr())
-            while self.accept_op(","):
+            if self.at_kw("ROLLUP", "CUBE") and self.peek(1).text == "(":
+                sel.group_modifier = self.next().text.lower()
+                self.expect_op("(")
                 sel.group_by.append(self._expr())
-            self.accept_kw("ASC")  # tolerated legacy syntax
+                while self.accept_op(","):
+                    sel.group_by.append(self._expr())
+                self.expect_op(")")
+            elif self.at_kw("GROUPING"):
+                self.next()
+                self.expect_kw("SETS")
+                self.expect_op("(")
+                sel.grouping_sets = [self._grouping_set()]
+                while self.accept_op(","):
+                    sel.grouping_sets.append(self._grouping_set())
+                self.expect_op(")")
+            else:
+                sel.group_by.append(self._expr())
+                while self.accept_op(","):
+                    sel.group_by.append(self._expr())
+                self.accept_kw("ASC")  # tolerated legacy syntax
+                if self.accept_kw("WITH"):
+                    self.expect_kw("ROLLUP")
+                    sel.group_modifier = "rollup"
         if self.accept_kw("HAVING"):
             sel.having = self._expr()
+        if no_tail:
+            return sel
         if self.accept_kw("ORDER"):
             self.expect_kw("BY")
             sel.order_by = self._order_list()
@@ -233,6 +280,18 @@ class Parser:
             self.expect_kw("SHARE")
             self.expect_kw("MODE")
         return sel
+
+    def _grouping_set(self) -> list:
+        """One GROUPING SETS element: (a, b) | (a) | a | () — () is the total."""
+        if self.accept_op("("):
+            if self.accept_op(")"):
+                return []
+            out = [self._expr()]
+            while self.accept_op(","):
+                out.append(self._expr())
+            self.expect_op(")")
+            return out
+        return [self._expr()]
 
     def _select_item(self) -> ast.SelectItem:
         if self.at_op("*"):
@@ -817,6 +876,26 @@ class Parser:
                 self.expect_kw("BY")
                 password = self.next().text
             return ast.CreateUser(user, password, ine)
+        or_replace = False
+        if self.accept_kw("OR"):
+            self.expect_kw("REPLACE")
+            or_replace = True
+        if self.accept_kw("VIEW"):
+            name = self._table_name()
+            cols = None
+            if self.accept_op("("):
+                cols = [self.expect_ident()]
+                while self.accept_op(","):
+                    cols.append(self.expect_ident())
+                self.expect_op(")")
+            self.expect_kw("AS")
+            start = self.peek().start
+            sel = self._select_with_setops()
+            end = self.toks[self.i - 1].end
+            return ast.CreateView(name, cols, sel, self.sql[start:end].strip(),
+                                  or_replace)
+        if or_replace:
+            raise self.error("OR REPLACE is only supported for CREATE VIEW")
         unique = self.accept_kw("UNIQUE")
         global_ = self.accept_kw("GLOBAL")
         if self.accept_kw("INDEX"):
@@ -1206,6 +1285,15 @@ class Parser:
             iname = self.expect_ident()
             self.expect_kw("ON")
             return ast.DropIndex(iname, self._table_name())
+        if self.accept_kw("VIEW"):
+            ie = False
+            if self.accept_kw("IF"):
+                self.expect_kw("EXISTS")
+                ie = True
+            names = [self._table_name()]
+            while self.accept_op(","):
+                names.append(self._table_name())
+            return ast.DropView(names, ie)
         self.expect_kw("TABLE")
         ie = False
         if self.accept_kw("IF"):

@@ -16,3 +16,26 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_compiled_caches():
+    """Free compiled XLA programs between test modules.
+
+    The full suite compiles thousands of kernels; XLA:CPU's compiler has been
+    observed to segfault late in the run under that accumulated state.  Dropping
+    the process-wide jit caches (ours + jax's) at module boundaries keeps the
+    live-executable population bounded without changing any test's behavior
+    (first query of each module recompiles)."""
+    yield
+    from galaxysql_tpu.exec import operators as _ops
+    with _ops._JIT_CACHE_LOCK:
+        _ops._JIT_CACHE.clear()
+    from galaxysql_tpu.exec.device_cache import GLOBAL_DEVICE_CACHE
+    GLOBAL_DEVICE_CACHE.clear()
+    from galaxysql_tpu.parallel.mesh import GLOBAL_MESH_CACHE
+    with GLOBAL_MESH_CACHE._lock:
+        GLOBAL_MESH_CACHE._map.clear()
+    jax.clear_caches()

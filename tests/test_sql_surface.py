@@ -1,0 +1,207 @@
+"""CTEs, views, ROLLUP/CUBE/GROUPING SETS, multi-distinct, union ORDER BY."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.utils import errors
+
+
+@pytest.fixture(scope="module")
+def env():
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE d; USE d")
+    s.execute("CREATE TABLE t (k VARCHAR(4), g VARCHAR(4), v BIGINT)")
+    rng = np.random.default_rng(7)
+    inst.store("d", "t").insert_arrays(
+        {"k": np.array(["a", "b", "c"])[rng.integers(0, 3, 2000)],
+         "g": np.array(["p", "q"])[rng.integers(0, 2, 2000)],
+         "v": rng.integers(0, 100, 2000)}, inst.tso.next_timestamp())
+    s.execute("ANALYZE TABLE t")
+    df = pd.DataFrame(s.execute("SELECT k, g, v FROM t").rows,
+                      columns=["k", "g", "v"])
+    yield inst, s, df
+    s.close()
+
+
+class TestCte:
+    def test_basic(self, env):
+        _i, s, df = env
+        r = s.execute("WITH big AS (SELECT k, v FROM t WHERE v > 50) "
+                      "SELECT k, count(*) FROM big GROUP BY k ORDER BY k").rows
+        assert [c for _, c in r] == list(df[df.v > 50].groupby("k").size())
+
+    def test_chained_and_double_reference(self, env):
+        _i, s, df = env
+        r = s.execute("WITH a AS (SELECT k, v FROM t WHERE v > 90), "
+                      "b AS (SELECT k FROM a WHERE v > 95) "
+                      "SELECT count(*) FROM b").rows
+        assert r[0][0] == int((df.v > 95).sum())
+        r = s.execute("WITH a AS (SELECT k, v FROM t WHERE v > 90) "
+                      "SELECT count(*) FROM a x, a y "
+                      "WHERE x.k = y.k AND x.v < y.v").rows
+        m = df[df.v > 90]
+        j = m.merge(m, on="k")
+        assert r[0][0] == int((j.v_x < j.v_y).sum())
+
+    def test_column_list_and_union_scope(self, env):
+        _i, s, df = env
+        r = s.execute("WITH c (kk) AS (SELECT k FROM t WHERE v < 5) "
+                      "SELECT kk FROM c UNION SELECT kk FROM c ORDER BY kk").rows
+        assert r == sorted({(k,) for k in df[df.v < 5].k})
+
+    def test_recursion_rejected(self, env):
+        _i, s, _df = env
+        with pytest.raises(errors.TddlError):
+            s.execute("WITH RECURSIVE r AS (SELECT 1) SELECT * FROM r")
+
+
+class TestGroupingSets:
+    def test_with_rollup(self, env):
+        _i, s, df = env
+        r = s.execute(
+            "SELECT k, g, sum(v) FROM t GROUP BY k, g WITH ROLLUP").rows
+        exp = [(str(k), str(g2), int(sub.v.sum()))
+               for (k, g2), sub in df.groupby(["k", "g"])]
+        exp += [(str(k), "None", int(sub.v.sum())) for k, sub in df.groupby("k")]
+        exp.append(("None", "None", int(df.v.sum())))
+        assert sorted((str(a), str(b), int(c)) for a, b, c in r) == sorted(exp)
+
+    def test_rollup_function_form(self, env):
+        _i, s, df = env
+        r = s.execute("SELECT k, sum(v) FROM t GROUP BY ROLLUP(k)").rows
+        assert len(r) == df.k.nunique() + 1
+
+    def test_cube(self, env):
+        _i, s, df = env
+        r = s.execute("SELECT k, g, count(*) FROM t GROUP BY CUBE(k, g)").rows
+        assert len(r) == (len(df.groupby(["k", "g"])) + df.k.nunique()
+                          + df.g.nunique() + 1)
+
+    def test_grouping_sets(self, env):
+        _i, s, df = env
+        r = s.execute("SELECT k, g, count(*) FROM t "
+                      "GROUP BY GROUPING SETS ((k), (g), ())").rows
+        assert len(r) == df.k.nunique() + df.g.nunique() + 1
+
+    def test_rollup_with_having_and_order(self, env):
+        _i, s, df = env
+        r = s.execute("SELECT k, sum(v) AS s FROM t GROUP BY k WITH ROLLUP "
+                      "HAVING sum(v) > 0 ORDER BY k").rows
+        assert len(r) == df.k.nunique() + 1
+        assert r[0][0] is None  # NULLs sort first ascending
+
+
+class TestViews:
+    def test_create_query_replace_drop(self, env):
+        _i, s, df = env
+        s.execute("CREATE VIEW hi AS SELECT k, v FROM t WHERE v >= 50")
+        r = s.execute("SELECT k, count(*) FROM hi GROUP BY k ORDER BY k").rows
+        assert [c for _, c in r] == list(df[df.v >= 50].groupby("k").size())
+        s.execute("CREATE OR REPLACE VIEW hi (kk, vv) AS "
+                  "SELECT k, v FROM t WHERE v < 10")
+        r = s.execute("SELECT count(*) FROM hi WHERE vv < 5").rows
+        assert r[0][0] == int((df.v < 5).sum())
+        # views reflect base-table changes (re-expanded per reference);
+        # sentinel v=-7 cannot collide with generated data (domain 0..99)
+        s.execute("INSERT INTO t VALUES ('a', 'p', -7)")
+        assert s.execute("SELECT count(*) FROM hi WHERE vv < 5").rows[0][0] == \
+            int((df.v < 5).sum()) + 1
+        s.execute("DELETE FROM t WHERE v = -7")
+        s.execute("DROP VIEW hi")
+        with pytest.raises(errors.TddlError):
+            s.execute("SELECT * FROM hi")
+
+    def test_view_persists_across_boot(self, tmp_path):
+        d = str(tmp_path)
+        inst = Instance(data_dir=d)
+        s = Session(inst)
+        s.execute("CREATE DATABASE vd; USE vd")
+        s.execute("CREATE TABLE b (x BIGINT)")
+        inst.store("vd", "b").insert_arrays({"x": np.arange(10)},
+                                            inst.tso.next_timestamp())
+        s.execute("CREATE VIEW evens AS SELECT x FROM b WHERE x % 2 = 0")
+        inst.save()
+        s.close()
+        inst2 = Instance(data_dir=d)
+        s2 = Session(inst2, "vd")
+        assert s2.execute("SELECT count(*) FROM evens").rows == [(5,)]
+        s2.close()
+
+
+class TestUnionTail:
+    def test_order_by_binds_to_union(self, env):
+        _i, s, _df = env
+        r = s.execute("SELECT k, v FROM t WHERE v < 3 UNION ALL "
+                      "SELECT k, v FROM t WHERE v > 97 "
+                      "ORDER BY v DESC LIMIT 5").rows
+        assert len(r) == 5
+        assert all(r[i][1] >= r[i + 1][1] for i in range(len(r) - 1))
+
+    def test_order_by_ordinal(self, env):
+        _i, s, df = env
+        r = s.execute("SELECT k FROM t WHERE v < 3 UNION SELECT k FROM t "
+                      "ORDER BY 1").rows
+        assert r == sorted({(k,) for k in df.k})
+
+
+class TestReviewRegressions:
+    def test_union_limit_offset(self, env):
+        _i, s, _df = env
+        base = s.execute("SELECT v FROM t WHERE v < 3 UNION ALL "
+                         "SELECT v FROM t WHERE v > 97 ORDER BY v").rows
+        r = s.execute("SELECT v FROM t WHERE v < 3 UNION ALL "
+                      "SELECT v FROM t WHERE v > 97 ORDER BY v "
+                      "LIMIT 5 OFFSET 10").rows
+        assert r == base[10:15]
+
+    def test_view_cycle_detected(self, env):
+        _i, s, _df = env
+        s.execute("CREATE VIEW cyc AS SELECT v FROM t WHERE v < 5")
+        s.execute("CREATE OR REPLACE VIEW cyc AS SELECT v FROM cyc")
+        with pytest.raises(errors.TddlError, match="references itself"):
+            s.execute("SELECT * FROM cyc")
+        s.execute("DROP VIEW cyc")
+
+    def test_view_binds_in_own_schema(self, env):
+        inst, s, df = env
+        s2 = Session(inst)
+        s2.execute("CREATE DATABASE other; USE other")
+        # unqualified 't' inside the view must resolve to d.t, not other.*
+        s2.execute("CREATE VIEW d.dview AS SELECT v FROM t WHERE v < 5")
+        r = s2.execute("SELECT count(*) FROM d.dview").rows
+        assert r[0][0] == int((df.v < 5).sum())
+        s2.execute("DROP VIEW d.dview")
+        s2.close()
+
+    def test_view_column_list_arity_checked(self, env):
+        _i, s, _df = env
+        with pytest.raises(errors.TddlError, match="column list"):
+            s.execute("CREATE VIEW bad (a, b) AS SELECT v FROM t")
+
+    def test_union_in_in_subquery(self, env):
+        _i, s, df = env
+        r = s.execute("SELECT count(*) FROM t WHERE k IN "
+                      "(SELECT k FROM t WHERE v < 2 UNION "
+                      "SELECT k FROM t WHERE v > 98)").rows
+        keys = set(df[df.v < 2].k) | set(df[df.v > 98].k)
+        assert r[0][0] == int(df.k.isin(keys).sum())
+
+
+class TestMultiDistinct:
+    def test_mixed_distinct_and_plain(self, env):
+        _i, s, df = env
+        r = s.execute("SELECT k, count(DISTINCT v), sum(v), count(*), min(v), "
+                      "sum(DISTINCT v) FROM t GROUP BY k ORDER BY k").rows
+        want = [(k, gr.v.nunique(), int(gr.v.sum()), len(gr), int(gr.v.min()),
+                 int(gr.v.drop_duplicates().sum()))
+                for k, gr in df.groupby("k", sort=True)]
+        assert [tuple(x) for x in r] == want
+
+    def test_global_mixed(self, env):
+        _i, s, df = env
+        r = s.execute("SELECT count(DISTINCT v), sum(v) FROM t").rows
+        assert r == [(df.v.nunique(), int(df.v.sum()))]
