@@ -30,10 +30,21 @@ MIN_BUCKET = 1024
 
 
 def bucket_capacity(n: int) -> int:
-    """Round up to a power of two (bounded recompile count, like chunk-size bucketing)."""
+    """Round up to a padding bucket (bounded recompile count, like chunk-size
+    bucketing): powers of two up to 64K, then quarter-steps {1, 1.25, 1.5,
+    1.75}x2^k.  Above 64K the finer ladder caps padding waste at 25% (a 1.2M-row
+    scan would otherwise pad to 2M and every kernel pays 1.75x) while only 4x-ing
+    the distinct compile shapes, all served by the persistent XLA cache."""
     c = MIN_BUCKET
     while c < n:
         c *= 2
+    if c <= (1 << 16) or c == n:
+        return c
+    half = c // 2
+    for q in (5, 6, 7):
+        step = half + (half // 4) * (q - 4)
+        if n <= step:
+            return step
     return c
 
 
@@ -75,6 +86,18 @@ def _dict_sig(e: ir.Expr) -> Tuple:
 
 def expr_cache_key(e: ir.Expr) -> Tuple:
     return (e.key(), _dict_sig(e))
+
+
+def lifted_keys(lift, exprs: Sequence[ir.Expr]):
+    """Value-independent cache keys for `exprs` under `lift`, or None when any
+    expression's masking is ambiguous (caller bakes values instead)."""
+    keys = []
+    for e in exprs:
+        tk = lift.template_key(e)
+        if tk is None:
+            return None
+        keys.append((tk, _dict_sig(e)))
+    return tuple(keys)
 
 
 def broadcast_value(n: int, data, valid):
@@ -142,19 +165,29 @@ class FilterOp(Operator):
         self.predicate = predicate
 
     def _compiled(self):
-        def build():
-            pred = ExprCompiler(jnp).compile_predicate(self.predicate)
+        from galaxysql_tpu.expr.compiler import LiftedLiterals
+        lift = LiftedLiterals([self.predicate])
+        tkeys = lifted_keys(lift, [self.predicate])
+        if tkeys is None:
+            lift = None
 
-            def run(batch: ColumnBatch) -> ColumnBatch:
-                mask = pred(batch_env(batch))
+        def build():
+            pred = ExprCompiler(jnp, lift=lift).compile_predicate(self.predicate)
+
+            def run(batch: ColumnBatch, lits) -> ColumnBatch:
+                env = batch_env(batch)
+                env["$lits"] = lits
+                mask = pred(env)
                 return ColumnBatch(batch.columns, batch.live_mask() & mask)
             return jax.jit(run)
-        return global_jit(("filter", expr_cache_key(self.predicate)), build)
+        key = ("filter", tkeys if tkeys is not None
+               else expr_cache_key(self.predicate))
+        return global_jit(key, build), (lift.values() if lift is not None else ())
 
     def batches(self) -> Iterator[ColumnBatch]:
-        f = self._compiled()
+        f, lits = self._compiled()
         for b in self.child.batches():
-            yield f(b)
+            yield f(b, lits)
 
 
 class ProjectOp(Operator):
@@ -165,12 +198,20 @@ class ProjectOp(Operator):
         self.exprs = list(exprs)
 
     def _compiled(self):
+        from galaxysql_tpu.expr.compiler import LiftedLiterals
+        es = [e for _, e in self.exprs]
+        lift = LiftedLiterals(es)
+        tkeys = lifted_keys(lift, es)
+        if tkeys is None:
+            lift = None
+
         def build():
-            comp = ExprCompiler(jnp)
+            comp = ExprCompiler(jnp, lift=lift)
             fns = [(name, e, comp.compile(e)) for name, e in self.exprs]
 
-            def run(batch: ColumnBatch) -> ColumnBatch:
+            def run(batch: ColumnBatch, lits) -> ColumnBatch:
                 env = batch_env(batch)
+                env["$lits"] = lits
                 cols = {}
                 n = batch.capacity
                 for name, e, f in fns:
@@ -178,13 +219,16 @@ class ProjectOp(Operator):
                     cols[name] = Column(data, valid, e.dtype, _find_dictionary(e))
                 return ColumnBatch(cols, batch.live)
             return jax.jit(run)
-        key = ("project", tuple((n, expr_cache_key(e)) for n, e in self.exprs))
-        return global_jit(key, build)
+        if tkeys is not None:
+            key = ("project", tuple(n for n, _ in self.exprs), tkeys)
+        else:
+            key = ("project", tuple((n, expr_cache_key(e)) for n, e in self.exprs))
+        return global_jit(key, build), (lift.values() if lift is not None else ())
 
     def batches(self) -> Iterator[ColumnBatch]:
-        f = self._compiled()
+        f, lits = self._compiled()
         for b in self.child.batches():
-            yield f(b)
+            yield f(b, lits)
 
 
 class HashAggOp(Operator):
@@ -246,19 +290,15 @@ class HashAggOp(Operator):
     MATMUL_AGG_MAX_DOMAIN = 64
 
     def _matmul_domains(self) -> Optional[List[int]]:
-        """Static key domains if the MXU one-hot matmul agg applies, else None.
+        """Static key domains if the dense-slot agg formulations apply, else None.
 
         Eligible when every group key has a small statically known domain
-        (dictionary string or boolean — dict codes are guaranteed < len(dict)),
-        and no SUM runs over floats (byte-limb decomposition is integer-exact
-        only).  Global aggregation (no keys) is domain 1 and always eligible:
-        it turns the lexsort into plain masked reductions."""
-        inputs, lanes = self._partial_specs()
-        for _name, spec in lanes:
-            if spec.kind == "sum" and spec.arg >= 0:
-                e = inputs[spec.arg]
-                if e.dtype.clazz == dt.TypeClass.FLOAT:
-                    return None
+        (dictionary string or boolean — dict codes are guaranteed < len(dict)).
+        Global aggregation (no keys) is domain 1 and always eligible: it turns
+        the lexsort into plain masked reductions.  Which dense-slot kernel runs
+        (MXU one-hot matmul vs CPU scatter-add) is decided per-backend inside
+        `K.groupby`; the matmul byte-limb path additionally rejects float SUMs
+        there."""
         domains: List[int] = []
         total = 1
         for _n, e in self.group_exprs:
@@ -279,7 +319,7 @@ class HashAggOp(Operator):
 
     def _partial_fn(self, max_groups: int):
         domains = self._matmul_domains()
-        key = ("agg_partial", self._cache_key(), max_groups,
+        key = ("agg_partial", jax.default_backend(), self._cache_key(), max_groups,
                tuple(domains) if domains is not None else None)
 
         def build():
@@ -307,11 +347,10 @@ class HashAggOp(Operator):
                 n = batch.capacity
                 keys = [broadcast_value(n, *f(env)) for f in gfns]
                 ins = [broadcast_value(n, *f(env)) for f in ifns]
-                if domains is not None:
-                    # small-domain MXU path: one-hot int8 matmul, no lexsort
-                    return K.matmul_groupby(keys, ins, specs, batch.live_mask(),
-                                            domains)
-                return K.sort_groupby(keys, ins, specs, batch.live_mask(), max_groups)
+                # backend-adaptive: dense-slot (matmul/scatter) when domains are
+                # small and static, hash (CPU) / lexsort (TPU) otherwise
+                return K.groupby(keys, ins, specs, batch.live_mask(), max_groups,
+                                 domains)
             return jax.jit(run)
         return global_jit(key, build)
 
@@ -319,12 +358,12 @@ class HashAggOp(Operator):
                   merge_specs: Tuple[K.AggSpec, ...]):
         # shared across ALL aggregations: behavior depends only on the merge specs and
         # capacity (key/agg lane dtypes are part of jit's own trace signature)
-        key = ("agg_merge", max_groups, n_keys, merge_specs)
+        key = ("agg_merge", jax.default_backend(), max_groups, n_keys, merge_specs)
 
         def build():
             def run(key_lanes, input_lanes, live):
-                return K.sort_groupby(key_lanes, input_lanes, merge_specs, live,
-                                      max_groups)
+                return K.groupby(key_lanes, input_lanes, merge_specs, live,
+                                 max_groups)
             return jax.jit(run)
         return global_jit(key, build)
 
@@ -431,6 +470,12 @@ class HashAggOp(Operator):
                                 jnp.int32(0), jnp.bool_(False))
             return self._finalize(jax.tree.map(jnp.asarray,
                                                jax.tree.map(np.asarray, r)),
+                                  lane_names)
+
+        if len(partials) == 1 and not spiller.spilled_files:
+            # single partial (the common fused-scan case): it IS the result —
+            # partial and merge lane layouts coincide, skip the merge kernel
+            return self._finalize(jax.tree.map(jnp.asarray, partials[0]),
                                   lane_names)
 
         acc: Optional[K.GroupByResult] = None
@@ -558,7 +603,8 @@ class HashJoinOp(Operator):
                  join_type: str = "inner",
                  residual: Optional[ir.Expr] = None,
                  build_schema: Optional[Dict[str, Tuple[dt.DataType,
-                                                        Optional[Dictionary]]]] = None):
+                                                        Optional[Dictionary]]]] = None,
+                 spill_threshold: int = 256 << 20):
         assert join_type in ("inner", "left", "semi", "anti")
         self.build, self.probe = build, probe
         self.build_keys, self.probe_keys = list(build_keys), list(probe_keys)
@@ -567,6 +613,10 @@ class HashJoinOp(Operator):
         # build-side output schema, needed to null-extend when the build side is EMPTY
         # (otherwise the left-join output would be missing the build columns entirely)
         self.build_schema = build_schema
+        # grace spill: a build side above this partitions BOTH sides by key
+        # hash to disk and joins bucket pairs (HybridHashJoinExec analog)
+        self.spill_threshold = spill_threshold
+        self.grace_partitions = 0  # observable spill counter (tests)
 
     def _key_compilers(self):
         """Compile key pairs into a common lane domain.
@@ -594,7 +644,7 @@ class HashJoinOp(Operator):
         return bk, pk
 
     def _pairs_fn(self, cap: int):
-        key = ("join_pairs", cap,
+        key = ("join_pairs", jax.default_backend(), cap,
                tuple(expr_cache_key(e) for e in self.build_keys),
                tuple(expr_cache_key(e) for e in self.probe_keys))
 
@@ -613,7 +663,15 @@ class HashJoinOp(Operator):
     BLOOM_MAX_BUILD = 1 << 20
 
     def _build_bloom(self, build_batch: ColumnBatch, pf):
-        """Host-built bloom over the build key; probe batches filter on device."""
+        """Runtime bloom over the build key; probe batches filter on device.
+
+        CPU builds the filter on device too (byte-plane bloom via scatter-max:
+        no bit packing, one flag byte per bloom bit) — the host round trip of
+        the build columns plus the num_live sync cost more than the whole join
+        there.  TPU keeps the native host build + packed-word device query
+        (device scatters serialize on TPU)."""
+        if K.prefer_scatter():
+            return self._build_bloom_device(build_batch, pf)
         from galaxysql_tpu import native
         n_build = build_batch.num_live()
         if n_build == 0 or n_build > self.BLOOM_MAX_BUILD:
@@ -643,6 +701,175 @@ class HashJoinOp(Operator):
             return ColumnBatch(batch.columns, live2)
         return apply
 
+    BLOOM_DEVICE_MAX_BITS = 1 << 24
+
+    def _build_bloom_device(self, build_batch: ColumnBatch, pf):
+        if build_batch.capacity == 0 or \
+                build_batch.capacity > self.BLOOM_MAX_BUILD:
+            return None
+        be = self.build_keys[0]
+        nbits = 1 << max(12, int(build_batch.capacity * 16 - 1).bit_length())
+        nbits = min(nbits, self.BLOOM_DEVICE_MAX_BITS)
+        key = ("bloom_dev", nbits, expr_cache_key(be),
+               expr_cache_key(self.probe_keys[0]))
+
+        def build_fns():
+            comp = ExprCompiler(jnp)
+            bf = comp.compile(be)
+            mask = jnp.uint64(nbits - 1)
+
+            def bits(d):
+                h = K._mix64(d.astype(jnp.int64).astype(jnp.uint64))
+                return ((h & mask).astype(jnp.int32),
+                        ((h >> jnp.uint64(32)) & mask).astype(jnp.int32))
+
+            def build_flags(batch: ColumnBatch):
+                env = batch_env(batch)
+                d, v = bf(env)
+                live = batch.live_mask()
+                if v is not None:
+                    live = live & v
+                d, _ = broadcast_value(batch.capacity, d, None)
+                b1, b2 = bits(d)
+                drop = jnp.int32(nbits)
+                b1 = jnp.where(live, b1, drop)
+                b2 = jnp.where(live, b2, drop)
+                flags = jnp.zeros(nbits, jnp.uint8)
+                one = jnp.ones(batch.capacity, jnp.uint8)
+                return flags.at[b1].max(one, mode="drop").at[b2].max(
+                    one, mode="drop")
+
+            def query(batch_cols_live, flags):
+                batch, = batch_cols_live
+                env = batch_env(batch)
+                pd, pv = pf(env)
+                pd, _ = broadcast_value(batch.capacity, pd, None)
+                q1, q2 = bits(pd)
+                hit = (flags[q1] & flags[q2]) > 0
+                live2 = batch.live_mask() & hit
+                if pv is not None:
+                    live2 = live2 & pv
+                return ColumnBatch(batch.columns, live2)
+
+            return jax.jit(build_flags), jax.jit(query, static_argnums=())
+        build_flags, query = global_jit(key, build_fns)
+        flags = build_flags(build_batch)
+
+        def apply(batch: ColumnBatch) -> ColumnBatch:
+            return query((batch,), flags)
+        return apply
+
+    # -- grace spill (HybridHashJoinExec analog) -----------------------------
+
+    def _key_compilers_np(self):
+        """Host twins of _key_compilers: key lanes in a common np domain."""
+        comp = ExprCompiler(np)
+        bk, pk = [], []
+        for be, pe in zip(self.build_keys, self.probe_keys):
+            bf, pf = comp.compile(be), comp.compile(pe)
+            if be.dtype.is_string and pe.dtype.is_string:
+                db = _find_dictionary(be)
+                dp = _find_dictionary(pe)
+                if db is not None and dp is not None and db is not dp:
+                    trans = np.asarray(dictionary_translation(db, dp))
+
+                    def translated(env, _pf=pf, _t=trans):
+                        d, v = _pf(env)
+                        return _t[np.clip(d, 0, _t.shape[0] - 1)], v
+                    pf = translated
+            bk.append(bf)
+            pk.append(pf)
+        return bk, pk
+
+    @staticmethod
+    def _np_bucket(batch: ColumnBatch, kfns, P: int) -> np.ndarray:
+        """Per-row bucket id from the join-key hash (host)."""
+        from galaxysql_tpu.meta.statistics import _mix64
+        env = {n: (c.np_data(), None if c.valid is None else c.np_valid())
+               for n, c in batch.columns.items()}
+        h = None
+        for f in kfns:
+            d, v = f(env)
+            d = np.broadcast_to(np.asarray(d), (batch.capacity,))
+            lane = _mix64(d.astype(np.int64).astype(np.uint64))
+            if v is not None:
+                vv = np.broadcast_to(np.asarray(v), (batch.capacity,))
+                lane = np.where(vv, lane, np.uint64(0xDEADBEEFCAFEBABE))
+            h = lane if h is None else _mix64(
+                h * np.uint64(31) + lane + np.uint64(0x9E3779B97F4A7C15))
+        return (h & np.uint64(P - 1)).astype(np.int64)
+
+    @staticmethod
+    def _spill_split(batch: ColumnBatch, buckets: np.ndarray, P: int,
+                     spillers, schema_out: dict):
+        live = batch.np_live()
+        for name, c in batch.columns.items():
+            schema_out.setdefault(name, (c.dtype, c.dictionary))
+        for p in range(P):
+            sel = np.nonzero(live & (buckets == p))[0]
+            if sel.size == 0:
+                continue
+            arrays = {}
+            for name, c in batch.columns.items():
+                arrays[f"d::{name}"] = c.np_data()[sel]
+                if c.valid is not None:
+                    arrays[f"v::{name}"] = c.np_valid()[sel]
+            arrays["::n"] = np.asarray([sel.size])
+            spillers[p].spill(arrays)
+
+    @staticmethod
+    def _rebuild(run: dict, schema: dict) -> ColumnBatch:
+        n = int(run["::n"][0])
+        cols = {}
+        for name, (typ, d_) in schema.items():
+            d = run[f"d::{name}"]
+            v = run.get(f"v::{name}")
+            cols[name] = Column(jnp.asarray(d),
+                                None if v is None else jnp.asarray(v), typ, d_)
+        return ColumnBatch(cols, jnp.ones(n, dtype=jnp.bool_))
+
+    def _grace_batches(self, build_parts: List[ColumnBatch],
+                       build_iter) -> Iterator[ColumnBatch]:
+        """Partition BOTH sides by key hash into P disk buckets; join each
+        bucket pair in memory.  Rows of one key land in one bucket on both
+        sides, so per-bucket joins compose exactly — including left/anti
+        unmatched semantics (a probe row can only ever match inside its own
+        bucket).  Build batches stream straight into buckets — the collected
+        prefix spills first, then the remainder one batch at a time."""
+        from galaxysql_tpu.exec.spill import Spiller
+        P = 16  # total build size is unknown mid-stream; bucket pairs that
+        #         still exceed memory join in-memory (bounded recursion none)
+        self.grace_partitions = P
+        bk, pk = self._key_compilers_np()
+        b_spill = [Spiller() for _ in range(P)]
+        p_spill = [Spiller() for _ in range(P)]
+        b_schema: dict = {}
+        p_schema: dict = {}
+        try:
+            import itertools
+            for bb in itertools.chain(build_parts, build_iter):
+                self._spill_split(bb, self._np_bucket(bb, bk, P), P, b_spill,
+                                  b_schema)
+            for pb in self.probe.batches():
+                self._spill_split(pb, self._np_bucket(pb, pk, P), P, p_spill,
+                                  p_schema)
+            for p in range(P):
+                p_runs = [self._rebuild(r, p_schema)
+                          for r in p_spill[p].read_all()]
+                if not p_runs and self.join_type in ("inner", "semi"):
+                    continue
+                b_runs = [self._rebuild(r, b_schema)
+                          for r in b_spill[p].read_all()]
+                inner = HashJoinOp(
+                    SourceOp(b_runs), SourceOp(p_runs),
+                    self.build_keys, self.probe_keys, self.join_type,
+                    self.residual, self.build_schema,
+                    spill_threshold=1 << 62)  # bucket pairs join in memory
+                yield from inner.batches()
+        finally:
+            for s in b_spill + p_spill:
+                s.close()
+
     @staticmethod
     def _gather(batch: ColumnBatch, idx, live) -> Dict[str, Column]:
         cols = {}
@@ -653,7 +880,20 @@ class HashJoinOp(Operator):
         return cols
 
     def batches(self) -> Iterator[ColumnBatch]:
-        build_batch = concat_batches(list(self.build.batches()))
+        # accumulate the build side batch-by-batch; crossing the spill
+        # threshold hands the ALREADY-collected prefix plus the still-unread
+        # remainder to the grace path, so peak memory stays ~threshold (the
+        # full build is never concatenated first)
+        build_parts: List[ColumnBatch] = []
+        build_bytes = 0
+        build_iter = iter(self.build.batches())
+        for b in build_iter:
+            build_parts.append(b)
+            build_bytes += _batch_bytes(b)
+            if build_bytes > self.spill_threshold:
+                yield from self._grace_batches(build_parts, build_iter)
+                return
+        build_batch = concat_batches(build_parts)
         if build_batch.capacity == 0:
             # empty build: inner/semi yield nothing; anti passes probe rows through;
             # left null-extends using the declared build schema
@@ -794,15 +1034,26 @@ class CrossJoinOp(Operator):
 
 
 class SortOp(Operator):
-    """ORDER BY [LIMIT]: materializes input, sorts once."""
+    """ORDER BY [LIMIT]: in-memory sort, or external sorted-run merge when the
+    input exceeds the spill threshold.
+
+    External path (SpilledTopNExec / external-sort analog): each
+    threshold-sized slab is sorted on device, compacted, and spilled as a
+    sorted run of host arrays (output columns + precomputed comparison-coded
+    key lanes); runs then stream through a bounded-memory chunked k-way merge
+    (per-run chunk heads, safe-prefix cut at the smallest chunk-tail key, the
+    prefix merged with one np.lexsort per wave)."""
 
     def __init__(self, child: Operator,
                  keys: Sequence[Tuple[ir.Expr, bool]],  # (expr, descending)
-                 limit: Optional[int] = None, offset: int = 0):
+                 limit: Optional[int] = None, offset: int = 0,
+                 spill_threshold: int = 256 << 20):
         self.child = child
         self.keys = list(keys)
         self.limit = limit
         self.offset = offset
+        self.spill_threshold = spill_threshold
+        self.spilled_runs = 0  # observable spill counter (tests, EXPLAIN)
 
     def _compiled(self):
         key = ("sort", tuple((expr_cache_key(e), desc) for e, desc in self.keys),
@@ -851,12 +1102,179 @@ class SortOp(Operator):
         return global_jit(key, build)
 
     def batches(self) -> Iterator[ColumnBatch]:
-        merged = concat_batches(list(self.child.batches()))
+        from galaxysql_tpu.exec.spill import Spiller
+        slab: List[ColumnBatch] = []
+        slab_bytes = 0
+        spiller = Spiller()
+        run_meta: List[int] = []  # row count per spilled run
+        try:
+            for b in self.child.batches():
+                slab.append(b)
+                slab_bytes += _batch_bytes(b)
+                if slab_bytes > self.spill_threshold:
+                    self._spill_run(slab, spiller, run_meta)
+                    slab = []
+                    slab_bytes = 0
+            if not run_meta:
+                merged = concat_batches(slab)
+                if merged.capacity == 0:
+                    yield merged
+                    return
+                padded = merged.pad_to(bucket_capacity(merged.capacity))
+                yield self._compiled()(padded)
+                return
+            if slab:
+                self._spill_run(slab, spiller, run_meta)
+            yield from self._merge_runs(spiller, run_meta)
+        finally:
+            spiller.close()
+
+    # -- external sort -------------------------------------------------------
+
+    def _key_codes(self, batch: ColumnBatch) -> List[np.ndarray]:
+        """Comparison-coded host key lanes: lexsort over them (major key first)
+        reproduces sort_indices order — NULL placement as a leading lane, DESC
+        via exact integer complement (~x) / float negation."""
+        env = {n: (c.np_data(), None if c.valid is None else c.np_valid())
+               for n, c in batch.columns.items()}
+        comp = ExprCompiler(np)
+        out: List[np.ndarray] = []
+        for e, desc in self.keys:
+            d, v = comp.compile(e)(env)
+            d = np.broadcast_to(np.asarray(d), (batch.capacity,))
+            if e.dtype.is_string:
+                d_ = _find_dictionary(e)
+                if d_ is not None and len(d_) and not d_.is_sorted:
+                    d = d_.rank_array()[np.clip(d, 0, len(d_) - 1)]
+            nulls_first = not desc  # MySQL: NULLs first asc, last desc
+            if v is None:
+                nk = np.ones(batch.capacity, np.int8)
+            else:
+                vv = np.broadcast_to(np.asarray(v), (batch.capacity,))
+                nk = np.where(vv, np.int8(1), np.int8(0))
+            if not nulls_first:
+                nk = np.int8(1) - nk
+            if np.issubdtype(d.dtype, np.floating):
+                dk = -d.astype(np.float64) if desc else d.astype(np.float64)
+            else:
+                di = d.astype(np.int64)
+                dk = ~di if desc else di
+            if v is not None:
+                dk = np.where(np.broadcast_to(np.asarray(v), dk.shape), dk, 0)
+            out.append(nk)
+            out.append(dk)
+        return out
+
+    def _spill_run(self, slab: List[ColumnBatch], spiller, run_meta: List[int]):
+        merged = concat_batches(slab)
         if merged.capacity == 0:
-            yield merged
             return
-        padded = merged.pad_to(bucket_capacity(merged.capacity))
-        yield self._compiled()(padded)
+        codes = self._key_codes(merged)
+        live = merged.np_live()
+        order = np.lexsort(tuple(reversed(codes)))
+        order = order[live[order]]  # compact: spilled runs hold live rows only
+        arrays: Dict[str, np.ndarray] = {}
+        for i, k in enumerate(codes):
+            arrays[f"k{i}"] = k[order]
+        for name, c in merged.columns.items():
+            arrays[f"d::{name}"] = c.np_data()[order]
+            if c.valid is not None:
+                arrays[f"v::{name}"] = c.np_valid()[order]
+        # column dtypes/dictionaries survive OUTSIDE the npz (metadata, not lanes)
+        self._run_schema = [(name, c.dtype, c.dictionary)
+                            for name, c in merged.columns.items()]
+        spiller.spill_mmap(arrays)
+        run_meta.append(int(order.shape[0]))
+        self.spilled_runs += 1
+
+    @staticmethod
+    def _tuple_le(ks: List[np.ndarray], bound: Tuple) -> np.ndarray:
+        """Vectorized lexicographic (k0,k1,...) <= bound."""
+        lt = np.zeros(ks[0].shape[0], dtype=bool)
+        eq = np.ones(ks[0].shape[0], dtype=bool)
+        for a, b in zip(ks, bound):
+            lt = lt | (eq & (a < b))
+            eq = eq & (a == b)
+        return lt | eq
+
+    def _merge_runs(self, spiller, run_meta: List[int]) -> Iterator[ColumnBatch]:
+        # mmap-backed: only the pages each merge wave slices become resident,
+        # so peak memory is ~CHUNK x runs, not the full input
+        runs = [spiller.open_mmap(i) for i in range(len(run_meta))]
+        nk = 2 * len(self.keys)
+        heads = [0] * len(runs)
+        sizes = run_meta
+        emitted = 0  # rows streamed out so far (pre offset/limit windowing)
+        stop_at = None if self.limit is None else self.offset + self.limit
+        CHUNK = 65536
+
+        while stop_at is None or emitted < stop_at:
+            # chunk window per live run; the merge-safe bound is the SMALLEST
+            # among unfinished runs' chunk-tail keys (rows <= bound cannot be
+            # preceded by any unread row)
+            windows = []
+            bound = None
+            for ri, r in enumerate(runs):
+                if heads[ri] >= sizes[ri]:
+                    continue
+                end = min(heads[ri] + CHUNK, sizes[ri])
+                windows.append((ri, end))
+                if end < sizes[ri]:
+                    tail = tuple(r[f"k{i}"][end - 1] for i in range(nk))
+                    if bound is None or tail < bound:
+                        bound = tail
+            if not windows:
+                break
+            take: List[Tuple[int, int, int]] = []  # (run, lo, hi)
+            for ri, end in windows:
+                lo = heads[ri]
+                if bound is None:
+                    hi = end
+                else:
+                    ks = [runs[ri][f"k{i}"][lo:end] for i in range(nk)]
+                    hi = lo + int(np.count_nonzero(self._tuple_le(ks, bound)))
+                if hi > lo:
+                    take.append((ri, lo, hi))
+                    heads[ri] = hi
+            if not take:
+                # every candidate sits above the bound (tie pathologies): the
+                # bound-owning run's whole chunk is safe by construction
+                ri, end = min(windows, key=lambda w: tuple(
+                    runs[w[0]][f"k{i}"][w[1] - 1] for i in range(nk)))
+                take = [(ri, heads[ri], end)]
+                heads[ri] = end
+            kparts = [np.concatenate([runs[ri][f"k{i}"][lo:hi]
+                                      for ri, lo, hi in take])
+                      for i in range(nk)]
+            order = np.lexsort(tuple(reversed(kparts)))
+            n = order.shape[0]
+            out_cols: Dict[str, Column] = {}
+            for name, typ, dict_ in self._run_schema:
+                d = np.concatenate([runs[ri][f"d::{name}"][lo:hi]
+                                    for ri, lo, hi in take])[order]
+                vcat = None
+                if any(f"v::{name}" in runs[ri] for ri, _, _ in take):
+                    vcat = np.concatenate(
+                        [runs[ri][f"v::{name}"][lo:hi]
+                         if f"v::{name}" in runs[ri]
+                         else np.ones(hi - lo, dtype=bool)
+                         for ri, lo, hi in take])[order]
+                out_cols[name] = Column(
+                    jnp.asarray(d), None if vcat is None else jnp.asarray(vcat),
+                    typ, dict_)
+            pos = emitted + np.arange(n)
+            live = pos >= self.offset
+            if stop_at is not None:
+                live = live & (pos < stop_at)
+            emitted += n
+            yield ColumnBatch(out_cols, jnp.asarray(live))
+
+
+def _batch_bytes(b: ColumnBatch) -> int:
+    total = 0
+    for c in b.columns.values():
+        total += c.data.nbytes + (c.valid.nbytes if c.valid is not None else 0)
+    return total
 
 
 class LimitOp(Operator):

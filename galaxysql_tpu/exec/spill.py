@@ -64,7 +64,8 @@ class Spiller:
 
     def __init__(self, manager: SpillSpaceManager = SPILL_MANAGER):
         self.manager = manager
-        self.files: List[tuple] = []  # (path, nbytes)
+        self.files: List[tuple] = []  # (path, nbytes) npz bundles
+        self.dirs: List[tuple] = []   # (dir, nbytes) mmap runs
 
     def spill(self, arrays: Dict[str, np.ndarray]) -> int:
         path = self.manager.allocate_path()
@@ -81,7 +82,43 @@ class Spiller:
 
     @property
     def spilled_files(self) -> int:
-        return len(self.files)
+        return len(self.files) + len(self.dirs)
+
+    # -- mmap runs -----------------------------------------------------------
+    # npz bundles decompress whole arrays on read; consumers that must stay
+    # bounded-memory over MANY runs at once (external-sort k-way merge) use
+    # directory runs of raw .npy files instead and read them mmap-backed, so
+    # only the pages a merge wave touches become resident.
+
+    dirs: List[tuple]
+
+    def spill_mmap(self, arrays: Dict[str, np.ndarray]) -> int:
+        """Write a run as a directory of raw .npy files; returns the run index."""
+        import json
+        base = self.manager.allocate_path() + ".d"
+        os.makedirs(base, exist_ok=True)
+        manifest = {}
+        total = 0
+        for i, (k, a) in enumerate(arrays.items()):
+            fn = f"a{i}.npy"
+            np.save(os.path.join(base, fn), np.ascontiguousarray(a))
+            manifest[k] = fn
+            total += os.path.getsize(os.path.join(base, fn))
+        with open(os.path.join(base, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+        self.manager.charge(total)
+        self.dirs.append((base, total))
+        return len(self.dirs) - 1
+
+    def open_mmap(self, run_ix: int) -> Dict[str, np.ndarray]:
+        """Lazily-paged views of one run (np.load mmap_mode='r')."""
+        import json
+        base, _ = self.dirs[run_ix]
+        with open(os.path.join(base, "manifest.json")) as f:
+            manifest = json.load(f)
+        return {k: np.load(os.path.join(base, fn), mmap_mode="r",
+                           allow_pickle=False)
+                for k, fn in manifest.items()}
 
     def close(self):
         for path, nbytes in self.files:
@@ -91,3 +128,7 @@ class Spiller:
                 pass
             self.manager.refund(nbytes)
         self.files.clear()
+        for base, nbytes in self.dirs:
+            shutil.rmtree(base, ignore_errors=True)
+            self.manager.refund(nbytes)
+        self.dirs.clear()

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import re
 from functools import reduce
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -101,11 +101,89 @@ def _temporal_days(xp, data, typ: dt.DataType):
     return data
 
 
-class ExprCompiler:
-    """Compiles bound IR against a fixed backend (`numpy` or `jax.numpy`)."""
+def param_eligible(n: ir.Expr) -> bool:
+    """Numeric scalar literals can be lifted into runtime kernel parameters.
 
-    def __init__(self, xp):
+    Strings/dictionary literals must stay baked (they resolve against host
+    dictionaries at compile time: code lookup, rank bisection, LIKE regex);
+    NULL literals are value-free already.  Lifting numeric literals makes the
+    compiled-kernel cache key value-independent, so `WHERE id = 7` and
+    `WHERE id = 9` share one XLA program — the point-query latency floor is the
+    bind+dispatch path, not a fresh ~35ms XLA compile per literal (reference
+    seam: PlanCache.java:80 parameterized plans)."""
+    return (isinstance(n, ir.Literal) and n.value is not None
+            and n.dictionary is None and not n.dtype.is_string)
+
+
+class LiftedLiterals:
+    """Slot assignment + encoded runtime values for lifted literals.
+
+    Built once per operator from its expression list; the same instance hands
+    (a) a value-independent template key per expression, (b) the id->slot map
+    the compiler consults, and (c) the encoded scalar tuple passed to the
+    jitted kernel each execution."""
+
+    def __init__(self, exprs: Sequence[ir.Expr]):
+        self.slots: dict = {}   # id(node) -> slot index
+        self.nodes: List[ir.Literal] = []
+        for e in exprs:
+            for n in ir.walk(e):
+                if param_eligible(n) and id(n) not in self.slots:
+                    self.slots[id(n)] = len(self.nodes)
+                    self.nodes.append(n)
+
+    def template_key(self, e: ir.Expr):
+        """e.key() with lifted literal values masked, or None when the masking
+        is ambiguous (fall back to value-baked keys — always correct)."""
+        expected = [n.key() for n in ir.walk(e) if param_eligible(n)]
+        taken = [0]
+
+        def rw(k):
+            if isinstance(k, tuple):
+                if (taken[0] < len(expected) and k == expected[taken[0]]):
+                    taken[0] += 1
+                    return ("litp", k[2] if len(k) > 2 else None)
+                return tuple(rw(x) for x in k)
+            return k
+
+        masked = rw(e.key())
+        return masked if taken[0] == len(expected) else None
+
+    def values(self) -> Tuple:
+        """Encoded lane-domain scalars, slot order (host numpy, fixed dtypes)."""
+        out = []
+        for n in self.nodes:
+            v = _encode_literal_value(n.value, n.dtype)
+            lane = n.dtype.lane if n.dtype.clazz != dt.TypeClass.FLOAT \
+                else np.float32
+            out.append(np.asarray(v, dtype=lane))
+        return tuple(out)
+
+
+def _encode_literal_value(value, typ: dt.DataType):
+    """Python literal -> lane-domain scalar (shared by bake and lift paths)."""
+    if typ.clazz == dt.TypeClass.DECIMAL:
+        return int(round(float(value) * _pow10(typ.scale)))
+    if typ.clazz == dt.TypeClass.DATE:
+        return temporal.parse_date(value) if isinstance(value, str) else int(value)
+    if typ.clazz == dt.TypeClass.DATETIME:
+        return temporal.parse_datetime(value) if isinstance(value, str) else int(value)
+    if typ.clazz == dt.TypeClass.FLOAT:
+        return float(value)
+    if typ.is_string:
+        return value  # encoded lazily against the peer dictionary
+    return int(value)
+
+
+class ExprCompiler:
+    """Compiles bound IR against a fixed backend (`numpy` or `jax.numpy`).
+
+    With `lift` (a LiftedLiterals), eligible literals compile to runtime
+    lookups of env["$lits"][slot] instead of baked constants."""
+
+    def __init__(self, xp, lift: Optional[LiftedLiterals] = None):
         self.xp = xp
+        self.lift = lift
 
     # -- public -----------------------------------------------------------
 
@@ -147,23 +225,17 @@ class ExprCompiler:
         """Python literal -> lane-domain scalar."""
         if value is None:
             return None
-        if typ.clazz == dt.TypeClass.DECIMAL:
-            return int(round(float(value) * _pow10(typ.scale)))
-        if typ.clazz == dt.TypeClass.DATE:
-            return temporal.parse_date(value) if isinstance(value, str) else int(value)
-        if typ.clazz == dt.TypeClass.DATETIME:
-            return temporal.parse_datetime(value) if isinstance(value, str) else int(value)
-        if typ.clazz == dt.TypeClass.FLOAT:
-            return float(value)
-        if typ.is_string:
-            return value  # encoded lazily against the peer dictionary
-        return int(value)
+        return _encode_literal_value(value, typ)
 
     def _literal(self, e: ir.Literal) -> Compiled:
         xp = self.xp
         if e.value is None:
             zero = np.zeros((), dtype=e.dtype.lane)
             return lambda env: (xp.asarray(zero), xp.zeros((), dtype=xp.bool_))
+        if self.lift is not None:
+            ix = self.lift.slots.get(id(e))
+            if ix is not None:
+                return lambda env: (env["$lits"][ix], None)
         v = self._encode_scalar(e.value, e.dtype)
         if isinstance(v, str):
             raise ValueError(

@@ -213,19 +213,7 @@ def matmul_groupby(keys: Sequence[Tuple[Any, Optional[Any]]],
     is always False (capacity is the static domain).
     """
     n = live.shape[0]
-    sizes: List[int] = []
-    effs: List[Any] = []
-    for (data, valid), dom in zip(keys, domains):
-        d = jnp.clip(data.astype(jnp.int32), 0, dom - 1)
-        size = dom + (1 if valid is not None else 0)
-        effs.append(d if valid is None else jnp.where(valid, d, jnp.int32(dom)))
-        sizes.append(size)
-    D = 1
-    for s in sizes:
-        D *= s
-    gid = jnp.zeros(n, dtype=jnp.int32)
-    for eff, size in zip(effs, sizes):
-        gid = gid * size + eff
+    gid, sizes, D = _domain_gid(keys, domains, n)
 
     # lane plan: [ones] + [present per distinct input] + [8 limbs per sum input]
     present_lane: dict = {}
@@ -279,14 +267,7 @@ def matmul_groupby(keys: Sequence[Tuple[Any, Optional[Any]]],
 
     # output key lanes decode the slot index back into per-key codes
     idx = jnp.arange(D, dtype=jnp.int32)
-    out_keys: List[Tuple[Any, Any]] = []
-    stride = D
-    for (data, valid), dom, size in zip(keys, domains, sizes):
-        stride //= size
-        slot = (idx // stride) % size
-        kd = jnp.clip(slot, 0, dom - 1).astype(data.dtype)
-        kv = None if valid is None else (slot < dom)
-        out_keys.append((kd, kv))
+    out_keys = _domain_out_keys(keys, domains, sizes, D)
 
     out_aggs: List[Tuple[Any, Any]] = []
     for spec in specs:
@@ -321,6 +302,287 @@ def matmul_groupby(keys: Sequence[Tuple[Any, Optional[Any]]],
                          num_groups.astype(jnp.int32), jnp.bool_(False))
 
 
+def _domain_gid(keys, domains, n):
+    """Encode small-domain key lanes into one dense group id (NULL slot last per
+    key) plus per-key sizes; shared by the matmul and scatter formulations."""
+    sizes: List[int] = []
+    effs: List[Any] = []
+    for (data, valid), dom in zip(keys, domains):
+        d = jnp.clip(data.astype(jnp.int32), 0, dom - 1)
+        size = dom + (1 if valid is not None else 0)
+        effs.append(d if valid is None else jnp.where(valid, d, jnp.int32(dom)))
+        sizes.append(size)
+    D = 1
+    for s in sizes:
+        D *= s
+    gid = jnp.zeros(n, dtype=jnp.int32)
+    for eff, size in zip(effs, sizes):
+        gid = gid * size + eff
+    return gid, sizes, D
+
+
+def _domain_out_keys(keys, domains, sizes, D):
+    """Decode domain slot indices back into per-key code lanes (matmul layout)."""
+    idx = jnp.arange(D, dtype=jnp.int32)
+    out_keys: List[Tuple[Any, Any]] = []
+    stride = D
+    for (data, valid), dom, size in zip(keys, domains, sizes):
+        stride //= size
+        slot = (idx // stride) % size
+        kd = jnp.clip(slot, 0, dom - 1).astype(data.dtype)
+        kv = None if valid is None else (slot < dom)
+        out_keys.append((kd, kv))
+    return out_keys
+
+
+def scatter_groupby(keys: Sequence[Tuple[Any, Optional[Any]]],
+                    inputs: Sequence[Tuple[Any, Optional[Any]]],
+                    specs: Sequence[AggSpec],
+                    live: Any,
+                    domains: Sequence[int]) -> GroupByResult:
+    """Small-domain grouped aggregation via scatter-add: the XLA:CPU twin of
+    `matmul_groupby`.
+
+    Same contract and slot layout as `matmul_groupby` (domain cross product,
+    NULL slot last, live marks non-empty slots, overflow always False), but the
+    reduction is `jax.ops.segment_*` — on CPU, XLA lowers scatters to tight
+    native loops (measured ~7x faster than the one-hot int8 matmul at 1.2M
+    rows), while on TPU scatters serialize and the matmul path wins.  Float
+    sums are supported here (no byte-limb decomposition needed: segment_sum
+    accumulates in the input dtype, matching `sort_groupby`)."""
+    n = live.shape[0]
+    gid, sizes, D = _domain_gid(keys, domains, n)
+    # dead rows land in a scratch slot D that every reduction slices off
+    seg = jnp.where(live, gid, jnp.int32(D))
+
+    live_cnt = jax.ops.segment_sum(live.astype(jnp.int64), seg,
+                                   num_segments=D + 1)[:D]
+    out_live = live_cnt > 0
+    num_groups = jnp.sum(out_live.astype(jnp.int32))
+
+    present_of: dict = {}
+    pres_cnt: dict = {}
+    for spec in specs:
+        if spec.arg >= 0 and spec.arg not in present_of:
+            dta, val = inputs[spec.arg]
+            p = live if val is None else (live & val)
+            present_of[spec.arg] = p
+            pres_cnt[spec.arg] = jax.ops.segment_sum(
+                p.astype(jnp.int64), seg, num_segments=D + 1)[:D]
+
+    out_keys = _domain_out_keys(keys, domains, sizes, D)
+
+    out_aggs: List[Tuple[Any, Any]] = []
+    for spec in specs:
+        if spec.kind == "count_star":
+            out_aggs.append((live_cnt, None))
+            continue
+        dta, _val = inputs[spec.arg]
+        pres = present_of[spec.arg]
+        if spec.kind == "count":
+            out_aggs.append((pres_cnt[spec.arg], None))
+        elif spec.kind in ("sum", "sum_float"):
+            if jnp.issubdtype(dta.dtype, jnp.floating):
+                masked = jnp.where(pres, dta, jnp.zeros((), dtype=dta.dtype))
+            else:
+                masked = jnp.where(pres, dta.astype(jnp.int64), jnp.int64(0))
+            s = jax.ops.segment_sum(masked, seg, num_segments=D + 1)[:D]
+            out_aggs.append((s, pres_cnt[spec.arg] > 0))
+        elif spec.kind in ("min", "max"):
+            if jnp.issubdtype(dta.dtype, jnp.floating):
+                neutral = jnp.array(np.inf if spec.kind == "min" else -np.inf,
+                                    dta.dtype)
+            else:
+                info = jnp.iinfo(dta.dtype)
+                neutral = jnp.array(info.max if spec.kind == "min" else info.min,
+                                    dta.dtype)
+            masked = jnp.where(pres, dta, neutral)
+            red_fn = jax.ops.segment_min if spec.kind == "min" else jax.ops.segment_max
+            red = red_fn(masked, seg, num_segments=D + 1)[:D]
+            # empty slots come back as the op's own identity; normalize to neutral
+            red = jnp.where(pres_cnt[spec.arg] > 0, red, neutral.astype(dta.dtype)) \
+                if jnp.issubdtype(dta.dtype, jnp.floating) else red
+            out_aggs.append((red, pres_cnt[spec.arg] > 0))
+        else:
+            raise ValueError(f"unsupported scatter agg kind {spec.kind}")
+
+    return GroupByResult(tuple(out_keys), tuple(out_aggs), out_live,
+                         num_groups.astype(jnp.int32), jnp.bool_(False))
+
+
+def _ident_lanes(keys):
+    """Per-key (data_canon, valid) identity lanes for hashing/equality.
+
+    Floats are canonicalized (-0.0 -> +0.0, NaN -> one bit pattern) then
+    bitcast to same-width ints so hash and equality agree with SQL GROUP BY
+    semantics (0.0 == -0.0 one group, all NaNs one group, NULLs one group)."""
+    out = []
+    for data, valid in keys:
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            d = jnp.where(data == 0, jnp.zeros((), data.dtype), data)
+            d = jnp.where(jnp.isnan(d), jnp.full((), jnp.nan, data.dtype), d)
+            width = jnp.int32 if data.dtype == jnp.float32 else jnp.int64
+            d = jax.lax.bitcast_convert_type(d, width)
+        else:
+            d = data
+        if valid is not None:
+            d = jnp.where(valid, d, jnp.zeros((), d.dtype))
+        out.append((d, valid))
+    return out
+
+
+def hash_groupby(keys: Sequence[Tuple[Any, Optional[Any]]],
+                 inputs: Sequence[Tuple[Any, Optional[Any]]],
+                 specs: Sequence[AggSpec],
+                 live: Any,
+                 max_groups: int,
+                 max_rounds: int = 64) -> GroupByResult:
+    """General grouped aggregation via open-addressing hash slots — no sort.
+
+    The XLA:CPU twin of `sort_groupby`: on CPU, XLA's comparator sorts are
+    single-threaded and catastrophically slow (lexsort of 1.2M rows ~1.3s)
+    while scatters are fast (~10ms), so group ids are assigned by hashing keys
+    into a power-of-two slot table.  Each round, unresolved rows probing an
+    EMPTY slot elect an owner by scatter-min on row index; every row then
+    verifies its actual key lanes against the owner's (hash collisions cost
+    extra rounds, never correctness).  Rows whose keys match the owner adopt
+    the slot as their group id; the rest re-probe with an odd per-key stride.
+    Aggregation is then `jax.ops.segment_*` by slot.
+
+    Output slots are in hash order, NOT compacted — `live` marks real groups,
+    the same contract `matmul_groupby` established.  `overflow` is True when
+    placement fails within `max_rounds` (distinct groups exceed capacity or
+    pathological clustering); callers retry with doubled `max_groups`."""
+    n = live.shape[0] if not keys else keys[0][0].shape[0]
+    cap = max(16, min(max_groups, n))
+    M = 1 << int(cap * 2 - 1).bit_length()  # load factor <= 0.5 at capacity
+
+    ident = _ident_lanes(keys)
+    h = hash_columns(ident)
+    s0 = h & jnp.uint64(M - 1)
+    # odd stride => full cycle mod the power-of-two table size
+    step = ((h >> jnp.uint64(32)) << jnp.uint64(1)) | jnp.uint64(1)
+
+    rowid = jnp.arange(n, dtype=jnp.int32)
+    sentinel = jnp.int32(n)
+
+    def cond(state):
+        r, rep, resolved, gid = state
+        return (r < max_rounds) & jnp.any(~resolved)
+
+    def body(state):
+        r, rep, resolved, gid = state
+        s = ((s0 + r.astype(jnp.uint64) * step) &
+             jnp.uint64(M - 1)).astype(jnp.int32)
+        occupied = rep[s] != sentinel
+        cand = jnp.where(resolved | occupied, sentinel, rowid)
+        rep = rep.at[s].min(cand)
+        owner = rep[s]
+        safe = jnp.clip(owner, 0, max(n - 1, 0))
+        same = owner != sentinel
+        for d, valid in ident:
+            same = same & (d[safe] == d)
+            if valid is not None:
+                same = same & (valid[safe] == valid)
+        newly = ~resolved & same
+        gid = jnp.where(newly, s, gid)
+        return r + jnp.uint64(1), rep, resolved | newly, gid
+
+    state = (jnp.uint64(0), jnp.full(M, sentinel, jnp.int32),
+             ~live, jnp.zeros(n, jnp.int32))
+    _, rep, resolved, gid = jax.lax.while_loop(cond, body, state)
+    overflow = jnp.any(~resolved)
+
+    placed = resolved & live
+    seg = jnp.where(placed, gid, jnp.int32(M))
+
+    live_cnt = jax.ops.segment_sum(live.astype(jnp.int64), seg,
+                                   num_segments=M + 1)[:M]
+    out_live = rep != sentinel
+    num_groups = jnp.sum(out_live.astype(jnp.int32))
+
+    safe_rep = jnp.clip(rep, 0, max(n - 1, 0))
+    out_keys = []
+    for data, valid in keys:
+        out_keys.append((data[safe_rep],
+                         None if valid is None else (valid[safe_rep] & out_live)))
+
+    present_of: dict = {}
+    pres_cnt: dict = {}
+    for spec in specs:
+        if spec.arg >= 0 and spec.arg not in present_of:
+            dta, val = inputs[spec.arg]
+            p = placed if val is None else (placed & val)
+            present_of[spec.arg] = p
+            pres_cnt[spec.arg] = jax.ops.segment_sum(
+                p.astype(jnp.int64), seg, num_segments=M + 1)[:M]
+
+    out_aggs: List[Tuple[Any, Any]] = []
+    for spec in specs:
+        if spec.kind == "count_star":
+            out_aggs.append((live_cnt, None))
+            continue
+        dta, _val = inputs[spec.arg]
+        pres = present_of[spec.arg]
+        if spec.kind == "count":
+            out_aggs.append((pres_cnt[spec.arg], None))
+        elif spec.kind in ("sum", "sum_float"):
+            if jnp.issubdtype(dta.dtype, jnp.floating):
+                masked = jnp.where(pres, dta, jnp.zeros((), dtype=dta.dtype))
+            else:
+                masked = jnp.where(pres, dta.astype(jnp.int64), jnp.int64(0))
+            s = jax.ops.segment_sum(masked, seg, num_segments=M + 1)[:M]
+            out_aggs.append((s, pres_cnt[spec.arg] > 0))
+        elif spec.kind in ("min", "max"):
+            if jnp.issubdtype(dta.dtype, jnp.floating):
+                neutral = jnp.array(np.inf if spec.kind == "min" else -np.inf,
+                                    dta.dtype)
+            else:
+                info = jnp.iinfo(dta.dtype)
+                neutral = jnp.array(info.max if spec.kind == "min" else info.min,
+                                    dta.dtype)
+            masked = jnp.where(pres, dta, neutral)
+            red_fn = jax.ops.segment_min if spec.kind == "min" else jax.ops.segment_max
+            red = red_fn(masked, seg, num_segments=M + 1)[:M]
+            red = jnp.where(pres_cnt[spec.arg] > 0, red, neutral.astype(dta.dtype)) \
+                if jnp.issubdtype(dta.dtype, jnp.floating) else red
+            out_aggs.append((red, pres_cnt[spec.arg] > 0))
+        else:
+            raise ValueError(f"unsupported hash agg kind {spec.kind}")
+
+    return GroupByResult(tuple(out_keys), tuple(out_aggs), out_live,
+                         num_groups.astype(jnp.int32), overflow)
+
+
+def prefer_scatter() -> bool:
+    """Kernel-formulation choice is a backend property: XLA:CPU lowers scatters
+    to fast native loops but its comparator sorts are single-threaded (measured
+    1.3s to lexsort 1.2M rows vs ~10ms for a segment_sum); TPU is the inverse
+    (scatters serialize, bitonic sorts + MXU matmuls are fast)."""
+    return jax.default_backend() == "cpu"
+
+
+def groupby(keys, inputs, specs, live, max_groups, domains=None):
+    """Backend-adaptive grouped aggregation dispatch (see `prefer_scatter`).
+
+    `domains` (per-key small static domains, or None) selects the dense-slot
+    formulations; float SUM is only a restriction for the matmul byte-limb
+    path, not for scatter."""
+    if domains is None and not keys:
+        domains = []  # global aggregation: one dense slot, never hash/sort
+    if domains is not None:
+        if prefer_scatter():
+            return scatter_groupby(keys, inputs, specs, live, domains)
+        float_sum = any(
+            s.kind in ("sum", "sum_float") and s.arg >= 0 and
+            jnp.issubdtype(inputs[s.arg][0].dtype, jnp.floating) for s in specs)
+        if not float_sum:
+            return matmul_groupby(keys, inputs, specs, live, domains)
+    if prefer_scatter():
+        return hash_groupby(keys, inputs, specs, live, max_groups)
+    return sort_groupby(keys, inputs, specs, live, max_groups)
+
+
 def _segmented_scan(x, reset, is_min: bool):
     """Running min/max that restarts where `reset` is True (log-depth, no scatter).
 
@@ -353,6 +615,14 @@ class JoinPairs(NamedTuple):
     overflow: Any       # scalar bool
 
 
+def _effective_live(keys, live):
+    m = live
+    for _, valid in keys:
+        if valid is not None:
+            m = m & valid
+    return m
+
+
 def hash_join_pairs(build_keys: Sequence[Tuple[Any, Optional[Any]]],
                     probe_keys: Sequence[Tuple[Any, Optional[Any]]],
                     build_live: Any,
@@ -361,17 +631,22 @@ def hash_join_pairs(build_keys: Sequence[Tuple[Any, Optional[Any]]],
     """Equi-join match enumeration: returns verified (build, probe) index pairs.
 
     NULL join keys never match (SQL semantics): rows with any NULL key are masked out of
-    both sides before hashing.
-    """
-    def effective_live(keys, live):
-        m = live
-        for _, valid in keys:
-            if valid is not None:
-                m = m & valid
-        return m
+    both sides before hashing.  Backend-adaptive: the TPU formulation sorts the
+    build hashes and binary-searches them (sorts vectorize, scatters serialize);
+    the CPU formulation buckets the build side into a slot-table CSR and probes
+    by direct gather (XLA:CPU searchsorted costs ~200ms per 1.2M probes — 18
+    full gather passes — while scatters are native loops)."""
+    if prefer_scatter():
+        return _hash_join_pairs_table(build_keys, probe_keys, build_live,
+                                      probe_live, cap)
+    return _hash_join_pairs_sorted(build_keys, probe_keys, build_live,
+                                   probe_live, cap)
 
-    b_live = effective_live(build_keys, build_live)
-    p_live = effective_live(probe_keys, probe_live)
+
+def _hash_join_pairs_sorted(build_keys, probe_keys, build_live, probe_live,
+                            cap: int) -> JoinPairs:
+    b_live = _effective_live(build_keys, build_live)
+    p_live = _effective_live(probe_keys, probe_live)
     nb = build_keys[0][0].shape[0]
     npr = probe_keys[0][0].shape[0]
 
@@ -409,6 +684,65 @@ def hash_join_pairs(build_keys: Sequence[Tuple[Any, Optional[Any]]],
 
     # pair slots are ordered by probe row, so per-probe-row "any verified" is a
     # prefix-sum range query — no scatter (TPU scatters serialize)
+    probe_matched = probe_matched_from(verified, starts, offsets) \
+        if npr else jnp.zeros(0, jnp.bool_)
+
+    return JoinPairs(b_of, p_of, verified, probe_matched, starts, offsets, overflow)
+
+
+def _hash_join_pairs_table(build_keys, probe_keys, build_live, probe_live,
+                           cap: int) -> JoinPairs:
+    """CPU join: slot-table CSR over the build side, gather-probe, scatter expand.
+
+    Build rows land in hash slots (M = 4x build capacity => expected <=0.25
+    collision candidates per probe, filtered by key verification like the
+    sorted path); a counting-sort arranges build row ids contiguously per slot
+    (one argsort of the SMALL side only — no probe-side binary search).  The
+    ragged probe->pair expansion replaces searchsorted(offsets, arange(cap))
+    with scatter-of-starts + cummax, which XLA:CPU runs ~10x faster."""
+    b_live = _effective_live(build_keys, build_live)
+    p_live = _effective_live(probe_keys, probe_live)
+    nb = build_keys[0][0].shape[0]
+    npr = probe_keys[0][0].shape[0]
+
+    M = 1 << max(4, int(nb * 4 - 1).bit_length())
+    h_b = hash_columns(build_keys)
+    s_b = (h_b & jnp.uint64(M - 1)).astype(jnp.int32)
+    s_b = jnp.where(b_live, s_b, jnp.int32(M))  # dead rows -> scratch slot
+    # CSR: build row ids grouped by slot (argsort of the small side)
+    perm = jnp.argsort(s_b).astype(jnp.int32)
+    slot_counts = jax.ops.segment_sum(jnp.ones(nb, jnp.int32), s_b,
+                                      num_segments=M + 1)[:M]
+    slot_ends = jnp.cumsum(slot_counts)
+    slot_starts = slot_ends - slot_counts
+
+    h_p = hash_columns(probe_keys)
+    s_p = (h_p & jnp.uint64(M - 1)).astype(jnp.int32)
+    counts = jnp.where(p_live, slot_counts[s_p].astype(jnp.int64), 0)
+
+    offsets = jnp.cumsum(counts)
+    total = offsets[-1] if npr else jnp.int64(0)
+    overflow = total > cap
+    starts = offsets - counts
+
+    # expansion: scatter each non-empty probe row's id at its first pair slot,
+    # then forward-fill with cummax (starts are unique among non-empty rows)
+    slots = jnp.arange(cap, dtype=jnp.int64)
+    scatter_at = jnp.where(counts > 0, starts, jnp.int64(cap))
+    p_of = jnp.zeros(cap, jnp.int32).at[scatter_at].max(
+        jnp.arange(npr, dtype=jnp.int32), mode="drop")
+    p_of = jax.lax.cummax(p_of)
+    k = slots - starts[p_of]
+    pair_live = slots < jnp.minimum(total, cap)
+    bpos = jnp.clip(slot_starts[s_p[p_of]].astype(jnp.int64) + k, 0,
+                    max(nb - 1, 0))
+    b_of = perm[bpos]
+
+    verified = pair_live
+    for (bd, bv), (pd, pv) in zip(build_keys, probe_keys):
+        verified = verified & (bd[b_of] == pd[p_of])
+    verified = verified & b_live[b_of] & p_live[p_of]
+
     probe_matched = probe_matched_from(verified, starts, offsets) \
         if npr else jnp.zeros(0, jnp.bool_)
 

@@ -163,6 +163,10 @@ class MppExecutor:
     # -- scan ---------------------------------------------------------------------
 
     def _scan(self, node: L.Scan) -> DistBatch:
+        if node.as_of is not None:
+            # flashback reads run on the local engine (loud fallback):
+            # device-cached MPP lanes are keyed by current table version only
+            raise errors.NotSupportedError("AS OF scan under MPP")
         t = node.table
         key = f"{t.schema.lower()}.{t.name.lower()}"
         store = self.ctx.stores[key]
@@ -280,7 +284,8 @@ class MppExecutor:
         return DistBatch(batch.columns, batch.live_mask(), True)
 
     def _agg_round(self, groups, child, inputs, specs, merge_specs, G):
-        key = ("mpp_agg", tuple((n, expr_cache_key(e)) for n, e in groups),
+        key = ("mpp_agg", jax.default_backend(),
+               tuple((n, expr_cache_key(e)) for n, e in groups),
                tuple(expr_cache_key(e) for e in inputs), specs, G,
                child.replicated, self.S)
 
@@ -304,7 +309,7 @@ class MppExecutor:
                 n = live.shape[0]
                 keys = [broadcast_value(n, *f(env)) for f in gfns]
                 ins = [broadcast_value(n, *f(env)) for f in ifns]
-                return K.sort_groupby(keys, ins, specs, live, G)
+                return K.groupby(keys, ins, specs, live, G)
 
             if child.replicated:
                 def run_rep(env, live):
@@ -328,7 +333,7 @@ class MppExecutor:
                 flat_keys = gather_pairs(r.keys)
                 flat_aggs = gather_pairs(r.aggs)
                 live_g = jax.lax.all_gather(r.live, "shard", axis=0).reshape(-1)
-                m = K.sort_groupby(flat_keys, flat_aggs, merge_specs, live_g, G)
+                m = K.groupby(flat_keys, flat_aggs, merge_specs, live_g, G)
                 over = jax.lax.pmax((over | m.overflow).astype(jnp.int32),
                                     "shard").astype(jnp.bool_)
                 return m, over
