@@ -149,7 +149,10 @@ def _apply_delta(ctx, tm, base, shadow, since_ts: int, now_ts: int):
                         "(deletes happened during the copy)")
                 del_keys = _pk_void(p, pk, gone_ids)
                 for sp in shadow.partitions:
-                    svis = sp.visible_mask(now_ts)
+                    # rows appended by THIS pass carry begin_ts == now_ts and
+                    # must survive: an UPDATE decomposes into delete+insert of
+                    # the same PK, and the delete targets only older epochs
+                    svis = sp.visible_mask(now_ts) & (sp.begin_ts != now_ts)
                     keys = _pk_void(sp, pk, np.arange(sp.num_rows))
                     hit = svis & np.isin(keys, del_keys)
                     ids = np.nonzero(hit)[0]

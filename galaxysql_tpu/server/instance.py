@@ -41,6 +41,15 @@ class Instance:
         self.privileges = PrivilegeManager(self.metadb)
         from galaxysql_tpu.txn.xa import TwoPhaseCoordinator
         self.xa_coordinator = TwoPhaseCoordinator(self)
+        from galaxysql_tpu.utils.locks import LockingFunctionManager
+        self.locks = LockingFunctionManager()
+        from galaxysql_tpu.txn.cdc import CdcManager
+        # ordered change log keyed by commit TSO (CdcManager.java:135)
+        self.cdc = CdcManager(self)
+        from galaxysql_tpu.meta.mdl import MdlManager
+        # per-table metadata locks: statements hold SHARED for their duration,
+        # DDL cutover (repartition swap) takes EXCLUSIVE (MdlManager.java:35)
+        self.mdl = MdlManager()
         from galaxysql_tpu.server.scheduler import ScheduledJobManager
         self.scheduler = ScheduledJobManager(self)
         from galaxysql_tpu.storage.archive import ArchiveManager
@@ -60,6 +69,7 @@ class Instance:
 
     def boot(self):
         """Load persisted metadata + data, then recover interrupted DDL jobs."""
+        self.planner.spm.attach(self.metadb)
         loaded = self.metadb.load_catalog(self.catalog)
         for tm in loaded:
             store = self.register_table(tm, persist=False)

@@ -117,7 +117,21 @@ class Session:
     def close(self):
         if self.txn is not None:
             self._rollback()
+        self.instance.locks.release_all(self.conn_id)
         self.instance.sessions.pop(self.conn_id, None)
+
+    def _lock_fn(self, name: str, vals: list):
+        """GET_LOCK family (LockingFunctionManager.java analog)."""
+        lm = self.instance.locks
+        key = str(vals[0])
+        if name == "get_lock":
+            timeout = float(vals[1]) if len(vals) > 1 else 0.0
+            return lm.get_lock(key, timeout, self.conn_id)
+        if name == "release_lock":
+            return lm.release_lock(key, self.conn_id)
+        if name == "is_free_lock":
+            return lm.is_free_lock(key)
+        return lm.is_used_lock(key)
 
     # -- dispatch ----------------------------------------------------------------
 
@@ -183,12 +197,18 @@ class Session:
         self._authorize(stmt)
         if isinstance(stmt, (ast.Select, ast.SetOpSelect)):
             return self._run_query(stmt, sql, params)
-        if isinstance(stmt, ast.Insert):
-            return self._run_insert(stmt, params)
-        if isinstance(stmt, ast.Update):
-            return self._run_update(stmt, params)
-        if isinstance(stmt, ast.Delete):
-            return self._run_delete(stmt, params)
+        if isinstance(stmt, (ast.Insert, ast.Update, ast.Delete)):
+            # statement-scope shared MDL on every referenced table: a
+            # repartition cutover cannot swap partition metadata under
+            # in-flight DML
+            keys = {f"{(t.schema or self._require_schema()).lower()}"
+                    f".{t.table.lower()}" for t in self._stmt_tables(stmt)}
+            with self.instance.mdl.shared(keys):
+                if isinstance(stmt, ast.Insert):
+                    return self._run_insert(stmt, params)
+                if isinstance(stmt, ast.Update):
+                    return self._run_update(stmt, params)
+                return self._run_delete(stmt, params)
         if isinstance(stmt, ast.CreateTable):
             return self._run_create_table(stmt)
         if isinstance(stmt, ast.DropTable):
@@ -231,6 +251,8 @@ class Session:
             return self._run_analyze(stmt)
         if isinstance(stmt, ast.KillStmt):
             return ok(info="kill acknowledged")
+        if isinstance(stmt, ast.BaselineStmt):
+            return self._run_baseline(stmt)
         if isinstance(stmt, ast.LoadData):
             return self._run_load_data(stmt)
         if isinstance(stmt, ast.CreateUser):
@@ -260,7 +282,33 @@ class Session:
         from galaxysql_tpu.ddl.jobs import alter_table_job
         schema = stmt.table.schema or self._require_schema()
         self.instance.catalog.table(schema, stmt.table.table)  # validate early
+        if any(a[0] == "repartition" for a in stmt.actions):
+            if len(stmt.actions) != 1:
+                raise errors.NotSupportedError(
+                    "PARTITION BY cannot be combined with other ALTER actions")
+            return self._run_repartition(stmt, sql, schema)
         job = alter_table_job(schema, sql, stmt.table.table, stmt.actions)
+        self.instance.ddl_engine.submit_and_run(job)
+        return ok()
+
+    def _run_repartition(self, stmt: ast.AlterTable, sql: str,
+                         schema: str) -> ResultSet:
+        """Online repartition: shadow-table backfill + catchup + verify + MDL
+        cutover (Balancer.java / RepartitionCutOverTask analog)."""
+        from galaxysql_tpu.ddl.repartition import repartition_job
+        pd = stmt.actions[0][1]
+        cols = []
+        for e in pd.exprs:
+            if not isinstance(e, ast.Name):
+                raise errors.NotSupportedError(
+                    "PARTITION BY expression must be a column name")
+            cols.append(e.parts[-1])
+        tm = self.instance.catalog.table(schema, stmt.table.table)
+        for c in cols:
+            tm.column(c)  # validates the partition column exists
+        method = pd.method if pd.method in ("hash", "key", "range") else "hash"
+        count = pd.count or tm.partition.num_partitions or 4
+        job = repartition_job(schema, sql, stmt.table.table, method, cols, count)
         self.instance.ddl_engine.submit_and_run(job)
         return ok()
 
@@ -437,6 +485,13 @@ class Session:
                           txn_id=self.txn.txn_id if self.txn is not None else 0,
                           archive=self.instance.archive,
                           archive_instance=self.instance)
+        from galaxysql_tpu.plan import logical as L
+        mdl_keys = {f"{n.table.schema.lower()}.{n.table.name.lower()}"
+                    for n in L.walk(plan.rel) if isinstance(n, L.Scan)}
+        with self.instance.mdl.shared(mdl_keys):
+            return self._run_query_locked(plan, ctx, sql, t0)
+
+    def _run_query_locked(self, plan, ctx, sql, t0) -> ResultSet:
         batch = None
         if plan.workload == "AP" and \
                 self.instance.config.get("ENABLE_MPP", self.vars) and \
@@ -467,6 +522,10 @@ class Session:
         rows = batch.to_pylist()
         fields = plan.fields()
         elapsed = time.time() - t0
+        if getattr(plan, "spm_key", None) is not None:
+            self.instance.planner.spm.record_execution(
+                plan.spm_key, elapsed * 1000.0,
+                getattr(plan, "bound_params", None))
         self.last_trace = ctx.trace + [f"elapsed={elapsed:.3f}s "
                                        f"workload={plan.workload}"]
         slow_ms = self.instance.config.get("SLOW_SQL_MS", self.vars)
@@ -493,7 +552,8 @@ class Session:
             # point and recovery (TsoTransaction 2PC analog, SURVEY.md §3.4)
             from galaxysql_tpu.txn.xa import TwoPhaseCoordinator
             coord = self.instance.xa_coordinator
-            coord.commit(txn)
+            cts = coord.commit(txn)
+            self.instance.cdc.flush_txn(txn, cts)
             if txn.inserted or txn.deleted:
                 self.instance.catalog.version += 1
             return
@@ -510,6 +570,7 @@ class Session:
             for sp in parts:
                 sp.commit(commit_ts)
             self.instance.metadb.tx_log_put(txn.txn_id, "DONE", commit_ts)
+        self.instance.cdc.flush_txn(txn, commit_ts)
         if txn.inserted or txn.deleted:
             self.instance.catalog.version += 1
 
@@ -567,6 +628,9 @@ class Session:
                     txn.inserted.append((store, pid, before_counts[pid], added))
                 self._gsi_write_rows(tm, store, pid, before_counts[pid], added,
                                      ts, txn)
+                self.instance.cdc.capture_range(tm, store, pid,
+                                                before_counts[pid], added,
+                                                ts, txn, self)
         tm.bump_version()
         self.instance.catalog.version += 1
         return ok(affected=n)
@@ -633,6 +697,8 @@ class Session:
                 # are otherwise not atomic against the archiver/other sessions
                 self._check_write_conflict(p, ids)
                 old_end = p.end_ts[ids].copy()
+                self.instance.cdc.capture_rows(tm, store, pid, ids, "delete",
+                                               ts, txn, self)
                 self._gsi_delete(tm, store, pid, ids, ts, txn)
                 p.delete_rows(ids, ts)
             if txn is not None:
@@ -660,6 +726,14 @@ class Session:
             cm = tm.column(name.simple)
             e = binder._bind_expr(vexpr, scope)
             target = cm.dtype
+            if target.is_string and isinstance(e, ir.Literal) \
+                    and isinstance(e.value, str):
+                # SET strcol = 'literal': encode into the column's dictionary
+                # (growing it if new) — the lane stores codes, not text
+                d_ = tm.dictionaries[cm.name.lower()]
+                code = np.asarray(d_.encode_one(e.value, add=True), np.int32)
+                sets.append((cm.name, lambda env, _c=code: (_c, None)))
+                continue
             if not (e.dtype.clazz == target.clazz and e.dtype.scale == target.scale) \
                     and e.dtype.clazz != dt.TypeClass.NULL and not target.is_string:
                 e = ir.Cast(e, target)
@@ -687,6 +761,8 @@ class Session:
                     new_lanes[cm.name] = d
                     new_valid[cm.name] = vm.copy()
                 old_end = p.end_ts[ids].copy()
+                self.instance.cdc.capture_rows(tm, store, pid, ids, "delete",
+                                               ts, txn, self)
                 self._gsi_delete(tm, store, pid, ids, ts, txn)
                 start = p.num_rows
                 p.update_rows(ids, new_lanes, new_valid, ts)
@@ -694,6 +770,8 @@ class Session:
                     txn.deleted.append((store, pid, ids, old_end))
                     txn.inserted.append((store, pid, start, ids.size))
                 self._gsi_write_rows(tm, store, pid, start, ids.size, ts, txn)
+                self.instance.cdc.capture_range(tm, store, pid, start, ids.size,
+                                                ts, txn, self)
             n += ids.size
         tm.bump_version()
         self.instance.catalog.version += 1
@@ -791,16 +869,10 @@ class Session:
         for name in stmt.names:
             tm = self.instance.catalog.table(name.schema or schema, name.table)
             store = self.instance.store(tm.schema, tm.name)
-            tm.stats.row_count = store.row_count()
-            for c in tm.columns:
-                sample = np.concatenate(
-                    [p.lanes[c.name][:65536] for p in store.partitions]) \
-                    if store.partitions else np.zeros(0)
-                if sample.size:
-                    tm.stats.ndv[c.name] = int(len(np.unique(sample)))
-                    if not c.dtype.is_string:
-                        tm.stats.min_max[c.name] = (sample.min().item(),
-                                                    sample.max().item())
+            from galaxysql_tpu.meta.statistics import analyze_store
+            # per-partition HLL sketches merged + equi-depth histograms
+            # (Histogram.java / statistic/ndv analog)
+            analyze_store(tm, store)
             rows.append((f"{tm.schema}.{tm.name}", "analyze", "status", "OK"))
         self.instance.catalog.version += 1
         return ResultSet(["Table", "Op", "Msg_type", "Msg_text"],
@@ -819,6 +891,35 @@ class Session:
                 self.vars[name.upper() if name.upper() in
                           self.instance.config.registry() else name.lower()] = value
         return ok()
+
+    def _run_baseline(self, stmt: ast.BaselineStmt) -> ResultSet:
+        """SPM DAL: BASELINE EVOLVE executes unaccepted candidates with their
+        join order forced and promotes measurably faster ones; BASELINE DELETE
+        drops a baseline (PlanManager DAL analog)."""
+        spm = self.instance.planner.spm
+        if stmt.action == "delete":
+            found = spm.delete(stmt.baseline_id)
+            return ok(affected=1 if found else 0)
+
+        def measure(key, orders):
+            schema, psql = key
+            from galaxysql_tpu.sql.parser import parse as _parse
+            pstmt = _parse(psql)
+            params = spm.last_params(key)
+            plan = self.instance.planner.bind_statement(
+                pstmt, schema, params, self, forced_orders=orders)
+            ctx = ExecContext(self.instance.stores, self._snapshot_ts(), params,
+                              archive=self.instance.archive,
+                              archive_instance=self.instance)
+            op = build_operator(plan.rel, ctx)
+            t0 = time.time()
+            run_to_batch(op)
+            return (time.time() - t0) * 1000.0
+
+        rows = spm.evolve(measure)
+        return ResultSet(["BASELINE_ID", "PROMOTED", "CANDIDATE_MS", "ACCEPTED_MS"],
+                         [dt.BIGINT, dt.BOOL, dt.DOUBLE, dt.DOUBLE],
+                         [(i, p, c, a) for i, p, c, a in rows])
 
     def _run_show(self, stmt: ast.Show) -> ResultSet:
         from galaxysql_tpu.server import show_handlers

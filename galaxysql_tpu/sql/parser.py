@@ -124,6 +124,13 @@ class Parser:
             return self._set()
         if t.is_kw("SHOW"):
             return self._show()
+        if t.is_kw("BASELINE"):
+            self.next()
+            if self.accept_kw("EVOLVE"):
+                return ast.BaselineStmt("evolve")
+            if self.accept_kw("DELETE"):
+                return ast.BaselineStmt("delete", int(self.next().text))
+            raise self.error("expected EVOLVE or DELETE after BASELINE")
         if t.is_kw("EXPLAIN"):
             self.next()
             analyze = self.accept_kw("ANALYZE")
@@ -391,6 +398,20 @@ class Parser:
             self.expect_op(")")
             return inner
         name = self._table_name()
+        if self.at_kw("AS") and self.peek(1).is_kw("OF"):
+            # flashback snapshot read: t AS OF TSO <n> (planner/flashback analog)
+            self.next()
+            self.next()
+            self.expect_kw("TSO")
+            t = self.next()
+            if t.kind == T.NUMBER:
+                name.as_of = int(t.text)
+            elif t.kind == T.PARAM:
+                # the plan-cache path parameterizes literals before parsing
+                idx = sum(1 for k in self.toks[:self.i - 1] if k.kind == T.PARAM)
+                name.as_of = ast.ParamRef(idx)
+            else:
+                raise self.error("expected a TSO value after AS OF TSO")
         name.alias = self._alias()
         return name
 
@@ -1260,6 +1281,9 @@ class Parser:
             elif self.accept_kw("RENAME"):
                 self.accept_kw("TO")
                 stmt.actions.append(("rename", self._table_name().table))
+            elif self.at_kw("PARTITION", "DBPARTITION"):
+                # online repartition: ALTER TABLE t PARTITION BY HASH(c) PARTITIONS n
+                stmt.actions.append(("repartition", self._partition_def()))
             else:
                 raise self.error("unsupported ALTER TABLE action")
             if not self.accept_op(","):
