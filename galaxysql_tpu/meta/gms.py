@@ -225,6 +225,14 @@ class MetaDb:
                           (key,))
         return rows[0][0] if rows else None
 
+    def kv_scan(self, prefix: str) -> List[Tuple[str, str]]:
+        return self.query(
+            "SELECT param_key, param_val FROM inst_config WHERE param_key LIKE ?",
+            (prefix + "%",))
+
+    def kv_delete(self, key: str):
+        self.execute("DELETE FROM inst_config WHERE param_key=?", (key,))
+
     def tx_log_put(self, txn_id: int, state: str, commit_ts: int = 0):
         self.execute("INSERT OR REPLACE INTO global_tx_log VALUES (?,?,?,?)",
                      (txn_id, state, commit_ts, time.time()))

@@ -37,6 +37,8 @@ class ExecutionPlan:
         self.param_count = param_count
         self.scanned_rows = scanned_rows_estimate(rel)
         self.workload = "AP" if self.scanned_rows >= AP_ROW_THRESHOLD else "TP"
+        self.spm_key = None          # set when planned through the cache path
+        self.join_orders: List[Tuple[str, ...]] = []
 
     def fields(self) -> List[L.Field]:
         return self.rel.fields()
@@ -103,6 +105,8 @@ class Planner:
     def __init__(self, catalog: Catalog):
         self.catalog = catalog
         self.cache = PlanCache()
+        from galaxysql_tpu.plan.spm import PlanManager
+        self.spm = PlanManager()
 
     def plan_select(self, sql: str, schema: str,
                     params: Optional[list] = None, session=None) -> ExecutionPlan:
@@ -117,7 +121,7 @@ class Planner:
         key = (schema.lower(), p.cache_key)
         bind_values = p.resolve(params or [])
         low = sql.lower()
-        if "nextval" in low or "connection_id" in low:
+        if "nextval" in low or "connection_id" in low or "_lock" in low:
             # per-execution values (sequences, session identity): never cache; bind
             # the PARAMETERIZED text so client '?' indexes stay aligned
             return self.bind_statement(parse(p.parameterized), schema, bind_values,
@@ -126,28 +130,45 @@ class Planner:
         if cached is not None and cached.param_count == len(bind_values):
             if cached.bound_params == bind_values:
                 return cached
-            plan = self.bind_statement(cached.statement, schema, bind_values, session)
+            plan = self.bind_statement(cached.statement, schema, bind_values, session,
+                                       spm_key=key)
             self.cache.put(key, plan)
             return plan
         stmt = parse(p.parameterized)
-        plan = self.bind_statement(stmt, schema, bind_values, session)
+        plan = self.bind_statement(stmt, schema, bind_values, session, spm_key=key)
         self.cache.put(key, plan)
         return plan
 
     def bind_statement(self, stmt: ast.Statement, schema: str,
-                       params: list, session=None) -> ExecutionPlan:
+                       params: list, session=None,
+                       spm_key: Optional[Tuple[str, str]] = None,
+                       forced_orders: Optional[list] = None) -> ExecutionPlan:
         binder = Binder(self.catalog, schema, params)
         if session is not None:
             binder.sequence_hook = \
                 lambda nm: session.instance.sequences.next_value(schema, nm)
             binder.connection_id = session.conn_id
+            binder.lock_fn_hook = session._lock_fn
         if isinstance(stmt, (ast.Select, ast.SetOpSelect)):
             rel, names = binder.bind_query(stmt)
         else:
             raise ValueError(f"not a plannable statement: {type(stmt).__name__}")
-        rel = optimize(rel)
+        # SPM: an accepted baseline pins the join order; the cost-based choice
+        # is captured (first sight) or recorded as an evolution candidate
+        from galaxysql_tpu.plan.spm import SpmContext
+        forced = forced_orders
+        if forced is None and spm_key is not None:
+            forced = self.spm.choose(spm_key, self.catalog.version)
+        spm_ctx = SpmContext(forced)
+        rel = optimize(rel, spm_ctx)
+        if forced_orders is None and spm_key is not None and spm_ctx.chosen:
+            self.spm.capture(spm_key, spm_ctx.chosen, self.catalog.version,
+                             followed_baseline=forced is not None,
+                             cost_preferred=spm_ctx.cost_preferred)
         plan = ExecutionPlan(rel, names, stmt, self.catalog.version, len(params))
         plan.bound_params = list(params)
+        plan.spm_key = spm_key
+        plan.join_orders = list(spm_ctx.chosen)
         return plan
 
 

@@ -78,8 +78,9 @@ def estimate_rows(node: L.RelNode) -> float:
         return max(float(node.table.stats.row_count), 1.0)
     if isinstance(node, L.Filter):
         sel = 1.0
+        resolver = _stats_resolver(node.child)
         for c in conjuncts(node.cond):
-            sel *= _selectivity(c)
+            sel *= _selectivity(c, resolver)
         return max(estimate_rows(node.child) * sel, 1.0)
     if isinstance(node, L.Project):
         return estimate_rows(node.child)
@@ -108,8 +109,79 @@ def estimate_rows(node: L.RelNode) -> float:
     return 1000.0
 
 
-def _selectivity(c: ir.Expr) -> float:
+def _stats_resolver(node: L.RelNode):
+    """field_id -> (TableMeta, column_name) over every Scan under `node`."""
+    out: Dict[str, Tuple] = {}
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, L.Scan):
+            for out_id, col in n.columns:
+                out[out_id] = (n.table, col)
+        else:
+            stack.extend(n.children)
+    return out
+
+
+def _lit_lane_value(e: ir.Literal, col_dtype) -> Optional[float]:
+    """Literal -> lane-domain float comparable against histogram bounds."""
+    from galaxysql_tpu.expr.compiler import _encode_literal_value
+    try:
+        v = _encode_literal_value(e.value, col_dtype)
+    except (TypeError, ValueError):
+        return None
+    return float(v) if not isinstance(v, str) else None
+
+
+def _col_lit_cmp(c: ir.Call):
+    """(colref, literal, flipped) for a simple column-vs-literal comparison."""
+    a, b = c.args[0], c.args[1]
+    if isinstance(a, ir.ColRef) and isinstance(b, ir.Literal) and \
+            b.value is not None:
+        return a, b, False
+    if isinstance(b, ir.ColRef) and isinstance(a, ir.Literal) and \
+            a.value is not None:
+        return b, a, True
+    return None
+
+
+_FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
+
+
+def _selectivity(c: ir.Expr, resolver=None) -> float:
+    """Predicate selectivity: histogram/NDV-backed when ANALYZE has run
+    (Histogram.java / statistic/ndv analog), fixed guesses otherwise."""
     if isinstance(c, ir.Call):
+        stats = None
+        if resolver is not None and c.op in ("eq", "ne", "lt", "le", "gt", "ge") \
+                and len(c.args) == 2:
+            cl = _col_lit_cmp(c)
+            if cl is not None:
+                col, lit, flipped = cl
+                tmcol = resolver.get(col.name)
+                if tmcol is not None:
+                    tm, cname = tmcol
+                    cm = tm.column(cname)
+                    hist = tm.stats.histograms.get(cm.name)
+                    ndv = tm.stats.ndv.get(cm.name, 0)
+                    op = _FLIP.get(c.op, c.op) if flipped else c.op
+                    if op in ("eq", "ne") and ndv > 0:
+                        f = 1.0 / ndv
+                        return max(min(f if op == "eq" else 1.0 - f, 1.0), 1e-9)
+                    if hist is not None and op in ("lt", "le", "gt", "ge"):
+                        v = _lit_lane_value(lit, cm.dtype)
+                        if v is not None:
+                            le = hist.frac_le(v)
+                            eq = hist.frac_eq(v)
+                            if op == "le":
+                                f = le
+                            elif op == "lt":
+                                f = le - eq
+                            elif op == "gt":
+                                f = 1.0 - le
+                            else:
+                                f = 1.0 - le + eq
+                            return max(min(f, 1.0), 1e-9)
         if c.op == "eq":
             return 0.05
         if c.op in ("lt", "le", "gt", "ge"):
@@ -119,7 +191,12 @@ def _selectivity(c: ir.Expr) -> float:
         if c.op in ("like",):
             return 0.1
         if c.op == "or":
-            return min(sum(_selectivity(d) for d in disjuncts(c)), 1.0)
+            return min(sum(_selectivity(d, resolver) for d in disjuncts(c)), 1.0)
+        if c.op == "and":
+            s = 1.0
+            for d in conjuncts(c):
+                s *= _selectivity(d, resolver)
+            return s
         if c.op == "ne":
             return 0.9
     if isinstance(c, ir.InList):
@@ -127,9 +204,25 @@ def _selectivity(c: ir.Expr) -> float:
     return 0.5
 
 
-def build_join_tree(node: L.RelNode) -> L.RelNode:
-    """Rewrite Filter-over-cross-join forests into ordered equi-join trees."""
-    node = _rewrite_children(node, build_join_tree)
+def _rel_label(node: L.RelNode) -> str:
+    """Stable identity of a join-forest member for SPM baselines: the scanned
+    table when the member bottoms out in one, else a field-id digest."""
+    n = node
+    while isinstance(n, (L.Filter, L.Project)):
+        n = n.children[0]
+    if isinstance(n, L.Scan):
+        return f"{n.table.schema.lower()}.{n.table.name.lower()}"
+    return "rel:" + ",".join(sorted(node.field_ids())[:4])
+
+
+def build_join_tree(node: L.RelNode, spm=None) -> L.RelNode:
+    """Rewrite Filter-over-cross-join forests into ordered equi-join trees.
+
+    `spm` (plan/spm.py SpmContext) makes the join order externally pinnable:
+    the chosen member order of every forest is reported out, and a forced
+    order — an accepted SPM baseline — overrides the greedy cost choice when
+    its labels still match the forest (PlanManager.java:92 accepted plans)."""
+    node = _rewrite_children(node, lambda c: build_join_tree(c, spm))
     preds: List[ir.Expr] = []
     base = node
     if isinstance(node, L.Filter):
@@ -178,12 +271,58 @@ def build_join_tree(node: L.RelNode) -> L.RelNode:
         ri.est_rows = estimate_rows(ri.node)
 
     # greedy: start at the smallest relation, repeatedly join the connected relation
-    # with the smallest estimate; unconnected relations fall back to cross joins last
+    # with the smallest estimate; unconnected relations fall back to cross joins last.
+    # An applicable SPM forced order replaces every greedy choice verbatim.
+    labels = [_rel_label(r) for r in rels]
+    forced_seq = None
+    # SPM only engages on forests with equi-join edges: predicate-free inner
+    # cross levels are re-flattened and re-ordered by the enclosing call, and
+    # recording them would misalign the per-forest force/capture sequence
+    spm_active = spm is not None and bool(edges)
+    if spm_active:
+        f = spm.next_forced()
+        if f is not None and sorted(f) == sorted(labels):
+            forced_seq = list(f)
+
+    def greedy_label_order() -> Tuple[str, ...]:
+        """What the cost model would pick today (estimates only, no tree) —
+        compared against a followed baseline to detect cost-model drift."""
+        rem = set(range(len(relinfos)))
+        members = set()
+        out = []
+        cur = min(rem, key=lambda i: relinfos[i].est_rows)
+        members.add(cur)
+        rem.discard(cur)
+        out.append(labels[cur])
+        while rem:
+            cands = [i for i in rem if any(
+                (a in members and b == i) or (b in members and a == i)
+                for a, b, _, _ in edges)]
+            nxt = min(cands or rem, key=lambda i: relinfos[i].est_rows)
+            members.add(nxt)
+            rem.discard(nxt)
+            out.append(labels[nxt])
+        return tuple(out)
+    by_label: Dict[str, List[int]] = {}
+    for i, lab in enumerate(labels):
+        by_label.setdefault(lab, []).append(i)
+
     remaining = set(range(len(relinfos)))
-    start = min(remaining, key=lambda i: relinfos[i].est_rows)
+
+    def take(lab: str) -> int:
+        for i in by_label[lab]:
+            if i in remaining:
+                return i
+        raise KeyError(lab)
+
+    if forced_seq is not None:
+        start = take(forced_seq[0])
+    else:
+        start = min(remaining, key=lambda i: relinfos[i].est_rows)
     current = relinfos[start]
     remaining.discard(start)
     current_members = {start}
+    chosen = [labels[start]]
     used_edges: Set[int] = set()
 
     def connected(i: int) -> bool:
@@ -191,16 +330,13 @@ def build_join_tree(node: L.RelNode) -> L.RelNode:
                    for a, b, _, _ in edges)
 
     while remaining:
-        candidates = [i for i in remaining if connected(i)]
-        if not candidates:
-            nxt = min(remaining, key=lambda i: relinfos[i].est_rows)
-            current = _Rel(L.Join(current.node, relinfos[nxt].node, "cross", []),
-                           current.ids | relinfos[nxt].ids,
-                           current.est_rows * relinfos[nxt].est_rows)
-            current_members.add(nxt)
-            remaining.discard(nxt)
-            continue
-        nxt = min(candidates, key=lambda i: relinfos[i].est_rows)
+        if forced_seq is not None:
+            nxt = take(forced_seq[len(chosen)])
+        else:
+            candidates = [i for i in remaining if connected(i)]
+            pool = candidates or remaining
+            nxt = min(pool, key=lambda i: relinfos[i].est_rows)
+        chosen.append(labels[nxt])
         eq_pairs: List[Tuple[ir.Expr, ir.Expr]] = []
         for k, (a, b, ea, eb) in enumerate(edges):
             if k in used_edges:
@@ -212,12 +348,22 @@ def build_join_tree(node: L.RelNode) -> L.RelNode:
                 eq_pairs.append((eb, ea))
                 used_edges.add(k)
         rel = relinfos[nxt]
-        # probe side = current accumulated tree, build = the joined-in relation if it is
-        # smaller; physical layer finalizes sides, logical Join is (left=probe-ish)
-        current = _Rel(L.Join(current.node, rel.node, "inner", eq_pairs),
-                       current.ids | rel.ids, max(current.est_rows, rel.est_rows))
+        if not eq_pairs:
+            current = _Rel(L.Join(current.node, rel.node, "cross", []),
+                           current.ids | rel.ids,
+                           current.est_rows * rel.est_rows)
+        else:
+            # probe side = current accumulated tree, build = the joined-in relation if
+            # it is smaller; physical layer finalizes sides, logical Join is
+            # (left=probe-ish)
+            current = _Rel(L.Join(current.node, rel.node, "inner", eq_pairs),
+                           current.ids | rel.ids, max(current.est_rows, rel.est_rows))
         current_members.add(nxt)
         remaining.discard(nxt)
+    if spm_active:
+        spm.chosen.append(tuple(chosen))
+        spm.cost_preferred.append(
+            greedy_label_order() if forced_seq is not None else tuple(chosen))
 
     # any edges between already-joined members that were not consumed become filters
     for k, (a, b, ea, eb) in enumerate(edges):
@@ -402,14 +548,16 @@ def _col_lit(a: ir.Expr, b: ir.Expr, id_to_col):
     return None, None
 
 
-def optimize(node: L.RelNode) -> L.RelNode:
+def optimize(node: L.RelNode, spm=None) -> L.RelNode:
     """The full RBO pipeline.
 
     push_filters runs BEFORE join-tree construction: subquery unnesting wraps the
     cross-join forest in semi/anti joins, and the WHERE conjuncts above them must reach
-    the forest first or the forest would be ordered without its predicates."""
+    the forest first or the forest would be ordered without its predicates.
+
+    `spm` (SpmContext) pins/reports join orders — see build_join_tree."""
     node = push_filters(node)
-    node = build_join_tree(node)
+    node = build_join_tree(node, spm)
     node = push_filters(node)
     node = prune_partitions(node)
     node = prune_columns(node)

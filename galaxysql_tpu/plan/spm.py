@@ -1,0 +1,238 @@
+"""SPM — SQL Plan Management: baselines, accepted plans, evolution.
+
+Reference analog: `polardbx-optimizer/.../planmanager/PlanManager.java:92` and
+`BaselineInfo`/`PlanInfo`: per parameterized-SQL *baselines* pin the join order
+the executor runs, independent of what the cost model would pick today.  The
+first execution captures the cost-based choice as the accepted plan; later
+plannings reuse it even when statistics drift would flip the greedy order
+(plan stability).  When the cost model disagrees with the accepted plan, its
+choice is kept as an *unaccepted candidate*; `BASELINE EVOLVE` executes
+candidates and promotes one that is measurably faster (plan evolution).
+Baselines are invalidated by DDL (catalog version) and persisted in the metadb
+kv store so they survive restarts.
+
+The plan identity here is the join order — the one decision our optimizer makes
+that is both cost-driven and high-blast-radius (the reference's PlanInfo stores
+full RelNode JSON; on this engine every other physical choice is deterministic
+given the join tree)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_KV_PREFIX = "spm.baseline."
+
+
+class SpmContext:
+    """Per-planning handshake with build_join_tree: carries the forced order in
+    and the chosen order out (one entry per join forest, preorder)."""
+
+    def __init__(self, forced: Optional[List[Tuple[str, ...]]] = None):
+        self.forced = forced or []   # list of label tuples, one per forest
+        self.chosen: List[Tuple[str, ...]] = []
+        # what the cost model would pick (== chosen unless a baseline forced)
+        self.cost_preferred: List[Tuple[str, ...]] = []
+        self._forest_ix = 0
+
+    def next_forced(self) -> Optional[Tuple[str, ...]]:
+        ix = self._forest_ix
+        self._forest_ix += 1
+        if ix < len(self.forced):
+            return self.forced[ix]
+        return None
+
+
+class PlanRecord:
+    __slots__ = ("orders", "origin", "runs", "total_ms")
+
+    def __init__(self, orders: List[Tuple[str, ...]], origin: str = "cost",
+                 runs: int = 0, total_ms: float = 0.0):
+        self.orders = [tuple(o) for o in orders]
+        self.origin = origin          # cost | evolved | manual
+        self.runs = runs
+        self.total_ms = total_ms
+
+    @property
+    def avg_ms(self) -> float:
+        return self.total_ms / self.runs if self.runs else float("inf")
+
+    def to_json(self):
+        return {"orders": [list(o) for o in self.orders], "origin": self.origin,
+                "runs": self.runs, "total_ms": self.total_ms}
+
+    @classmethod
+    def from_json(cls, d):
+        return cls([tuple(o) for o in d["orders"]], d.get("origin", "cost"),
+                   d.get("runs", 0), d.get("total_ms", 0.0))
+
+
+class Baseline:
+    __slots__ = ("key", "catalog_version", "accepted", "candidate", "baseline_id",
+                 "last_params")
+
+    def __init__(self, key: Tuple[str, str], catalog_version: int,
+                 accepted: PlanRecord, baseline_id: int,
+                 candidate: Optional[PlanRecord] = None):
+        self.key = key
+        self.catalog_version = catalog_version
+        self.accepted = accepted
+        self.candidate = candidate
+        self.baseline_id = baseline_id
+        self.last_params: list = []  # most recent bind values (evolution input)
+
+
+class PlanManager:
+    """Baseline store + accepted-plan choice + evolution (PlanManager.java:92)."""
+
+    def __init__(self):
+        self._baselines: Dict[Tuple[str, str], Baseline] = {}
+        self._lock = threading.Lock()
+        self._metadb = None
+        self._next_id = 1
+        self.enabled = True
+
+    # -- persistence --------------------------------------------------------
+
+    def attach(self, metadb):
+        """Bind the metadb and reload persisted baselines."""
+        self._metadb = metadb
+        for k, v in metadb.kv_scan(_KV_PREFIX):
+            try:
+                d = json.loads(v)
+                key = (d["schema"], d["sql"])
+                b = Baseline(key, d["catalog_version"],
+                             PlanRecord.from_json(d["accepted"]),
+                             d.get("id", self._next_id),
+                             PlanRecord.from_json(d["candidate"])
+                             if d.get("candidate") else None)
+                with self._lock:
+                    self._baselines[key] = b
+                    self._next_id = max(self._next_id, b.baseline_id + 1)
+            except Exception:
+                continue  # a corrupt record must not poison boot
+
+    def _persist(self, b: Baseline):
+        if self._metadb is None:
+            return
+        d = {"schema": b.key[0], "sql": b.key[1], "id": b.baseline_id,
+             "catalog_version": b.catalog_version,
+             "accepted": b.accepted.to_json(),
+             "candidate": b.candidate.to_json() if b.candidate else None}
+        self._metadb.kv_put(_KV_PREFIX + f"{b.baseline_id}", json.dumps(d))
+
+    def _unpersist(self, b: Baseline):
+        if self._metadb is not None:
+            self._metadb.kv_delete(_KV_PREFIX + f"{b.baseline_id}")
+
+    # -- planning-time API --------------------------------------------------
+
+    def choose(self, key: Tuple[str, str],
+               catalog_version: int) -> Optional[List[Tuple[str, ...]]]:
+        """Accepted join orders for this SQL, or None.  A DDL since capture
+        (catalog version mismatch) drops the stale baseline (invalidation)."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            b = self._baselines.get(key)
+            if b is None:
+                return None
+            if b.catalog_version != catalog_version:
+                del self._baselines[key]
+                self._unpersist(b)
+                return None
+            return list(b.accepted.orders)
+
+    def capture(self, key: Tuple[str, str], chosen: List[Tuple[str, ...]],
+                catalog_version: int, followed_baseline: bool,
+                cost_preferred: Optional[List[Tuple[str, ...]]] = None):
+        """Record the planner's outcome.  First sight => accepted baseline;
+        a cost-model disagreement (cost_preferred != accepted) => unaccepted
+        candidate (evolution input), while execution keeps following the
+        accepted plan."""
+        if not self.enabled or not chosen:
+            return
+        with self._lock:
+            b = self._baselines.get(key)
+            if b is None:
+                b = Baseline(key, catalog_version, PlanRecord(chosen, "cost"),
+                             self._next_id)
+                self._next_id += 1
+                self._baselines[key] = b
+                self._persist(b)
+                return
+            pref = [tuple(o) for o in (cost_preferred or chosen)]
+            if pref != b.accepted.orders and \
+                    (b.candidate is None or pref != b.candidate.orders):
+                b.candidate = PlanRecord(pref, "cost")
+                self._persist(b)
+
+    def record_execution(self, key: Tuple[str, str], elapsed_ms: float,
+                         params: Optional[list] = None):
+        with self._lock:
+            b = self._baselines.get(key)
+            if b is None:
+                return
+            b.accepted.runs += 1
+            b.accepted.total_ms += elapsed_ms
+            if params is not None:
+                b.last_params = list(params)
+
+    def last_params(self, key: Tuple[str, str]) -> list:
+        with self._lock:
+            b = self._baselines.get(key)
+            return list(b.last_params) if b is not None else []
+
+    # -- DAL ----------------------------------------------------------------
+
+    def rows(self) -> List[tuple]:
+        """SHOW BASELINE rows."""
+        out = []
+        with self._lock:
+            for b in sorted(self._baselines.values(),
+                            key=lambda x: x.baseline_id):
+                out.append((b.baseline_id, b.key[0], b.key[1],
+                            json.dumps([list(o) for o in b.accepted.orders]),
+                            b.accepted.origin, b.accepted.runs,
+                            round(b.accepted.avg_ms, 3) if b.accepted.runs else None,
+                            json.dumps([list(o) for o in b.candidate.orders])
+                            if b.candidate else None))
+        return out
+
+    def delete(self, baseline_id: int) -> bool:
+        with self._lock:
+            for k, b in list(self._baselines.items()):
+                if b.baseline_id == baseline_id:
+                    del self._baselines[k]
+                    self._unpersist(b)
+                    return True
+        return False
+
+    def evolve(self, measure, min_gain: float = 0.8) -> List[tuple]:
+        """Execute unaccepted candidates and promote the measurably faster ones.
+
+        `measure(key, orders) -> elapsed_ms` runs the SQL with the given join
+        orders forced (the session provides this).  A candidate is promoted
+        when its measured time is < min_gain * accepted's average.  Returns
+        (baseline_id, promoted, candidate_ms, accepted_avg_ms) per candidate."""
+        results = []
+        with self._lock:
+            pending = [(k, b) for k, b in self._baselines.items()
+                       if b.candidate is not None]
+        for k, b in pending:
+            cand_ms = measure(k, list(b.candidate.orders))
+            accepted_avg = b.accepted.avg_ms
+            promoted = cand_ms < min_gain * accepted_avg
+            with self._lock:
+                if promoted:
+                    b.candidate.origin = "evolved"
+                    b.candidate.runs = 1
+                    b.candidate.total_ms = cand_ms
+                    b.accepted = b.candidate
+                b.candidate = None
+                self._persist(b)
+            results.append((b.baseline_id, promoted, round(cand_ms, 3),
+                            round(accepted_avg, 3)))
+        return results

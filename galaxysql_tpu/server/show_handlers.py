@@ -40,6 +40,21 @@ def handle(session, stmt: ast.Show):
         return ResultSet([f"Tables_in_{schema}"], [dt.VARCHAR], [(n,) for n in names])
     if kind == "columns":
         return session._describe(ast.TableName([stmt.target]))
+    if kind == "binlog":
+        # SHOW BINLOG EVENTS: the ordered global change stream (CDC surface)
+        rows = inst.cdc.events()
+        return ResultSet(
+            ["SEQ", "COMMIT_TSO", "SCHEMA_NAME", "TABLE_NAME", "KIND", "PAYLOAD"],
+            [dt.BIGINT, dt.BIGINT, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR],
+            rows)
+    if kind == "baseline":
+        # SPM DAL (PlanManager.java DAL analog): one row per plan baseline
+        rows = inst.planner.spm.rows()
+        return ResultSet(
+            ["BASELINE_ID", "SCHEMA_NAME", "PARAMETERIZED_SQL", "ACCEPTED_PLAN",
+             "ORIGIN", "RUNS", "AVG_MS", "CANDIDATE_PLAN"],
+            [dt.BIGINT, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR,
+             dt.BIGINT, dt.DOUBLE, dt.VARCHAR], rows)
     if kind == "create_table":
         schema = session.schema
         tm = inst.catalog.table(schema, stmt.target)

@@ -189,6 +189,7 @@ class TableExpr(Node):
 class TableName(TableExpr):
     parts: List[str]             # [table] | [db, table]
     alias: Optional[str] = None
+    as_of: Optional[int] = None  # flashback: AS OF TSO <n> snapshot read
 
     @property
     def table(self) -> str:
@@ -411,6 +412,13 @@ class Show(Statement):
 class Explain(Statement):
     stmt: Statement
     analyze: bool = False
+
+
+@dataclasses.dataclass
+class BaselineStmt(Statement):
+    """SPM DAL: BASELINE EVOLVE | BASELINE DELETE <id> (PlanManager DAL)."""
+    action: str                       # evolve | delete
+    baseline_id: Optional[int] = None
 
 
 @dataclasses.dataclass
