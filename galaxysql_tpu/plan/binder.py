@@ -112,6 +112,7 @@ class Binder:
         # session hooks (sequences, connection id) — set by the caller when available
         self.sequence_hook = None
         self.connection_id = None
+        self.lock_fn_hook = None  # (fn_name, args) -> int|None (GET_LOCK family)
         # CTE scopes: a stack of {name: ast.Cte}; bodies re-bind per reference
         # (fresh column ids per occurrence, like the reference's view expansion)
         self._ctes: List[Dict[str, ast.Cte]] = []
@@ -280,15 +281,25 @@ class Binder:
             if t.schema is None:
                 cte = self._lookup_cte(t.table)
                 if cte is not None:
+                    if t.as_of is not None:
+                        # silent wrong-snapshot results are worse than refusal
+                        raise errors.NotSupportedError(
+                            "AS OF TSO on a CTE reference")
                     return self._bind_cte_ref(cte, t, scope)
             schema = t.schema or self.default_schema
             view = self.catalog.view(schema, t.table)
             if view is not None:
+                if t.as_of is not None:
+                    raise errors.NotSupportedError("AS OF TSO on a view")
                 return self._bind_view_ref(view, t, scope)
             tm = self.catalog.table(schema, t.table)
             alias = (t.alias or t.table).lower()
             cols = [(f"{alias}.{c.name}", c.name) for c in tm.columns]
             scan = L.Scan(tm, alias, cols)
+            as_of = t.as_of
+            if isinstance(as_of, ast.ParamRef):
+                as_of = int(self.params[as_of.index])
+            scan.as_of = as_of
             scope.add(alias, scan.fields())
             return scan
         if isinstance(t, ast.SubqueryRef):
@@ -1282,6 +1293,18 @@ class Binder:
             return ir.lit(int(v))
         if name == "connection_id":
             return ir.lit(int(self.connection_id or 0))
+        if name in ("get_lock", "release_lock", "is_free_lock", "is_used_lock"):
+            # user-level advisory locks (LockingFunctionManager.java): evaluated
+            # at bind with session identity; never plan-cached (side effects)
+            if self.lock_fn_hook is None:
+                raise errors.NotSupportedError(f"{name.upper()} outside a session")
+            vals = []
+            for a in args:
+                if not isinstance(a, ir.Literal):
+                    raise errors.TddlError(f"{name.upper()} requires literal args")
+                vals.append(a.value)
+            r = self.lock_fn_hook(name, vals)
+            return ir.lit(None, dt.NULLTYPE) if r is None else ir.lit(int(r))
         if name == "@@":
             raise errors.NotSupportedError("system variable in expression")
         if name == "length" or name == "char_length":

@@ -76,6 +76,7 @@ class Scan(RelNode):
         self.children = []
         # filled by the pruning pass; None = all partitions
         self.partitions: Optional[List[int]] = None
+        self.as_of: Optional[int] = None  # flashback snapshot TSO (AS OF TSO)
 
     def fields(self) -> List[Field]:
         out = []

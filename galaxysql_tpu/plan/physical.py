@@ -94,14 +94,20 @@ class ScanSource(ops.Operator):
         store = self.ctx.stores[f"{t.schema.lower()}.{t.name.lower()}"]
         storage_cols = [c for _, c in self.node.columns]
         rename = {c: oid for oid, c in self.node.columns}
+        # flashback (AS OF TSO n): the scan reads at the requested snapshot —
+        # own-txn provisional rows excluded (a historical read, not a txn read)
+        as_of = self.node.as_of
+        snap = as_of if as_of is not None else self.ctx.snapshot_ts
+        txn_id = 0 if as_of is not None else self.ctx.txn_id
         self.ctx.trace.append(
-            f"scan {t.name} partitions={self.node.partitions or 'all'}")
-        yield from self._archive_batches(t, storage_cols, rename)
+            f"scan {t.name} partitions={self.node.partitions or 'all'}" +
+            (f" as_of={as_of}" if as_of is not None else ""))
+        yield from self._archive_batches(t, storage_cols, rename, snap)
         from galaxysql_tpu.exec.operators import bucket_capacity
         cache = self.ctx.device_cache
         if cache is None:
             for b in store.scan(storage_cols, self.node.partitions,
-                                self.ctx.snapshot_ts, txn_id=self.ctx.txn_id):
+                                snap, txn_id=txn_id):
                 # pad to power-of-two buckets: partitions of different sizes must not
                 # each compile their own kernel shapes
                 yield b.pad_to(bucket_capacity(b.capacity)).rename(rename)
@@ -112,13 +118,13 @@ class ScanSource(ops.Operator):
         if self.node.partitions is None:
             # full-table scans fuse all partitions into ONE cached device batch:
             # one kernel dispatch per operator instead of one per partition
-            b = self._fused_table_batch(t, store, cache, jnp)
+            b = self._fused_table_batch(t, store, cache, jnp, snap, txn_id)
             if b is not None:
                 yield b.rename(rename)  # fused cols are storage-name keyed
                 return
         pids = (range(len(store.partitions)) if self.node.partitions is None
                 else self.node.partitions)
-        ts = self.ctx.snapshot_ts
+        ts = snap
         for pid in pids:
             p = store.partitions[pid]
             if p.num_rows == 0:
@@ -153,30 +159,32 @@ class ScanSource(ops.Operator):
                                        padded(p.begin_ts))
                 end = cache.get_lane(store, pid, "::end_ts", t.version,
                                      padded(p.end_ts, -1))
-                live = _device_visibility(begin, end, ts, self.ctx.txn_id)
+                live = _device_visibility(begin, end, ts, txn_id)
                 if pad_live is not None:
                     live = live & pad_live
             yield ColumnBatch(cols, live)
 
 
-    def _archive_batches(self, t, storage_cols, rename):
+    def _archive_batches(self, t, storage_cols, rename, snap=None):
         """Cold rows from parquet archives (OSSTableScanExec analog)."""
         am = self.ctx.archive
         if am is None:
             return
+        snap = self.ctx.snapshot_ts if snap is None else snap
         from galaxysql_tpu.exec.operators import bucket_capacity
         inst_key = f"{t.schema.lower()}.{t.name.lower()}"
-        if not am.files_for(inst_key, self.ctx.snapshot_ts):
+        if not am.files_for(inst_key, snap):
             return
         for b in am.scan_archive(self.ctx.archive_instance, t.schema, t.name,
-                                 storage_cols, self.ctx.snapshot_ts):
+                                 storage_cols, snap):
             self.ctx.trace.append(f"scan-archive {t.name} rows={b.capacity}")
             yield b.pad_to(bucket_capacity(max(b.capacity, 1))).rename(rename)
 
 
-    def _fused_table_batch(self, t, store, cache, jnp):
+    def _fused_table_batch(self, t, store, cache, jnp, snap=None, txn_id=None):
         from galaxysql_tpu.exec.operators import bucket_capacity
-        ts = self.ctx.snapshot_ts
+        ts = self.ctx.snapshot_ts if snap is None else snap
+        txn_id = self.ctx.txn_id if txn_id is None else txn_id
         total = sum(p.num_rows for p in store.partitions)
         if total == 0 or total > (1 << 27):
             return None  # empty: old per-partition loop yields nothing
@@ -215,7 +223,7 @@ class ScanSource(ops.Operator):
         else:
             begin = fused("::begin_ts", [p.begin_ts for p in store.partitions])
             end = fused("::end_ts", [p.end_ts for p in store.partitions], -1)
-            live = _device_visibility(begin, end, ts, self.ctx.txn_id)
+            live = _device_visibility(begin, end, ts, txn_id)
             if pad_live is not None:
                 live = live & pad_live
         return ColumnBatch(cols, live)

@@ -1,0 +1,123 @@
+"""Flashback snapshot reads (AS OF TSO) and user-level named locks (GET_LOCK).
+
+Reference analogs: `polardbx-optimizer/src/test/java/.../planner/flashback/`
+(the MVCC+TSO engine makes historical reads nearly free) and
+`polardbx-common/.../common/lock/LockingFunctionManager.java`.
+"""
+
+import threading
+import time
+
+import pytest
+
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+
+
+@pytest.fixture()
+def session():
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE f")
+    s.execute("USE f")
+    yield s
+    s.close()
+
+
+class TestFlashback:
+    def test_as_of_returns_old_snapshot(self, session):
+        session.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+        session.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+        ts1 = session.instance.tso.next_timestamp()
+        session.execute("UPDATE t SET v = 99 WHERE id = 1")
+        session.execute("DELETE FROM t WHERE id = 2")
+        session.execute("INSERT INTO t VALUES (3, 30)")
+        # current state
+        assert session.execute("SELECT id, v FROM t ORDER BY id").rows == \
+            [(1, 99), (3, 30)]
+        # historical state at ts1
+        assert session.execute(
+            f"SELECT id, v FROM t AS OF TSO {ts1} ORDER BY id").rows == \
+            [(1, 10), (2, 20)]
+
+    def test_as_of_with_alias_and_filter(self, session):
+        session.execute("CREATE TABLE u (id BIGINT, v VARCHAR(8))")
+        session.execute("INSERT INTO u VALUES (1, 'old')")
+        ts1 = session.instance.tso.next_timestamp()
+        session.execute("UPDATE u SET v = 'new' WHERE id = 1")
+        r = session.execute(
+            f"SELECT x.v FROM u AS OF TSO {ts1} x WHERE x.id = 1")
+        assert r.rows == [("old",)]
+        assert session.execute("SELECT v FROM u").rows == [("new",)]
+
+    def test_as_of_ignores_own_txn_writes(self, session):
+        session.execute("CREATE TABLE w (id BIGINT)")
+        session.execute("INSERT INTO w VALUES (1)")
+        ts1 = session.instance.tso.next_timestamp()
+        session.execute("BEGIN")
+        session.execute("INSERT INTO w VALUES (2)")
+        # txn read sees own write; flashback read does not
+        assert len(session.execute("SELECT id FROM w").rows) == 2
+        assert len(session.execute(
+            f"SELECT id FROM w AS OF TSO {ts1}").rows) == 1
+        session.execute("ROLLBACK")
+
+    def test_as_of_on_view_or_cte_refuses(self, session):
+        # silent wrong-snapshot results are worse than refusal (review finding)
+        session.execute("CREATE TABLE vt (id BIGINT)")
+        session.execute("CREATE VIEW vv AS SELECT id FROM vt")
+        from galaxysql_tpu.utils import errors as E
+        with pytest.raises(E.NotSupportedError):
+            session.execute("SELECT * FROM vv AS OF TSO 5")
+        with pytest.raises(E.NotSupportedError):
+            session.execute(
+                "WITH c AS (SELECT id FROM vt) SELECT * FROM c AS OF TSO 5")
+
+
+class TestGetLock:
+    def test_acquire_release(self, session):
+        assert session.execute("SELECT GET_LOCK('m', 0)").rows == [(1,)]
+        assert session.execute("SELECT IS_FREE_LOCK('m')").rows == [(0,)]
+        assert session.execute("SELECT IS_USED_LOCK('m')").rows == \
+            [(session.conn_id,)]
+        assert session.execute("SELECT RELEASE_LOCK('m')").rows == [(1,)]
+        assert session.execute("SELECT IS_FREE_LOCK('m')").rows == [(1,)]
+        # releasing a lock nobody holds -> NULL
+        assert session.execute("SELECT RELEASE_LOCK('m')").rows == [(None,)]
+
+    def test_reentrant_same_session(self, session):
+        assert session.execute("SELECT GET_LOCK('r', 0)").rows == [(1,)]
+        assert session.execute("SELECT GET_LOCK('r', 0)").rows == [(1,)]
+        assert session.execute("SELECT RELEASE_LOCK('r')").rows == [(1,)]
+        # still held (count 2 -> 1)
+        assert session.execute("SELECT IS_FREE_LOCK('r')").rows == [(0,)]
+        assert session.execute("SELECT RELEASE_LOCK('r')").rows == [(1,)]
+        assert session.execute("SELECT IS_FREE_LOCK('r')").rows == [(1,)]
+
+    def test_blocks_across_sessions(self, session):
+        s2 = Session(session.instance, schema="f")
+        assert session.execute("SELECT GET_LOCK('b', 0)").rows == [(1,)]
+        # a second session times out while the first holds it
+        assert s2.execute("SELECT GET_LOCK('b', 0.1)").rows == [(0,)]
+        # other-session release returns 0 (not the owner)
+        assert s2.execute("SELECT RELEASE_LOCK('b')").rows == [(0,)]
+
+        got = []
+
+        def waiter():
+            got.append(s2.execute("SELECT GET_LOCK('b', 5)").rows[0][0])
+
+        thr = threading.Thread(target=waiter)
+        thr.start()
+        time.sleep(0.2)
+        assert not got  # still blocked
+        session.execute("SELECT RELEASE_LOCK('b')")
+        thr.join(5)
+        assert got == [1]  # woke up and acquired
+        s2.close()
+
+    def test_session_close_releases(self, session):
+        s2 = Session(session.instance, schema="f")
+        assert s2.execute("SELECT GET_LOCK('c', 0)").rows == [(1,)]
+        s2.close()
+        assert session.execute("SELECT GET_LOCK('c', 0.5)").rows == [(1,)]
