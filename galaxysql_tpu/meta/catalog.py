@@ -78,6 +78,9 @@ class TableStats:
     ndv: Dict[str, int] = dataclasses.field(default_factory=dict)
     min_max: Dict[str, Tuple[Any, Any]] = dataclasses.field(default_factory=dict)
     version: int = 0
+    # equi-depth histograms + HLL sketches (meta/statistics.py), built by ANALYZE
+    histograms: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    sketches: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
 class TableMeta:
