@@ -96,6 +96,9 @@ class TableMeta:
         self.partition = partition
         self.indexes = list(indexes)
         self.comment = comment
+        # CN->worker plane: non-None marks a remote table served by a worker
+        # process via shipped SQL ({"host":..., "port":...}; net/dn.py)
+        self.remote: Optional[Dict[str, Any]] = None
         self.by_name: Dict[str, ColumnMeta] = {c.name.lower(): c for c in self.columns}
         # one shared host dictionary per string column (codes stable table-wide)
         self.dictionaries: Dict[str, Dictionary] = {

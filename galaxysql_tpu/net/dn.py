@@ -1,0 +1,167 @@
+"""The CN<->worker RPC plane: plan shipping to a second process.
+
+Reference analog: the CN->DN seam — `repo/mysql/spi/MyJdbcHandler.java:691`
+(physical SQL shipped to the shard's storage node and executed there) plus the
+inter-CN sync-action bus (`executor/sync/SyncManagerHelper.java:36`).  A worker
+(`galaxysql_tpu.net.worker`) is a real second OS process hosting its own
+engine Instance; the coordinator attaches its tables as *remote tables* whose
+scans compile to shipped SQL (filters/column pruning pushed down), so one
+query's fragments genuinely span two processes.
+
+Wire format: length-prefixed JSON header + raw npy column payloads over a
+localhost TCP socket.  JSON (not pickle) on purpose: the socket is an internal
+trust boundary and must not be an arbitrary-code-execution vector.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_HDR = struct.Struct(">I")
+
+
+def send_msg(sock: socket.socket, header: dict,
+             arrays: Optional[Dict[str, np.ndarray]] = None):
+    """[u32 jsonlen][json][per-array: u32 namelen][name][u32 npylen][npy]"""
+    arrays = arrays or {}
+    header = dict(header)
+    header["n_arrays"] = len(arrays)
+    hb = json.dumps(header).encode()
+    out = [_HDR.pack(len(hb)), hb]
+    for name, arr in arrays.items():
+        buf = io.BytesIO()
+        np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+        nb = name.encode()
+        out += [_HDR.pack(len(nb)), nb, _HDR.pack(buf.getbuffer().nbytes),
+                buf.getvalue()]
+    sock.sendall(b"".join(out))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("peer closed")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket) -> Tuple[dict, Dict[str, np.ndarray]]:
+    (hlen,) = _HDR.unpack(_recv_exact(sock, 4))
+    header = json.loads(_recv_exact(sock, hlen))
+    arrays: Dict[str, np.ndarray] = {}
+    for _ in range(header.get("n_arrays", 0)):
+        (nlen,) = _HDR.unpack(_recv_exact(sock, 4))
+        name = _recv_exact(sock, nlen).decode()
+        (alen,) = _HDR.unpack(_recv_exact(sock, 4))
+        arrays[name] = np.load(io.BytesIO(_recv_exact(sock, alen)),
+                               allow_pickle=False)
+    return header, arrays
+
+
+class WorkerClient:
+    """Coordinator-side connection to one worker process (one socket, locked:
+    the protocol is strictly request/response)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 180.0):
+        # generous default: the worker's FIRST query on a cold process pays
+        # XLA compiles; ping() overrides with a short probe timeout
+        self.timeout = timeout
+        self.addr = (host, port)
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self):
+        if self._sock is None:
+            s = socket.create_connection(self.addr, timeout=self.timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+
+    def request(self, header: dict,
+                arrays: Optional[Dict[str, np.ndarray]] = None
+                ) -> Tuple[dict, Dict[str, np.ndarray]]:
+        with self._lock:
+            self._connect()
+            try:
+                send_msg(self._sock, header, arrays)
+                resp, arrs = recv_msg(self._sock)
+            except (ConnectionError, OSError):
+                # one reconnect: the worker may have restarted between queries
+                self.close()
+                self._connect()
+                send_msg(self._sock, header, arrays)
+                resp, arrs = recv_msg(self._sock)
+        if resp.get("error"):
+            from galaxysql_tpu.utils import errors
+            raise errors.TddlError(f"worker {self.addr}: {resp['error']}")
+        return resp, arrs
+
+    def execute(self, sql: str, schema: str = "") -> Tuple[List[str], List[str],
+                                                           Dict[str, np.ndarray],
+                                                           Dict[str, np.ndarray]]:
+        """Ship SQL; returns (columns, sql_types, data arrays, valid arrays)."""
+        resp, arrs = self.request({"op": "exec_sql", "sql": sql,
+                                   "schema": schema})
+        cols = resp["columns"]
+        data = {c: arrs[f"d::{c}"] for c in cols}
+        valid = {c: arrs[f"v::{c}"] for c in cols if f"v::{c}" in arrs}
+        return cols, resp["types"], data, valid
+
+    def sync_action(self, action: str, payload: dict) -> dict:
+        """Inter-node sync bus (SyncManagerHelper analog): cache invalidation,
+        config changes, baseline ops."""
+        resp, _ = self.request({"op": "sync", "action": action,
+                                "payload": payload})
+        return resp
+
+    def ping(self, timeout: float = 5.0) -> bool:
+        try:
+            with self._lock:
+                self._connect()
+                self._sock.settimeout(timeout)
+                try:
+                    send_msg(self._sock, {"op": "ping"})
+                    resp, _ = recv_msg(self._sock)
+                finally:
+                    self._sock.settimeout(self.timeout)
+            return resp.get("ok", False)
+        except Exception:
+            self.close()
+            return False
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+
+class SyncBus:
+    """Coordinator-side broadcast of sync actions to every attached worker
+    (`SyncManagerHelper.sync(...)` analog): best-effort fan-out, collects acks."""
+
+    def __init__(self):
+        self.workers: List[WorkerClient] = []
+
+    def attach(self, client: WorkerClient):
+        if client not in self.workers:
+            self.workers.append(client)
+
+    def broadcast(self, action: str, payload: dict) -> List[dict]:
+        out = []
+        for w in self.workers:
+            try:
+                out.append(w.sync_action(action, payload))
+            except Exception as e:  # a dead worker must not block the others
+                out.append({"ok": False, "error": str(e)})
+        return out

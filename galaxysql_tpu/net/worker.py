@@ -1,0 +1,173 @@
+"""Worker process: a second-process engine serving shipped plan fragments.
+
+Reference analog: the DN side of the CN->DN plane (the MySQL storage node that
+`MyJdbcHandler.java:691` ships physical SQL to) collapsed onto this engine: the
+worker boots its own `Instance` (own stores, own metadb, own planner) and
+serves:
+
+- exec_sql: run shipped SQL, return columnar results (lane arrays + validity
+  + dictionary decode on the string columns, so the coordinator re-encodes
+  into its own dictionaries)
+- sync:     the inter-node sync-action bus (SyncManagerHelper.java:36) —
+  invalidate plan cache / baselines, SET config, stats refresh
+- ping:     liveness
+
+Run as a process: `python -m galaxysql_tpu.net.worker --port 0` (prints the
+bound port on stdout so a parent can attach).
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import sys
+import threading
+import traceback
+from typing import Dict
+
+import numpy as np
+
+from galaxysql_tpu.net.dn import recv_msg, send_msg
+
+
+class Worker:
+    def __init__(self, data_dir=None):
+        from galaxysql_tpu.server.instance import Instance
+        self.instance = Instance(data_dir=data_dir)
+        self.queries: list = []  # shipped-SQL log (tests assert pushdown)
+        self._lock = threading.Lock()
+
+    # -- request handlers ----------------------------------------------------
+
+    def handle(self, header: dict, arrays: Dict[str, np.ndarray]):
+        op = header.get("op")
+        if op == "ping":
+            return {"ok": True, "node": self.instance.node_id}, {}
+        if op == "exec_sql":
+            return self._exec_sql(header)
+        if op == "sync":
+            return self._sync(header)
+        return {"error": f"unknown op {op!r}"}, {}
+
+    def _exec_sql(self, header: dict):
+        from galaxysql_tpu.server.session import Session
+        sql = header["sql"]
+        with self._lock:
+            self.queries.append(sql)
+        s = Session(self.instance, schema=header.get("schema") or None)
+        try:
+            rs = s.execute(sql)
+            cols = rs.names
+            arrays: Dict[str, np.ndarray] = {}
+            types = []
+            for i, (name, typ) in enumerate(zip(rs.names, rs.types)):
+                vals = [r[i] for r in rs.rows]
+                valid = np.array([v is not None for v in vals], dtype=bool)
+                if typ.is_string:
+                    data = np.array([v if v is not None else "" for v in vals],
+                                    dtype=object).astype(str)
+                elif typ.sql_name().startswith(("DECIMAL", "DOUBLE", "FLOAT")):
+                    data = np.array([v if v is not None else 0.0 for v in vals],
+                                    dtype=np.float64)
+                elif typ.sql_name() in ("DATE", "DATETIME"):
+                    data = np.array([v if v is not None else "" for v in vals],
+                                    dtype=object).astype(str)
+                else:
+                    data = np.array([v if v is not None else 0 for v in vals],
+                                    dtype=np.int64)
+                arrays[f"d::{name}"] = data
+                if not valid.all():
+                    arrays[f"v::{name}"] = valid
+                types.append(typ.sql_name())
+            return ({"columns": cols, "types": types, "rows": len(rs.rows),
+                     "affected": rs.affected}, arrays)
+        finally:
+            s.close()
+
+    def _sync(self, header: dict):
+        """Sync-action bus (SyncManagerHelper analog)."""
+        action = header.get("action")
+        payload = header.get("payload") or {}
+        inst = self.instance
+        if action == "invalidate_plan_cache":
+            inst.planner.cache.invalidate_all()
+            return {"ok": True, "action": action}, {}
+        if action == "invalidate_baselines":
+            for row in list(inst.planner.spm.rows()):
+                inst.planner.spm.delete(row[0])
+            return {"ok": True, "action": action}, {}
+        if action == "set_config":
+            inst.config.set_instance(payload["name"], payload["value"])
+            return {"ok": True, "action": action}, {}
+        if action == "table_meta":
+            tm = inst.catalog.table(payload["schema"], payload["table"])
+            return {"ok": True,
+                    "columns": [[c.name, c.dtype.sql_name().split("(")[0],
+                                 c.dtype.precision, c.dtype.scale, c.nullable]
+                                for c in tm.columns],
+                    "primary_key": list(tm.primary_key)}, {}
+        if action == "query_log":
+            with self._lock:
+                return {"ok": True, "queries": list(self.queries)}, {}
+        return {"error": f"unknown sync action {action!r}"}, {}
+
+    # -- server loop ---------------------------------------------------------
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0):
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(16)
+        self.port = srv.getsockname()[1]
+        print(f"WORKER_READY {self.port}", flush=True)
+        while True:
+            conn, _ = srv.accept()
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                header, arrays = recv_msg(conn)
+                try:
+                    resp, out = self.handle(header, arrays)
+                except Exception as e:
+                    traceback.print_exc(file=sys.stderr)
+                    resp, out = {"error": f"{type(e).__name__}: {e}"}, {}
+                send_msg(conn, resp, out)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--platform", default=None,
+                    help="force the jax platform (e.g. cpu); the environment's "
+                         "sitecustomize clobbers JAX_PLATFORMS, so an env var "
+                         "cannot do this — it must happen in-process before "
+                         "first device use")
+    ap.add_argument("--init-sql", default=None,
+                    help="semicolon-separated bootstrap statements")
+    args = ap.parse_args()
+    import os
+    import jax
+    platform = args.platform or os.environ.get("GALAXYSQL_WORKER_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    jax.config.update("jax_enable_x64", True)
+    w = Worker(data_dir=args.data_dir)
+    if args.init_sql:
+        from galaxysql_tpu.server.session import Session
+        s = Session(w.instance)
+        s.execute(args.init_sql)
+        s.close()
+    w.serve(port=args.port)
+
+
+if __name__ == "__main__":
+    main()

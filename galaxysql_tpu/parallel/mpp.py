@@ -167,6 +167,8 @@ class MppExecutor:
             # flashback reads run on the local engine (loud fallback):
             # device-cached MPP lanes are keyed by current table version only
             raise errors.NotSupportedError("AS OF scan under MPP")
+        if getattr(node.table, "remote", None) is not None:
+            raise errors.NotSupportedError("remote-table scan under MPP")
         t = node.table
         key = f"{t.schema.lower()}.{t.name.lower()}"
         store = self.ctx.stores[key]

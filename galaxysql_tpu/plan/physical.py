@@ -91,6 +91,9 @@ class ScanSource(ops.Operator):
 
     def batches(self) -> Iterator[ColumnBatch]:
         t = self.node.table
+        if getattr(t, "remote", None) is not None:
+            yield from self._remote_batches(t)
+            return
         store = self.ctx.stores[f"{t.schema.lower()}.{t.name.lower()}"]
         storage_cols = [c for _, c in self.node.columns]
         rename = {c: oid for oid, c in self.node.columns}
@@ -164,6 +167,49 @@ class ScanSource(ops.Operator):
                     live = live & pad_live
             yield ColumnBatch(cols, live)
 
+
+    def _remote_batches(self, t) -> Iterator[ColumnBatch]:
+        """Plan shipping: the scan compiles to SQL executed by the worker
+        process that owns the table (MyJdbcHandler.java:691 analog) — column
+        pruning rides the SELECT list; results re-encode into this CN's lanes
+        and dictionaries."""
+        from galaxysql_tpu.chunk.batch import column_from_pylist
+        from galaxysql_tpu.exec.operators import bucket_capacity
+        inst = self.ctx.archive_instance
+        if inst is None:
+            raise errors.TddlError(
+                f"remote table {t.name} needs an owning instance context")
+        addr = (t.remote["host"], t.remote["port"])
+        client = inst.workers.get(addr)
+        if client is None:
+            raise errors.TddlError(f"remote table {t.name}: no worker attached")
+        if inst.ha.worker_fenced(addr):
+            # fail fast on a fenced worker instead of hanging on a dead socket
+            raise errors.TddlError(
+                f"remote table {t.name}: worker {addr[0]}:{addr[1]} is fenced "
+                "(liveness probe failed)")
+        storage_cols = [c for _, c in self.node.columns]
+        sql = (f"SELECT {', '.join(storage_cols)} FROM "
+               f"{t.schema}.{t.name}")
+        self.ctx.trace.append(f"remote-scan {t.name} -> {addr[0]}:{addr[1]}")
+        names, _types, data, valid = client.execute(sql, t.schema)
+        n = len(next(iter(data.values()))) if data else 0
+        cols = {}
+        for oid, cname in self.node.columns:
+            cm = t.column(cname)
+            arr = data[cname]
+            v = valid.get(cname)
+            vals = arr.tolist()
+            if v is not None:
+                vals = [x if ok else None for x, ok in zip(vals, v.tolist())]
+            cols[oid] = column_from_pylist(vals, cm.dtype,
+                                           t.dictionaries.get(cname.lower()))
+        if not cols:
+            return
+        import jax.numpy as jnp
+        b = ColumnBatch(cols, jnp.ones(n, dtype=jnp.bool_) if n else
+                        jnp.zeros(0, dtype=jnp.bool_))
+        yield b.pad_to(bucket_capacity(max(n, 1)))
 
     def _archive_batches(self, t, storage_cols, rename, snap=None):
         """Cold rows from parquet archives (OSSTableScanExec analog)."""

@@ -56,6 +56,11 @@ class Instance:
         self.archive = ArchiveManager(
             os.path.join(data_dir, "archive") if data_dir else None)
         self.node_id = f"cn-{uuid.uuid4().hex[:8]}"
+        from galaxysql_tpu.net.dn import SyncBus
+        self.workers: Dict[tuple, object] = {}  # (host, port) -> WorkerClient
+        self.sync_bus = SyncBus()
+        from galaxysql_tpu.meta.ha import HaManager
+        self.ha = HaManager(self)
         import collections
         self.counters = collections.Counter()  # engine_counters virtual table
         self.lock = threading.RLock()
@@ -125,6 +130,32 @@ class Instance:
             cid = self.next_conn_id
             self.next_conn_id += 1
             return cid
+
+    def attach_remote_table(self, schema: str, name: str, host: str,
+                            port: int):
+        """Register a worker-process table: scans compile to shipped SQL
+        (MyJdbcHandler.java:691 plan-shipping seam).  The worker is also wired
+        into the sync-action bus and the HA prober."""
+        from galaxysql_tpu.net.dn import WorkerClient
+        from galaxysql_tpu.types import datatype as dt
+        from galaxysql_tpu.meta.catalog import ColumnMeta, TableMeta, SINGLE
+        key = (host, port)
+        client = self.workers.get(key)
+        if client is None:
+            client = WorkerClient(host, port)
+            self.workers[key] = client
+            self.sync_bus.attach(client)
+        resp = client.sync_action("table_meta", {"schema": schema,
+                                                 "table": name})
+        cols = [ColumnMeta(n, dt.from_sql_name(t, p or 0, s or 0), nullable)
+                for n, t, p, s, nullable in resp["columns"]]
+        tm = TableMeta(schema, name, cols, resp.get("primary_key") or [],
+                       SINGLE)
+        tm.remote = {"host": host, "port": port}
+        self.catalog.create_schema(schema, if_not_exists=True)
+        self.catalog.add_table(tm, if_not_exists=True)
+        self.catalog.version += 1
+        return tm
 
     def mesh(self):
         """The instance's device mesh for MPP execution (None on a single device)."""

@@ -120,6 +120,11 @@ class ScheduledJobManager:
         """Fire every enabled job whose interval has elapsed; returns fired names."""
         import json
         now = now if now is not None else time.time()
+        # leader-only: with several coordinators sharing one GMS, background
+        # jobs fire on exactly one (HA re-elects when the leader's heartbeat
+        # ages out — StorageHaManager/leader-key analog)
+        if not self.instance.ha.is_leader():
+            return []
         fired = []
         for name, kind, schema, table, params_json, interval_s, enabled, last in \
                 self.instance.metadb.query(

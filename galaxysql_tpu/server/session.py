@@ -597,6 +597,7 @@ class Session:
         schema = self._require_schema()
         tname = stmt.table.table
         tm = self.instance.catalog.table(stmt.table.schema or schema, tname)
+        self._reject_remote_dml(tm)
         store = self.instance.store(tm.schema, tm.name)
         ts, txn = self._dml_ts()
 
@@ -634,6 +635,13 @@ class Session:
         tm.bump_version()
         self.instance.catalog.version += 1
         return ok(affected=n)
+
+    @staticmethod
+    def _reject_remote_dml(tm):
+        if getattr(tm, "remote", None) is not None:
+            raise errors.NotSupportedError(
+                f"table {tm.name} lives on a worker process; DML must run "
+                "there (read-only from this CN)")
 
     def _dml_match(self, tm: TableMeta, where: Optional[ast.ExprNode],
                    params: Optional[list], alias: str):
@@ -687,6 +695,7 @@ class Session:
     def _run_delete(self, stmt: ast.Delete, params: Optional[list]) -> ResultSet:
         schema = self._require_schema()
         tm = self.instance.catalog.table(stmt.table.schema or schema, stmt.table.table)
+        self._reject_remote_dml(tm)
         ts, txn = self._dml_ts()
         alias = (stmt.table.alias or stmt.table.table).lower()
         n = 0
@@ -714,6 +723,7 @@ class Session:
         if not isinstance(stmt.table, ast.TableName):
             raise errors.NotSupportedError("multi-table UPDATE")
         tm = self.instance.catalog.table(stmt.table.schema or schema, stmt.table.table)
+        self._reject_remote_dml(tm)
         ts, txn = self._dml_ts()
         alias = (stmt.table.alias or stmt.table.table).lower()
         binder = Binder(self.instance.catalog, schema, params or [])

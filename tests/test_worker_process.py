@@ -1,0 +1,180 @@
+"""The CN<->worker plane: a REAL second OS process serving shipped plan SQL,
+the sync-action bus, and HA liveness acting on it.
+
+Reference analogs: `repo/mysql/spi/MyJdbcHandler.java:691` (plan shipping to
+the shard's storage process), `executor/sync/SyncManagerHelper.java:36`
+(inter-node sync actions), `gms/ha/impl/StorageHaManager.java:1203` (liveness
+driving behavior).  The done bar: one query whose fragments span both
+processes.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.utils import errors
+
+INIT_SQL = (
+    "CREATE DATABASE w; USE w; "
+    "CREATE TABLE dim (k BIGINT PRIMARY KEY, label VARCHAR(16), price DECIMAL(10,2)); "
+    "INSERT INTO dim VALUES (1,'alpha',1.50), (2,'beta',2.25), (3,'gamma',0.75), "
+    "(4,'delta',9.99), (5, NULL, 5.00)"
+)
+
+
+@pytest.fixture(scope="module")
+def worker_proc():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "galaxysql_tpu.net.worker", "--port", "0",
+         "--platform", "cpu", "--init-sql", INIT_SQL],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True)
+    line = p.stdout.readline()
+    if not line.startswith("WORKER_READY"):
+        err = p.stderr.read()[-3000:] if p.stderr else ""
+        raise AssertionError(f"worker failed to start: {line!r}\n{err}")
+    port = int(line.split()[1])
+    yield p, port
+    if p.poll() is None:
+        p.kill()
+        p.wait()
+
+
+@pytest.fixture()
+def session(worker_proc):
+    _, port = worker_proc
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE w")
+    s.execute("USE w")
+    inst.attach_remote_table("w", "dim", "127.0.0.1", port)
+    yield s, port
+    s.close()
+
+
+class TestPlanShipping:
+    def test_remote_scan(self, session):
+        s, port = session
+        r = s.execute("SELECT k, label, price FROM dim ORDER BY k")
+        assert r.rows == [(1, "alpha", 1.5), (2, "beta", 2.25),
+                          (3, "gamma", 0.75), (4, "delta", 9.99), (5, None, 5.0)]
+
+    def test_query_fragments_span_both_processes(self, session):
+        """Local fact table joined with the worker-resident dim table: the
+        probe/agg fragment runs here, the dim scan runs in the worker."""
+        s, port = session
+        s.execute("CREATE TABLE fact (id BIGINT, k BIGINT, qty BIGINT)")
+        s.instance.store("w", "fact").insert_pylists(
+            {"id": list(range(100)), "k": [(i % 5) + 1 for i in range(100)],
+             "qty": [i for i in range(100)]},
+            s.instance.tso.next_timestamp())
+        r = s.execute(
+            "SELECT dim.label, sum(fact.qty) FROM fact, dim "
+            "WHERE fact.k = dim.k AND dim.k <= 2 "
+            "GROUP BY dim.label ORDER BY dim.label")
+        # k=1 rows: ids 0,5,..,95 qty sum = 950; k=2: 970
+        assert r.rows == [("alpha", 950), ("beta", 970)]
+        assert any("remote-scan" in t for t in s.last_trace)
+
+    def test_shipped_sql_is_column_pruned(self, session):
+        s, port = session
+        s.execute("SELECT k FROM dim")
+        log = s.instance.workers[("127.0.0.1", port)].sync_action(
+            "query_log", {})["queries"]
+        pruned = [q for q in log if q.startswith("SELECT k FROM")]
+        assert pruned, log  # only the referenced column was shipped
+
+    def test_remote_dml_refused(self, session):
+        s, _ = session
+        with pytest.raises(errors.NotSupportedError, match="worker"):
+            s.execute("INSERT INTO dim VALUES (9, 'x', 1.0)")
+        with pytest.raises(errors.NotSupportedError, match="worker"):
+            s.execute("DELETE FROM dim WHERE k = 1")
+
+    def test_sync_bus_broadcast(self, session):
+        s, port = session
+        acks = s.instance.sync_bus.broadcast(
+            "set_config", {"name": "SLOW_SQL_MS", "value": 1234})
+        assert acks and acks[0]["ok"]
+        acks = s.instance.sync_bus.broadcast("invalidate_plan_cache", {})
+        assert acks[0]["ok"]
+
+
+class TestHaActs:
+    def test_fenced_worker_refuses_fast(self, session):
+        s, port = session
+        addr = ("127.0.0.1", port)
+        s.instance.ha.fence_worker(addr, True)
+        try:
+            t0 = time.time()
+            with pytest.raises(errors.TddlError, match="fenced"):
+                s.execute("SELECT k FROM dim")
+            assert time.time() - t0 < 1.0  # refusal, not a socket hang
+        finally:
+            s.instance.ha.fence_worker(addr, False)
+        assert len(s.execute("SELECT k FROM dim").rows) == 5
+
+    def test_probe_fences_dead_worker_and_recovers(self, session):
+        s, port = session
+        addr = ("127.0.0.1", port)
+        fenced = s.instance.ha.probe_workers()
+        assert fenced.get(addr) is False  # alive
+        # dead endpoint: a worker nobody listens on
+        from galaxysql_tpu.net.dn import WorkerClient
+        dead = WorkerClient("127.0.0.1", 1)  # port 1: nothing listens
+        s.instance.workers[("127.0.0.1", 1)] = dead
+        try:
+            fenced = s.instance.ha.probe_workers()
+            assert fenced[("127.0.0.1", 1)] is True
+            assert fenced[addr] is False
+        finally:
+            del s.instance.workers[("127.0.0.1", 1)]
+
+
+class TestLeaderElection:
+    def test_smallest_alive_coordinator_leads(self):
+        inst = Instance()
+        db = inst.metadb
+        # "!" sorts before every hex digit, so this rival beats the
+        # instance's own cn-<hex> heartbeat deterministically
+        db.heartbeat("cn-!first", "coordinator", "h1", 0)
+        db.heartbeat("cn-zzz", "coordinator", "h2", 0)
+        inst.ha.check()
+        assert inst.ha.leader() == "cn-!first"
+        # the leader's heartbeat goes stale -> leadership moves
+        from galaxysql_tpu.utils.failpoint import FAIL_POINTS
+        from galaxysql_tpu.meta.ha import FP_HB_STALE
+        FAIL_POINTS.arm(FP_HB_STALE, "cn-!first")
+        try:
+            trans = inst.ha.check()
+            assert ("cn-!first", "ALIVE", "DEAD") in trans
+            assert inst.ha.leader() != "cn-!first"
+        finally:
+            FAIL_POINTS.clear()
+
+    def test_scheduler_fires_only_on_leader(self):
+        inst = Instance()
+        # another coordinator with a smaller id is alive: we are NOT leader
+        db = inst.metadb
+        db.heartbeat("cn-!rival", "coordinator", "h1", 0)
+        inst.ha.check()
+        assert not inst.ha.is_leader()
+        inst.scheduler.register("j1", "analyze", "x", "y", {}, interval_s=0)
+        assert inst.scheduler.run_due() == []  # gated
+        # the rival dies -> leadership falls to us -> jobs fire
+        from galaxysql_tpu.utils.failpoint import FAIL_POINTS
+        from galaxysql_tpu.meta.ha import FP_HB_STALE
+        FAIL_POINTS.arm(FP_HB_STALE, "cn-!rival")
+        try:
+            assert inst.ha.is_leader()
+            fired = inst.scheduler.run_due()
+            assert fired == ["j1"]  # job ran (FAILED status is fine: fake table)
+        finally:
+            FAIL_POINTS.clear()
