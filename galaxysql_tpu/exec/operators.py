@@ -604,7 +604,8 @@ class HashJoinOp(Operator):
                  residual: Optional[ir.Expr] = None,
                  build_schema: Optional[Dict[str, Tuple[dt.DataType,
                                                         Optional[Dictionary]]]] = None,
-                 spill_threshold: int = 256 << 20):
+                 spill_threshold: int = 256 << 20,
+                 enable_bloom: bool = True):
         assert join_type in ("inner", "left", "semi", "anti")
         self.build, self.probe = build, probe
         self.build_keys, self.probe_keys = list(build_keys), list(probe_keys)
@@ -617,6 +618,7 @@ class HashJoinOp(Operator):
         # hash to disk and joins bucket pairs (HybridHashJoinExec analog)
         self.spill_threshold = spill_threshold
         self.grace_partitions = 0  # observable spill counter (tests)
+        self.enable_bloom = enable_bloom  # NO_BLOOM hint disables runtime filters
 
     def _key_compilers(self):
         """Compile key pairs into a common lane domain.
@@ -921,7 +923,8 @@ class HashJoinOp(Operator):
         # provably unmatched, so semantics are exact for inner/semi; left/anti must
         # keep unmatched rows and skip the filter.
         bloom_filter = None
-        if self.join_type in ("inner", "semi") and len(self.build_keys) == 1:
+        if self.enable_bloom and self.join_type in ("inner", "semi") and \
+                len(self.build_keys) == 1:
             _, pk = self._key_compilers()
             bloom_filter = self._build_bloom(build_batch, pk[0])
 

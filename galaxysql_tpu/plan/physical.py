@@ -32,7 +32,7 @@ class ExecContext:
     def __init__(self, stores: Dict[str, TableStore], snapshot_ts: Optional[int] = None,
                  params: Optional[list] = None, batch_rows: int = 1 << 20,
                  device_cache=None, txn_id: int = 0, archive=None,
-                 archive_instance=None):
+                 archive_instance=None, hints=None):
         self.stores = stores          # "schema.table" -> TableStore
         self.snapshot_ts = snapshot_ts
         self.params = params or []
@@ -41,6 +41,7 @@ class ExecContext:
         self.txn_id = txn_id          # owning txn for MVCC visibility (0 = none)
         self.archive = archive        # ArchiveManager (cold parquet scans)
         self.archive_instance = archive_instance
+        self.hints = hints or {}  # statement hints (sql/hints.py)
         self.trace: List[str] = []
 
 
@@ -370,17 +371,21 @@ def _build_join(node: L.Join, ctx: ExecContext) -> ops.Operator:
                                build_schema=bschema)
     lkeys = [a for a, _ in node.equi]
     rkeys = [b for _, b in node.equi]
+    bloom = not ctx.hints.get("no_bloom", False)
     right_schema = {fid: (typ, d) for fid, typ, d in node.right.fields()}
     if node.kind in ("left", "semi", "anti"):
         # probe side MUST be the preserved/output (left) side
         return ops.HashJoinOp(right, left, rkeys, lkeys, node.kind,
-                              residual=node.residual, build_schema=right_schema)
+                              residual=node.residual, build_schema=right_schema,
+                              enable_bloom=bloom)
     # inner: build the smaller estimated side
     l_est = estimate_rows(node.left)
     r_est = estimate_rows(node.right)
     if r_est <= l_est:
         return ops.HashJoinOp(right, left, rkeys, lkeys, "inner",
-                              residual=node.residual, build_schema=right_schema)
+                              residual=node.residual, build_schema=right_schema,
+                              enable_bloom=bloom)
     left_schema = {fid: (typ, d) for fid, typ, d in node.left.fields()}
     return ops.HashJoinOp(left, right, lkeys, rkeys, "inner",
-                          residual=node.residual, build_schema=left_schema)
+                          residual=node.residual, build_schema=left_schema,
+                          enable_bloom=bloom)

@@ -39,6 +39,7 @@ class ExecutionPlan:
         self.workload = "AP" if self.scanned_rows >= AP_ROW_THRESHOLD else "TP"
         self.spm_key = None          # set when planned through the cache path
         self.join_orders: List[Tuple[str, ...]] = []
+        self.hints: Dict[str, object] = {}
 
     def fields(self) -> List[L.Field]:
         return self.rel.fields()
@@ -153,22 +154,29 @@ class Planner:
             rel, names = binder.bind_query(stmt)
         else:
             raise ValueError(f"not a plannable statement: {type(stmt).__name__}")
-        # SPM: an accepted baseline pins the join order; the cost-based choice
-        # is captured (first sight) or recorded as an evolution candidate
+        # hints outrank SPM; SPM accepted baselines outrank the cost model
+        from galaxysql_tpu.sql.hints import parse_hints, qualified_order
+        hints = parse_hints(getattr(stmt, "hints", None))
         from galaxysql_tpu.plan.spm import SpmContext
         forced = forced_orders
-        if forced is None and spm_key is not None:
+        if forced is None and hints.get("join_order"):
+            forced = [tuple(qualified_order(hints["join_order"], schema))]
+        hinted = forced_orders is None and (bool(hints.get("join_order")) or
+                                            hints.get("baseline_off"))
+        if forced is None and spm_key is not None and not hinted:
             forced = self.spm.choose(spm_key, self.catalog.version)
         spm_ctx = SpmContext(forced)
         rel = optimize(rel, spm_ctx)
-        if forced_orders is None and spm_key is not None and spm_ctx.chosen:
+        if forced_orders is None and not hinted and spm_key is not None and \
+                spm_ctx.chosen:
             self.spm.capture(spm_key, spm_ctx.chosen, self.catalog.version,
                              followed_baseline=forced is not None,
                              cost_preferred=spm_ctx.cost_preferred)
         plan = ExecutionPlan(rel, names, stmt, self.catalog.version, len(params))
         plan.bound_params = list(params)
-        plan.spm_key = spm_key
+        plan.spm_key = None if hinted else spm_key
         plan.join_orders = list(spm_ctx.chosen)
+        plan.hints = hints
         return plan
 
 

@@ -484,7 +484,8 @@ class Session:
                           device_cache=cache,
                           txn_id=self.txn.txn_id if self.txn is not None else 0,
                           archive=self.instance.archive,
-                          archive_instance=self.instance)
+                          archive_instance=self.instance,
+                          hints=getattr(plan, "hints", None))
         from galaxysql_tpu.plan import logical as L
         mdl_keys = {f"{n.table.schema.lower()}.{n.table.name.lower()}"
                     for n in L.walk(plan.rel) if isinstance(n, L.Scan)}
@@ -493,10 +494,13 @@ class Session:
 
     def _run_query_locked(self, plan, ctx, sql, t0) -> ResultSet:
         batch = None
-        if plan.workload == "AP" and \
-                self.instance.config.get("ENABLE_MPP", self.vars) and \
-                plan.scanned_rows >= self.instance.config.get("MPP_MIN_AP_ROWS",
-                                                              self.vars):
+        engine_hint = getattr(plan, "hints", {}).get("engine")
+        want_mpp = engine_hint == "MPP" or (
+            engine_hint is None and plan.workload == "AP" and
+            self.instance.config.get("ENABLE_MPP", self.vars) and
+            plan.scanned_rows >= self.instance.config.get("MPP_MIN_AP_ROWS",
+                                                          self.vars))
+        if want_mpp:
             # cluster MPP mode: the plan compiles to SPMD stages over the device mesh
             # (ExecutorHelper.executeCluster analog)
             mesh = self.instance.mesh()
@@ -516,7 +520,8 @@ class Session:
             # TP fast path: pin execution to the host CPU backend — point queries must
             # not pay accelerator dispatch/compile latency (CURSOR-mode bypass,
             # SURVEY.md §7.3 'latency floor')
-            device_ctx = _cpu_device_ctx() if plan.workload == "TP" else _NULL_CTX
+            device_ctx = _cpu_device_ctx() \
+                if (plan.workload == "TP" or engine_hint == "TP") else _NULL_CTX
             with device_ctx:
                 batch = run_to_batch(op)
         rows = batch.to_pylist()

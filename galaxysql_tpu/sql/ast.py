@@ -220,7 +220,8 @@ class Join(TableExpr):
 # ---------------------------------------------------------------------------
 
 class Statement(Node):
-    pass
+    # head hint comment text (/*+TDDL: ... */), parsed lazily by sql/hints.py
+    hints: "Optional[str]" = None
 
 
 @dataclasses.dataclass

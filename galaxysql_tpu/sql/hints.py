@@ -1,0 +1,58 @@
+"""Optimizer/executor hints: /*+TDDL: ... */ directives.
+
+Reference analog: `polardbx-optimizer/.../optimizer/parse/hint` +
+`optimizer/hint/*` — the reference's hint system steers pushdown, join order,
+and execution mode.  This engine honors the directives with a real decision
+behind them:
+
+- JOIN_ORDER(t1, t2, ...)  force the join order (same machinery as SPM
+  accepted plans; names resolve against the default schema)
+- ENGINE(MPP|LOCAL|TP)     force cluster-MPP, local device engine, or the
+  TP host path regardless of the workload classifier
+- NO_BLOOM                 disable runtime bloom filters for the statement
+- BASELINE_OFF             bypass SPM for the statement (plan as costed)
+
+Unknown directives are ignored (hints must never break a query), matching the
+reference's permissive hint parsing.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional
+
+_HINT_RE = re.compile(r"/\*\+\s*TDDL:\s*(.*?)\s*\*/", re.S | re.I)
+_DIRECTIVE_RE = re.compile(r"([A-Z_]+)\s*(?:\(([^)]*)\))?", re.I)
+
+
+def parse_hints(comment: Optional[str]) -> Dict[str, object]:
+    """Hint comment text -> directive dict (empty for None/no TDDL hints)."""
+    out: Dict[str, object] = {}
+    if not comment:
+        return out
+    m = _HINT_RE.search(comment)
+    if not m:
+        return out
+    for name, args in _DIRECTIVE_RE.findall(m.group(1)):
+        name = name.upper()
+        arglist = [a.strip().strip("`").lower()
+                   for a in (args or "").split(",") if a.strip()]
+        if name == "JOIN_ORDER" and arglist:
+            out["join_order"] = arglist
+        elif name == "ENGINE" and arglist:
+            eng = arglist[0].upper()
+            if eng in ("MPP", "LOCAL", "TP"):
+                out["engine"] = eng
+        elif name == "NO_BLOOM":
+            out["no_bloom"] = True
+        elif name == "BASELINE_OFF":
+            out["baseline_off"] = True
+    return out
+
+
+def qualified_order(names: List[str], default_schema: str) -> List[str]:
+    """Hint table names -> the schema-qualified labels build_join_tree uses."""
+    out = []
+    for n in names:
+        out.append(n if "." in n else f"{default_schema.lower()}.{n}")
+    return out

@@ -96,9 +96,16 @@ class Parser:
 
     def _statement(self) -> ast.Statement:
         t = self.peek()
+        hint_text = None
         if t.kind == T.OP and t.text.startswith("/*"):
-            self.next()  # skip hint comment at statement head
+            hint_text = self.next().text  # hint comment at statement head
             t = self.peek()
+        stmt = self._statement_inner(t)
+        if hint_text is not None:
+            stmt.hints = hint_text
+        return stmt
+
+    def _statement_inner(self, t) -> ast.Statement:
         if t.is_kw("SELECT") or t.is_kw("WITH") or self.at_op("("):
             return self._select_with_setops()
         if t.is_kw("INSERT", "REPLACE"):
