@@ -1,0 +1,121 @@
+"""REST observability: JSON endpoints over the engine's runtime state.
+
+Reference analog: `polardbx-executor/.../mpp/web/*` (query/stage/cluster JSON
+resources served by the MPP coordinator's HTTP server).  Endpoints:
+
+- /status      node identity, uptime, engine counters
+- /queries     per-session state + last trace + the slow-query log
+- /cluster     HA node states, leader, attached workers + fence state
+- /plan-cache  hit/miss/size
+- /baselines   SPM baselines (SHOW BASELINE as JSON)
+- /scheduler   background jobs + recent firings
+
+Read-only by design: mutations go through SQL/DAL, never HTTP.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class WebConsole:
+    def __init__(self, instance, host: str = "127.0.0.1", port: int = 0):
+        self.instance = instance
+        self.host = host
+        self.port = port
+        self.started_at = time.time()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- resources -----------------------------------------------------------
+
+    def resource(self, path: str):
+        inst = self.instance
+        if path == "/status":
+            return {"node_id": inst.node_id,
+                    "uptime_s": round(time.time() - self.started_at, 1),
+                    "counters": dict(inst.counters),
+                    "sessions": len(inst.sessions)}
+        if path == "/queries":
+            from galaxysql_tpu.utils.tracing import SLOW_LOG
+            sessions = []
+            for cid, s in list(inst.sessions.items()):
+                sessions.append({
+                    "conn_id": cid, "schema": getattr(s, "schema", None),
+                    "user": getattr(s, "user", None),
+                    "in_txn": getattr(s, "txn", None) is not None,
+                    "last_trace": list(getattr(s, "last_trace", []))[-8:]})
+            slow = [{"sql": e.sql, "elapsed_s": e.elapsed_s,
+                     "conn_id": e.conn_id, "at": e.at}
+                    for e in SLOW_LOG.entries()]
+            return {"sessions": sessions, "slow_queries": slow[-50:]}
+        if path == "/cluster":
+            inst.ha.check()
+            return {"nodes": dict(inst.ha.states),
+                    "leader": inst.ha.leader(),
+                    "workers": [{"host": h, "port": p,
+                                 "fenced": inst.ha.worker_fenced((h, p))}
+                                for (h, p) in inst.workers]}
+        if path == "/plan-cache":
+            c = inst.planner.cache
+            return {"hits": c.hits, "misses": c.misses,
+                    "size": len(c._map), "capacity": c.capacity}
+        if path == "/baselines":
+            cols = ["baseline_id", "schema", "sql", "accepted", "origin",
+                    "runs", "avg_ms", "candidate"]
+            return {"baselines": [dict(zip(cols, r))
+                                  for r in inst.planner.spm.rows()]}
+        if path == "/scheduler":
+            jobs = [{"name": n, "kind": k, "schema": s, "table": t,
+                     "interval_s": i, "enabled": bool(e), "last_fire": lf}
+                    for n, k, s, t, i, e, lf in inst.scheduler.jobs()]
+            hist = [{"name": n, "fired_at": at, "status": st, "detail": d}
+                    for n, at, st, d in inst.scheduler.history()[-50:]]
+            return {"jobs": jobs, "history": hist}
+        return None
+
+    # -- http ----------------------------------------------------------------
+
+    def start(self):
+        console = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                try:
+                    body = console.resource(self.path.rstrip("/") or "/status")
+                except Exception as e:  # a broken resource must not kill the server
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(json.dumps({"error": str(e)}).encode())
+                    return
+                if body is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(b'{"error": "unknown resource"}')
+                    return
+                data = json.dumps(body, default=str).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *a):  # no stderr chatter
+                pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="web-console")
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

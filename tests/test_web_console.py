@@ -1,0 +1,71 @@
+"""REST observability endpoints (mpp/web analog): read-only JSON resources."""
+
+import json
+import urllib.request
+
+import pytest
+
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.server.web import WebConsole
+
+
+@pytest.fixture(scope="module")
+def console():
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE wc")
+    s.execute("USE wc")
+    s.execute("CREATE TABLE t (a BIGINT, b BIGINT)")
+    inst.store("wc", "t").insert_pylists(
+        {"a": list(range(100)), "b": list(range(100))},
+        inst.tso.next_timestamp())
+    s.execute("SET GLOBAL SLOW_SQL_MS = 0")  # log every query
+    s.execute("SELECT count(*) FROM t")
+    s.execute("SELECT t.a, count(*) FROM t, t t2 WHERE t.a = t2.b GROUP BY t.a")
+    web = WebConsole(inst)
+    port = web.start()
+    yield inst, s, port
+    web.stop()
+    s.close()
+
+
+def fetch(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+class TestWebConsole:
+    def test_status(self, console):
+        inst, s, port = console
+        d = fetch(port, "/status")
+        assert d["node_id"] == inst.node_id
+        assert d["sessions"] >= 1
+
+    def test_queries_and_slow_log(self, console):
+        _, s, port = console
+        d = fetch(port, "/queries")
+        assert any(q["conn_id"] == s.conn_id for q in d["sessions"])
+        assert d["slow_queries"]  # SLOW_SQL_MS=0 logs everything
+        assert any("count" in q["sql"] for q in d["slow_queries"])
+
+    def test_cluster(self, console):
+        inst, _, port = console
+        d = fetch(port, "/cluster")
+        assert d["nodes"].get(inst.node_id) == "ALIVE"
+        assert d["leader"] is not None
+
+    def test_plan_cache_and_baselines(self, console):
+        _, _, port = console
+        pc = fetch(port, "/plan-cache")
+        assert pc["size"] >= 1
+        bl = fetch(port, "/baselines")
+        assert isinstance(bl["baselines"], list)
+        assert bl["baselines"], "the join query should have captured a baseline"
+
+    def test_scheduler_and_404(self, console):
+        _, _, port = console
+        d = fetch(port, "/scheduler")
+        assert "jobs" in d and "history" in d
+        with pytest.raises(urllib.error.HTTPError):
+            fetch(port, "/nope")
