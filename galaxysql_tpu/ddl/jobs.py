@@ -67,6 +67,15 @@ class DdlContext:
 # task library (the `ddl/job/task/basic` + `gsi` analogs, Appendix D)
 # ---------------------------------------------------------------------------
 
+def _mdl_exclusive(ctx, table_name: str):
+    """Exclusive metadata lock for schema-mutating tasks: in-flight statements
+    hold SHARED for their duration (session dispatch), so a column add/drop or
+    rename cannot swap lanes under a running query or DML (MdlManager.java:35;
+    the concurrency stress suite catches the unguarded interleaving)."""
+    tm = ctx.table(table_name)
+    return ctx.instance.mdl.exclusive(f"{tm.schema.lower()}.{tm.name.lower()}")
+
+
 @task
 class ValidateTableTask(DdlTask):
     def run(self, ctx):
@@ -76,6 +85,10 @@ class ValidateTableTask(DdlTask):
 @task
 class AddColumnTask(DdlTask):
     def run(self, ctx):
+        with _mdl_exclusive(ctx, self.payload["table"]):
+            self._run_locked(ctx)
+
+    def _run_locked(self, ctx):
         tm = ctx.table(self.payload["table"])
         name = self.payload["name"]
         if tm.has_column(name):
@@ -113,22 +126,28 @@ class AddColumnTask(DdlTask):
         ctx.bump(tm)
 
     def undo(self, ctx):
-        tm = ctx.table(self.payload["table"])
-        name = self.payload["name"]
-        if not tm.has_column(name):
-            return
-        tm.columns = [c for c in tm.columns if c.name.lower() != name.lower()]
-        tm.by_name.pop(name.lower(), None)
-        store = ctx.instance.store(tm.schema, tm.name)
-        for p in store.partitions:
-            p.lanes.pop(name, None)
-            p.valid.pop(name, None)
-        ctx.bump(tm)
+        with _mdl_exclusive(ctx, self.payload["table"]):
+            tm = ctx.table(self.payload["table"])
+            name = self.payload["name"]
+            if not tm.has_column(name):
+                return
+            tm.columns = [c for c in tm.columns
+                          if c.name.lower() != name.lower()]
+            tm.by_name.pop(name.lower(), None)
+            store = ctx.instance.store(tm.schema, tm.name)
+            for p in store.partitions:
+                p.lanes.pop(name, None)
+                p.valid.pop(name, None)
+            ctx.bump(tm)
 
 
 @task
 class DropColumnTask(DdlTask):
     def run(self, ctx):
+        with _mdl_exclusive(ctx, self.payload["table"]):
+            self._run_locked(ctx)
+
+    def _run_locked(self, ctx):
         tm = ctx.table(self.payload["table"])
         name = self.payload["name"]
         if not tm.has_column(name):
@@ -153,6 +172,10 @@ class DropColumnTask(DdlTask):
 @task
 class RenameTableTask(DdlTask):
     def run(self, ctx):
+        with _mdl_exclusive(ctx, self.payload["table"]):
+            self._run_locked(ctx)
+
+    def _run_locked(self, ctx):
         tm = ctx.table(self.payload["table"])
         new = self.payload["new_name"]
         cat = ctx.instance.catalog
