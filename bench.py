@@ -174,6 +174,40 @@ def main():
         "vs_baseline": round(q3_base / q3_best, 3), "platform": platform,
     })
 
+    # -- SSB Q1.1: fact scan + date-dim join + filtered agg (config 4) ----------
+    if os.environ.get("BENCH_SSB", "1") != "0":
+        from galaxysql_tpu.storage import ssb
+        sdata = ssb.generate(sf / 2)
+        s.execute("CREATE DATABASE ssb")
+        s.execute("USE ssb")
+        for t in ssb.TABLE_ORDER:
+            s.execute(ssb.SSB_DDL[t])
+            inst.store("ssb", t).insert_arrays(sdata[t],
+                                               inst.tso.next_timestamp())
+        s.execute("ANALYZE TABLE " + ", ".join(ssb.TABLE_ORDER))
+        ssb_best = _bench_query(s, ssb.QUERIES["1.1"], runs)
+
+        def pandas_ssb(d):
+            lo, da = d["lineorder"], d["dates"]
+            t0 = time.perf_counter()
+            dd = pd.DataFrame({"dk": da["d_datekey"], "y": da["d_year"]})
+            lf = pd.DataFrame({"od": lo["lo_orderdate"],
+                               "p": lo["lo_extendedprice"],
+                               "disc": lo["lo_discount"], "q": lo["lo_quantity"]})
+            f = lf[(lf.disc >= 1) & (lf.disc <= 3) & (lf.q < 25)]
+            j = f.merge(dd[dd.y == 1993], left_on="od", right_on="dk")
+            _ = (j.p * j.disc).sum()
+            return time.perf_counter() - t0
+
+        ssb_base = min(pandas_ssb(sdata) for _ in range(runs))
+        n_lo = len(sdata["lineorder"]["lo_orderdate"])
+        results.append({
+            "metric": f"ssb_q1.1_sf{sf / 2:g}_rows_per_sec_per_chip",
+            "value": round(n_lo / ssb_best, 1), "unit": "rows/s",
+            "vs_baseline": round(ssb_base / ssb_best, 3), "platform": platform,
+        })
+        s.execute("USE tpch")
+
     # -- TPC-H Q1 (headline; LAST so a single-line parse of the tail sees it) --
     q1_best = _bench_query(s, QUERIES[1], runs)
     q1_base = min(pandas_q1(data)[0] for _ in range(runs))

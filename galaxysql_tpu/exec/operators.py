@@ -100,6 +100,20 @@ def lifted_keys(lift, exprs: Sequence[ir.Expr]):
     return tuple(keys)
 
 
+def _is_host_batch(b: ColumnBatch) -> bool:
+    """True when every lane is host numpy (TP scans yield these): small point
+    queries then run the np expression backend directly — per-call jax dispatch
+    (~0.5ms) dwarfs the actual work at point-query sizes."""
+    for c in b.columns.values():
+        if not isinstance(c.data, np.ndarray):
+            return False
+    live = b.live
+    return live is None or isinstance(live, np.ndarray)
+
+
+TP_HOST_ROWS = 1 << 16
+
+
 def broadcast_value(n: int, data, valid):
     """Materialize a compiled (data, valid) pair to full row length.
 
@@ -184,9 +198,39 @@ class FilterOp(Operator):
                else expr_cache_key(self.predicate))
         return global_jit(key, build), (lift.values() if lift is not None else ())
 
+    def _compiled_np(self):
+        from galaxysql_tpu.expr.compiler import LiftedLiterals
+        lift = LiftedLiterals([self.predicate])
+        tkeys = lifted_keys(lift, [self.predicate])
+        if tkeys is None:
+            lift = None
+
+        def build():
+            pred = ExprCompiler(np, lift=lift).compile_predicate(self.predicate)
+
+            def run(batch: ColumnBatch, lits) -> ColumnBatch:
+                env = {n: (c.data, c.valid) for n, c in batch.columns.items()}
+                env["$lits"] = lits
+                mask = np.broadcast_to(np.asarray(pred(env)),
+                                       (batch.capacity,))
+                live = batch.live if batch.live is not None else \
+                    np.ones(batch.capacity, np.bool_)
+                return ColumnBatch(batch.columns, live & mask)
+            return run
+        key = ("filter-np", tkeys if tkeys is not None
+               else expr_cache_key(self.predicate))
+        return global_jit(key, build), (lift.values() if lift is not None else ())
+
     def batches(self) -> Iterator[ColumnBatch]:
-        f, lits = self._compiled()
+        f = lits = fnp = None
         for b in self.child.batches():
+            if b.capacity <= TP_HOST_ROWS and _is_host_batch(b):
+                if fnp is None:
+                    fnp, lits_np = self._compiled_np()
+                yield fnp(b, lits_np)
+                continue
+            if f is None:
+                f, lits = self._compiled()
             yield f(b, lits)
 
 
@@ -225,9 +269,50 @@ class ProjectOp(Operator):
             key = ("project", tuple((n, expr_cache_key(e)) for n, e in self.exprs))
         return global_jit(key, build), (lift.values() if lift is not None else ())
 
+    def _compiled_np(self):
+        from galaxysql_tpu.expr.compiler import LiftedLiterals
+        es = [e for _, e in self.exprs]
+        lift = LiftedLiterals(es)
+        tkeys = lifted_keys(lift, es)
+        if tkeys is None:
+            lift = None
+
+        def build():
+            comp = ExprCompiler(np, lift=lift)
+            fns = [(name, e, comp.compile(e)) for name, e in self.exprs]
+
+            def run(batch: ColumnBatch, lits) -> ColumnBatch:
+                env = {n: (c.data, c.valid) for n, c in batch.columns.items()}
+                env["$lits"] = lits
+                cols = {}
+                n = batch.capacity
+
+                def bc(x):
+                    return None if x is None else \
+                        np.broadcast_to(np.asarray(x), (n,))
+                for name, e, f in fns:
+                    data, valid = f(env)
+                    cols[name] = Column(bc(data), bc(valid), e.dtype,
+                                        _find_dictionary(e))
+                return ColumnBatch(cols, batch.live)
+            return run
+        if tkeys is not None:
+            key = ("project-np", tuple(n for n, _ in self.exprs), tkeys)
+        else:
+            key = ("project-np",
+                   tuple((n, expr_cache_key(e)) for n, e in self.exprs))
+        return global_jit(key, build), (lift.values() if lift is not None else ())
+
     def batches(self) -> Iterator[ColumnBatch]:
-        f, lits = self._compiled()
+        f = lits = fnp = None
         for b in self.child.batches():
+            if b.capacity <= TP_HOST_ROWS and _is_host_batch(b):
+                if fnp is None:
+                    fnp, lits_np = self._compiled_np()
+                yield fnp(b, lits_np)
+                continue
+            if f is None:
+                f, lits = self._compiled()
             yield f(b, lits)
 
 

@@ -77,6 +77,11 @@ class Scan(RelNode):
         # filled by the pruning pass; None = all partitions
         self.partitions: Optional[List[int]] = None
         self.as_of: Optional[int] = None  # flashback snapshot TSO (AS OF TSO)
+        # advisory column-vs-literal conjuncts in LANE domain, extracted by the
+        # pruning pass: (table_column, op, lane_value); archive scans use them
+        # for parquet min-max file pruning (SARG analog); the Filter above the
+        # scan still applies, so sargs are never load-bearing for correctness
+        self.sargs: List[Tuple[str, str, Any]] = []
 
     def fields(self) -> List[Field]:
         out = []

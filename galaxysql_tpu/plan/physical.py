@@ -223,7 +223,8 @@ class ScanSource(ops.Operator):
         if not am.files_for(inst_key, snap):
             return
         for b in am.scan_archive(self.ctx.archive_instance, t.schema, t.name,
-                                 storage_cols, snap):
+                                 storage_cols, snap,
+                                 sargs=getattr(self.node, "sargs", None)):
             self.ctx.trace.append(f"scan-archive {t.name} rows={b.capacity}")
             yield b.pad_to(bucket_capacity(max(b.capacity, 1))).rename(rename)
 

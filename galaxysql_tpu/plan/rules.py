@@ -508,6 +508,7 @@ def prune_partitions(node: L.RelNode) -> L.RelNode:
     if not isinstance(node, L.Filter) or not isinstance(node.child, L.Scan):
         return node
     scan = node.child
+    _extract_sargs(node.cond, scan)
     info = scan.table.partition
     if info.method in ("single", "broadcast") or info.num_partitions <= 1:
         return node
@@ -521,6 +522,31 @@ def prune_partitions(node: L.RelNode) -> L.RelNode:
     if parts is not None:
         scan.partitions = sorted(parts)
     return node
+
+
+def _extract_sargs(cond: ir.Expr, scan: L.Scan):
+    """Collect simple col-vs-literal conjuncts as lane-domain SARGs on the
+    scan — the archive layer prunes parquet files by min-max stats against
+    them (OSSTableScanExec.java:45-61 analog)."""
+    id_to_col = {oid: col for oid, col in scan.columns}
+    for c in conjuncts(cond):
+        if not (isinstance(c, ir.Call) and
+                c.op in ("eq", "lt", "le", "gt", "ge") and len(c.args) == 2):
+            continue
+        cl = _col_lit_cmp(c)
+        if cl is None:
+            continue
+        col, lit, flipped = cl
+        if col.name not in id_to_col:
+            continue
+        cm = scan.table.column(id_to_col[col.name])
+        if cm.dtype.is_string:
+            continue  # codes are assignment-ordered; min-max means nothing
+        v = _lit_lane_value(lit, cm.dtype)
+        if v is None:
+            continue
+        op = _FLIP.get(c.op, c.op) if flipped else c.op
+        scan.sargs.append((cm.name, op, v))
 
 
 def _prune_one(c: ir.Expr, router: PartitionRouter, id_to_col) -> Optional[List[int]]:

@@ -53,6 +53,8 @@ class ArchiveManager:
         self._seq = 0
         self.metadb = None
         self._decoded: Dict[str, object] = {}  # path -> pyarrow table (immutable)
+        self._file_stats: Dict[str, dict] = {}  # path -> column min-max (immutable)
+        self.pruned_files = 0  # observable SARG skip counter
 
     def attach(self, metadb):
         """Bind the metadb manifest + recover registry state (boot path)."""
@@ -228,9 +230,56 @@ class ArchiveManager:
             total += ids.size
         return total
 
+    def file_refuted(self, path: str, sargs) -> bool:
+        """True when parquet column min-max stats prove NO row can satisfy
+        the conjunctive sargs [(column, op, lane_value)] — the SARG/min-max
+        file skip of the reference's columnar scans (OSSTableScanExec.java:
+        45-61).  Missing stats never prune (advisory only)."""
+        if not sargs:
+            return False
+        with self._lock:
+            stats = self._file_stats.get(path)
+        if stats is None:
+            stats = {}
+            try:
+                md = pq.ParquetFile(path).metadata
+                for rg in range(md.num_row_groups):
+                    row = md.row_group(rg)
+                    for ci in range(row.num_columns):
+                        col = row.column(ci)
+                        st = col.statistics
+                        if st is None or not st.has_min_max:
+                            continue
+                        name = col.path_in_schema
+                        lo, hi = st.min, st.max
+                        if not isinstance(lo, (int, float)):
+                            continue
+                        old_st = stats.get(name)
+                        if old_st is None:
+                            stats[name] = (lo, hi)
+                        else:
+                            stats[name] = (min(old_st[0], lo), max(old_st[1], hi))
+            except Exception:
+                stats = {}
+            with self._lock:
+                self._file_stats[path] = stats
+        for cname, op, v in sargs:
+            mm = stats.get(cname)
+            if mm is None:
+                continue
+            lo, hi = mm
+            if (op == "eq" and (v < lo or v > hi)) or \
+                    (op in ("lt",) and lo >= v) or \
+                    (op in ("le",) and lo > v) or \
+                    (op in ("gt",) and hi <= v) or \
+                    (op in ("ge",) and hi < v):
+                return True
+        return False
+
     def scan_archive(self, instance, schema: str, table: str,
                      columns: List[str],
-                     snapshot_ts: Optional[int] = None) -> Iterator[ColumnBatch]:
+                     snapshot_ts: Optional[int] = None,
+                     sargs=None) -> Iterator[ColumnBatch]:
         """Yield archived rows as ColumnBatches (strings re-encoded against the
         table's live dictionaries so joins/filters stay in code space).  Decoded
         parquet tables cache by path (archive files are immutable)."""
@@ -242,6 +291,9 @@ class ArchiveManager:
             return
         tm = instance.catalog.table(schema, table)
         for path in files:
+            if sargs and self.file_refuted(path, sargs):
+                self.pruned_files += 1
+                continue
             with self._lock:
                 t = self._decoded.get(path)
             if t is None:

@@ -161,3 +161,39 @@ class TestArchiveCrashSafety:
         n = inst.archive.archive_older_than(inst, "c", "nl", "d", base)
         assert n == 1  # only the dated row; NULL never expires
         assert s.execute("SELECT count(*) FROM nl WHERE d IS NULL").rows == [(1,)]
+
+
+class TestSargPruning:
+    def test_minmax_stats_skip_refuted_files(self, tmp_path):
+        """Parquet min-max stats prune whole archive files against scan SARGs
+        (OSSTableScanExec.java:45-61 analog); pruning never changes results."""
+        import numpy as np
+        from galaxysql_tpu.server.instance import Instance
+        from galaxysql_tpu.server.session import Session
+        inst = Instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE ar")
+        s.execute("USE ar")
+        s.execute("CREATE TABLE ev (id BIGINT PRIMARY KEY, d DATE, v BIGINT)")
+        from galaxysql_tpu.types import temporal
+        today = temporal.days_from_civil(2026, 7, 29)
+        store = inst.store("ar", "ev")
+        # two disjoint archive epochs: ids 0..99 (old), 100..199 (older still)
+        for base, age in ((0, 400), (100, 800)):
+            store.insert_pylists(
+                {"id": list(range(base, base + 100)),
+                 "d": [temporal.format_date(today - age)] * 100,
+                 "v": [base] * 100},
+                inst.tso.next_timestamp())
+            n = inst.archive.archive_older_than(inst, "ar", "ev", "d",
+                                                today - age + 1)
+            assert n == 100
+        am = inst.archive
+        before = am.pruned_files
+        # id >= 150 refutes the first file (ids 0..99) by its max stat
+        r = s.execute("SELECT count(*) FROM ev WHERE id >= 150")
+        assert r.rows == [(50,)]
+        assert am.pruned_files > before  # at least one file skipped
+        # unconstrained scan still sees every archived row
+        assert s.execute("SELECT count(*) FROM ev").rows == [(200,)]
+        s.close()
