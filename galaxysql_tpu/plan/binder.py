@@ -1126,6 +1126,8 @@ class Binder:
             if e.op == "not":
                 return ir.call("not", arg)
             raise errors.NotSupportedError(f"unary {e.op}")
+        if isinstance(e, ast.Collate):
+            return self._bind_collate(e, scope, rep)
         if isinstance(e, ast.Binary):
             return self._bind_binary(e, scope, rep)
         if isinstance(e, ast.BetweenExpr):
@@ -1190,6 +1192,40 @@ class Binder:
             raise errors.TddlError("INTERVAL literal outside date arithmetic")
         raise errors.NotSupportedError(f"expression {type(e).__name__}")
 
+    def _bind_collate(self, e: ast.Collate, scope, rep) -> ir.Expr:
+        """expr COLLATE name: lower to a fold-class representative-code
+        translation (one device gather), so equality/grouping under the
+        collation is integer equality of translated codes (common/collation/*
+        analog).  The node is tagged so comparisons fold the literal side to
+        its class representative too."""
+        from galaxysql_tpu.types import collation as coll
+        inner = self._bind_expr(e.arg, scope, rep)
+        if not inner.dtype.is_string:
+            raise errors.NotSupportedError("COLLATE on a non-string expression")
+        coll.fold_fn(e.name)  # validate the collation name eagerly
+        if isinstance(inner, ir.Literal):
+            # 'lit' COLLATE ci: the collation governs the COMPARISON; carry a
+            # marker the comparison binder resolves against the column side
+            m = ir.Call("collate_lit", [inner], inner.dtype)
+            m.meta = (None, "collate", e.name.lower())
+            return m
+        d = _find_dictionary(inner)
+        if d is None:
+            raise errors.NotSupportedError(
+                "COLLATE needs a dictionary-backed string")
+        table = coll.rep_table(d, e.name)
+        c = ir.Call("dict_transform", [inner], inner.dtype, dictionary=d)
+        c.meta = (table, "collate", e.name.lower())
+        return c
+
+    @staticmethod
+    def _collation_of(x: ir.Expr):
+        if isinstance(x, ir.Call) and x.op in ("dict_transform", "collate_lit") \
+                and x.meta is not None and len(x.meta) >= 3 \
+                and x.meta[1] == "collate":
+            return x.meta[2]
+        return None
+
     def _bind_binary(self, e: ast.Binary, scope, rep) -> ir.Expr:
         op_map = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge",
                   "and": "and", "or": "or", "+": "add", "-": "sub", "*": "mul",
@@ -1206,6 +1242,35 @@ class Binder:
             raise errors.NotSupportedError(f"operator {e.op}")
         a = self._bind_expr(e.left, scope, rep)
         b = self._bind_expr(e.right, scope, rep)
+        if op in ("eq", "ne", "lt", "le", "gt", "ge"):
+            cname = self._collation_of(a) or self._collation_of(b)
+            if cname is not None:
+                from galaxysql_tpu.types import collation as coll
+                # unwrap literal-side markers; translate the column side to
+                # fold-class representative codes; fold the literal to its
+                # class representative so codes compare consistently
+                if isinstance(a, ir.Call) and a.op == "collate_lit":
+                    a = a.args[0]
+                if isinstance(b, ir.Call) and b.op == "collate_lit":
+                    b = b.args[0]
+
+                def colled(x):
+                    if self._collation_of(x) is not None:
+                        return x
+                    d = _find_dictionary(x)
+                    if d is None or isinstance(x, ir.Literal):
+                        return x
+                    t = coll.rep_table(d, cname)
+                    c = ir.Call("dict_transform", [x], x.dtype, dictionary=d)
+                    c.meta = (t, "collate", cname)
+                    return c
+                a, b = colled(a), colled(b)
+                for side, other in ((a, b), (b, a)):
+                    if isinstance(other, ir.Literal) and \
+                            isinstance(other.value, str):
+                        d = _find_dictionary(side)
+                        if d is not None:
+                            other.value = coll.rep_text(d, cname, other.value)
         if op == "div" and e.op == "div":
             return ir.Cast(ir.call("div", a, b), dt.BIGINT)
         return ir.call(op, a, b)

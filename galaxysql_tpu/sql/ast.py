@@ -150,6 +150,13 @@ class BetweenExpr(ExprNode):
 
 
 @dataclasses.dataclass
+class Collate(ExprNode):
+    """expr COLLATE name: comparison/grouping under an explicit collation."""
+    arg: "ExprNode"
+    name: str
+
+
+@dataclasses.dataclass
 class LikeExpr(ExprNode):
     arg: ExprNode
     pattern: ExprNode

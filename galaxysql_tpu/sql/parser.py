@@ -584,7 +584,10 @@ class Parser:
             return self._unary_expr()
         if self.accept_op("~"):
             return ast.Unary("~", self._unary_expr())
-        return self._primary()
+        e = self._primary()
+        while self.accept_kw("COLLATE"):  # MySQL: binds tighter than comparison
+            e = ast.Collate(e, self.expect_ident())
+        return e
 
     def _primary(self) -> ast.ExprNode:
         t = self.peek()
