@@ -42,6 +42,8 @@ class ExecContext:
         self.archive = archive        # ArchiveManager (cold parquet scans)
         self.archive_instance = archive_instance
         self.hints = hints or {}  # statement hints (sql/hints.py)
+        self.collect_stats = False       # EXPLAIN ANALYZE per-operator stats
+        self.op_stats: List[dict] = []   # filled by StatsOp when collecting
         self.trace: List[str] = []
 
 
@@ -296,7 +298,38 @@ class ValuesSource(ops.Operator):
         yield batch_from_pydict(data, schema, dicts)
 
 
+class StatsOp(ops.Operator):
+    """EXPLAIN ANALYZE instrumentation: per-operator batches/rows/wall time
+    (RuntimeStatistics analog).  Only wrapped when ctx.collect_stats is set —
+    num_live() forces a device sync per batch, so the normal path never pays."""
+
+    def __init__(self, inner: ops.Operator, label: str, ctx: ExecContext):
+        self.inner = inner
+        self.label = label
+        self.ctx = ctx
+
+    def batches(self):
+        import time as _t
+        t0 = _t.perf_counter()
+        rows = 0
+        nb = 0
+        for b in self.inner.batches():
+            nb += 1
+            rows += b.num_live()
+            yield b
+        self.ctx.op_stats.append(
+            {"operator": self.label, "batches": nb, "rows_out": rows,
+             "wall_ms": round((_t.perf_counter() - t0) * 1000, 3)})
+
+
 def build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
+    op = _build_operator(node, ctx)
+    if getattr(ctx, "collect_stats", False):
+        return StatsOp(op, type(node).__name__, ctx)
+    return op
+
+
+def _build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
     if isinstance(node, L.Scan):
         return ScanSource(node, ctx)
     if isinstance(node, L.Values):

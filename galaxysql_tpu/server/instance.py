@@ -72,9 +72,21 @@ class Instance:
 
     # -- boot ------------------------------------------------------------------
 
+    def _reload_global_config(self, *_):
+        """Pull persisted SET GLOBAL values from the shared metadb (fired by
+        the config listener when a peer coordinator changes one)."""
+        import json
+        for k, v in self.metadb.kv_scan("config.param."):
+            try:
+                self.config.set_instance(k[len("config.param."):], json.loads(v))
+            except Exception:
+                continue  # an unknown/stale param must not poison boot
+
     def boot(self):
         """Load persisted metadata + data, then recover interrupted DDL jobs."""
         self.planner.spm.attach(self.metadb)
+        self.config_listener.bind("config.params", self._reload_global_config)
+        self._reload_global_config()
         loaded = self.metadb.load_catalog(self.catalog)
         for tm in loaded:
             store = self.register_table(tm, persist=False)

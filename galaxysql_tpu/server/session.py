@@ -902,6 +902,12 @@ class Session:
                 self.user_vars[name.lower()] = value
             elif scope == "global":
                 self.instance.config.set_instance(name, value)
+                # durable + fleet-visible: peers sharing the GMS reload via
+                # the config listener (§5.6 config push analog)
+                import json as _json
+                self.instance.metadb.kv_put(
+                    f"config.param.{name.upper()}", _json.dumps(value))
+                self.instance.metadb.notify("config.params")
             else:
                 self.vars[name.upper() if name.upper() in
                           self.instance.config.registry() else name.lower()] = value
@@ -951,12 +957,16 @@ class Session:
             ctx = ExecContext(self.instance.stores, self._snapshot_ts(),
                               params or [], archive=self.instance.archive,
                               archive_instance=self.instance)
+            ctx.collect_stats = True  # per-operator rows/time (RuntimeStatistics)
             op = build_operator(plan.rel, ctx)
             t0 = time.time()
             batch = run_to_batch(op)
             elapsed = time.time() - t0
             lines += [f"-- rows: {batch.num_live()}", f"-- elapsed: {elapsed:.3f}s"] + \
                 [f"-- {t}" for t in ctx.trace]
+            for st in ctx.op_stats:
+                lines.append(f"-- op {st['operator']}: rows={st['rows_out']} "
+                             f"batches={st['batches']} wall={st['wall_ms']}ms")
         lines.append(f"-- workload: {plan.workload}")
         return ResultSet(["plan"], [dt.VARCHAR], [(l,) for l in lines])
 

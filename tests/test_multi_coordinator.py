@@ -1,0 +1,70 @@
+"""Two coordinator Instances sharing one GMS metadb file: the DCN-plane story.
+
+Reference analog: multiple CNs over one shared GMS (SURVEY.md §5.8): catalog
+loads on the second node, leadership is exclusive, background jobs fire once
+across the fleet, and config changes propagate through the metadb listener.
+"""
+
+import pytest
+
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+
+
+@pytest.fixture()
+def gms_dir(tmp_path):
+    return str(tmp_path / "shared")
+
+
+class TestTwoCoordinators:
+    def test_second_node_loads_shared_catalog(self, gms_dir):
+        a = Instance(data_dir=gms_dir)
+        sa = Session(a)
+        sa.execute("CREATE DATABASE m")
+        sa.execute("USE m")
+        sa.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+        sa.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+        a.save()
+        sa.close()
+
+        b = Instance(data_dir=gms_dir)
+        sb = Session(b, schema="m")
+        assert sb.execute("SELECT id, v FROM t ORDER BY id").rows == \
+            [(1, 10), (2, 20)]
+        sb.close()
+
+    def test_leadership_is_exclusive_and_scheduler_fires_once(self, gms_dir):
+        a = Instance(data_dir=gms_dir)
+        b = Instance(data_dir=gms_dir)
+        # both heartbeat into the SAME node_info table
+        a.ha.heartbeat()
+        b.ha.heartbeat()
+        a.ha.check()
+        b.ha.check()
+        leaders = [i for i in (a, b) if i.ha.is_leader()]
+        assert len(leaders) == 1
+        leader = leaders[0]
+        follower = a if leader is b else b
+        # a due job fires on the leader only
+        leader.scheduler.register("job", "analyze", "x", "y", {},
+                                  interval_s=3600)
+        assert follower.scheduler.run_due() == []
+        assert leader.scheduler.run_due() == ["job"]
+        # at-most-once per interval: the slot is consumed fleet-wide (the
+        # conditional last_fire UPDATE lives in the shared metadb row)
+        assert leader.scheduler.run_due() == []
+        assert follower.scheduler.run_due() == []
+
+    def test_config_listener_propagates(self, gms_dir):
+        a = Instance(data_dir=gms_dir)
+        b = Instance(data_dir=gms_dir)
+        sa = Session(a)
+        sa.execute("SET GLOBAL SLOW_SQL_MS = 4321")
+        # node B observes the change through the shared config listener
+        fired = b.config_listener.poll()
+        assert "config.params" in fired
+        assert b.config.get("SLOW_SQL_MS", {}) == 4321
+        # and a freshly booted node C sees it immediately (persisted)
+        c = Instance(data_dir=gms_dir)
+        assert c.config.get("SLOW_SQL_MS", {}) == 4321
+        sa.close()

@@ -184,3 +184,29 @@ class TestScalarSubqueryInSelect:
         session.execute("INSERT INTO m1 VALUES (1); INSERT INTO m2 VALUES (1), (2)")
         with pytest.raises(errors.TddlError):
             session.execute("SELECT a, (SELECT b FROM m2) FROM m1")
+
+
+class TestExplainAnalyzeStats:
+    def test_per_operator_runtime_stats(self):
+        """EXPLAIN ANALYZE reports per-operator rows/batches/wall time
+        (RuntimeStatistics analog) — collected only when analyzing."""
+        from galaxysql_tpu.server.instance import Instance
+        from galaxysql_tpu.server.session import Session
+        inst = Instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE ea")
+        s.execute("USE ea")
+        s.execute("CREATE TABLE t (a BIGINT, b BIGINT)")
+        inst.store("ea", "t").insert_pylists(
+            {"a": list(range(500)), "b": [i % 9 for i in range(500)]},
+            inst.tso.next_timestamp())
+        lines = [r[0] for r in s.execute(
+            "EXPLAIN ANALYZE SELECT b, count(*) FROM t WHERE a >= 100 "
+            "GROUP BY b").rows]
+        ops = [l for l in lines if l.startswith("-- op ")]
+        assert any("Aggregate" in l for l in ops)
+        assert any("Filter" in l for l in ops)
+        assert any("Scan" in l for l in ops)
+        agg = next(l for l in ops if "Aggregate" in l)
+        assert "rows=9" in agg and "wall=" in agg
+        s.close()
