@@ -25,21 +25,43 @@ class MySQLError(Exception):
 
 class MiniClient:
     def __init__(self, host: str, port: int, user: str = "root", password: str = "",
-                 database: Optional[str] = None, timeout: float = 30.0):
+                 database: Optional[str] = None, timeout: float = 30.0,
+                 compress: bool = False):
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.seq = 0
         self.more_results = False
+        # compressed protocol: negotiated at handshake, framing active after
+        self.compress = compress
+        self.compressed = False
+        self.cseq = 0
+        self._inbuf = b""
         self._handshake(user, password, database)
+        if compress:
+            self.compressed = True
 
     # -- framing ---------------------------------------------------------------
+
+    def _read_raw(self, n: int) -> bytes:
+        if not self.compressed:
+            return self._recvn(n)
+        import zlib
+        while len(self._inbuf) < n:
+            hdr = self._recvn(7)
+            clen = hdr[0] | (hdr[1] << 8) | (hdr[2] << 16)
+            self.cseq = (hdr[3] + 1) & 0xFF
+            ulen = hdr[4] | (hdr[5] << 8) | (hdr[6] << 16)
+            body = self._recvn(clen)
+            self._inbuf += zlib.decompress(body) if ulen else body
+        out, self._inbuf = self._inbuf[:n], self._inbuf[n:]
+        return out
 
     def _read_packet(self) -> bytes:
         payload = b""
         while True:
-            header = self._recvn(4)
+            header = self._read_raw(4)
             length = header[0] | (header[1] << 8) | (header[2] << 16)
             self.seq = (header[3] + 1) & 0xFF
-            payload += self._recvn(length)
+            payload += self._read_raw(length)
             if length < 0xFFFFFF:
                 return payload
 
@@ -53,16 +75,31 @@ class MiniClient:
         return buf
 
     def _send(self, payload: bytes):
+        frames = []
         while True:
             chunk, payload = payload[:0xFFFFFF], payload[0xFFFFFF:]
             header = struct.pack("<I", len(chunk))[:3] + bytes([self.seq])
             self.seq = (self.seq + 1) & 0xFF
-            self.sock.sendall(header + chunk)
+            frames.append(header + chunk)
             if len(chunk) < 0xFFFFFF:
                 break
+        data = b"".join(frames)
+        if not self.compressed:
+            self.sock.sendall(data)
+            return
+        import zlib
+        if len(data) >= 50:
+            body, ulen = zlib.compress(data), len(data)
+        else:
+            body, ulen = data, 0
+        hdr = (struct.pack("<I", len(body))[:3] + bytes([self.cseq]) +
+               struct.pack("<I", ulen)[:3])
+        self.cseq = (self.cseq + 1) & 0xFF
+        self.sock.sendall(hdr + body)
 
     def _command(self, payload: bytes):
         self.seq = 0
+        self.cseq = 0
         self._send(payload)
 
     # -- handshake -------------------------------------------------------------
@@ -85,6 +122,8 @@ class MiniClient:
         caps = (P.CLIENT_PROTOCOL_41 | P.CLIENT_SECURE_CONNECTION |
                 P.CLIENT_PLUGIN_AUTH | P.CLIENT_MULTI_STATEMENTS |
                 P.CLIENT_TRANSACTIONS)
+        if self.compress:
+            caps |= P.CLIENT_COMPRESS
         if database:
             caps |= P.CLIENT_CONNECT_WITH_DB
         auth = P.native_password_scramble(password.encode(), seed[:20])

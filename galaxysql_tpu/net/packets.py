@@ -23,6 +23,7 @@ CLIENT_LONG_PASSWORD = 1
 CLIENT_FOUND_ROWS = 2
 CLIENT_LONG_FLAG = 4
 CLIENT_CONNECT_WITH_DB = 8
+CLIENT_COMPRESS = 32
 CLIENT_PROTOCOL_41 = 512
 CLIENT_TRANSACTIONS = 8192
 CLIENT_SECURE_CONNECTION = 32768
@@ -32,7 +33,7 @@ CLIENT_PLUGIN_AUTH = 1 << 19
 CLIENT_DEPRECATE_EOF = 1 << 24
 
 SERVER_CAPABILITIES = (CLIENT_LONG_PASSWORD | CLIENT_FOUND_ROWS | CLIENT_LONG_FLAG |
-                       CLIENT_CONNECT_WITH_DB | CLIENT_PROTOCOL_41 |
+                       CLIENT_CONNECT_WITH_DB | CLIENT_COMPRESS | CLIENT_PROTOCOL_41 |
                        CLIENT_TRANSACTIONS | CLIENT_SECURE_CONNECTION |
                        CLIENT_MULTI_STATEMENTS | CLIENT_MULTI_RESULTS |
                        CLIENT_PLUGIN_AUTH)

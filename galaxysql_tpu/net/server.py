@@ -46,17 +46,40 @@ class Connection:
         self.stmts: Dict[int, PreparedStatement] = {}
         self.next_stmt_id = 1
         self.closed = False
+        # compressed protocol (CLIENT_COMPRESS): active after a successful
+        # handshake that negotiated it; MySQL packets then ride inside
+        # [3B comp-len][1B comp-seq][3B uncompressed-len] frames (zlib when
+        # uncompressed-len > 0, verbatim when 0)
+        self.compressed = False
+        self.cseq = 0
+        self._inbuf = b""
+        self._outbuf: list = []
 
     # -- framing ---------------------------------------------------------------
+
+    async def _read_raw(self, n: int) -> bytes:
+        """n bytes of the logical (post-decompression) stream."""
+        if not self.compressed:
+            return await self.reader.readexactly(n)
+        import zlib
+        while len(self._inbuf) < n:
+            hdr = await self.reader.readexactly(7)
+            clen = hdr[0] | (hdr[1] << 8) | (hdr[2] << 16)
+            self.cseq = (hdr[3] + 1) & 0xFF
+            ulen = hdr[4] | (hdr[5] << 8) | (hdr[6] << 16)
+            body = await self.reader.readexactly(clen)
+            self._inbuf += zlib.decompress(body) if ulen else body
+        out, self._inbuf = self._inbuf[:n], self._inbuf[n:]
+        return out
 
     async def read_packet(self) -> Optional[bytes]:
         # reassemble >=16MB payloads split across continuation packets
         payload = b""
         while True:
-            header = await self.reader.readexactly(4)
+            header = await self._read_raw(4)
             length = header[0] | (header[1] << 8) | (header[2] << 16)
             self.seq = (header[3] + 1) & 0xFF
-            payload += await self.reader.readexactly(length)
+            payload += await self._read_raw(length)
             if length < 0xFFFFFF:
                 return payload
 
@@ -65,11 +88,30 @@ class Connection:
             chunk, payload = payload[:0xFFFFFF], payload[0xFFFFFF:]
             header = struct.pack("<I", len(chunk))[:3] + bytes([self.seq])
             self.seq = (self.seq + 1) & 0xFF
-            self.writer.write(header + chunk)
+            if self.compressed:
+                self._outbuf.append(header + chunk)
+            else:
+                self.writer.write(header + chunk)
             if len(chunk) < 0xFFFFFF:
                 break
 
+    MIN_COMPRESS = 50  # MySQL: tiny frames ship uncompressed (ulen = 0)
+
     async def flush(self):
+        if self.compressed and self._outbuf:
+            import zlib
+            data = b"".join(self._outbuf)
+            self._outbuf = []
+            for off in range(0, len(data), 0xFFFFF0):
+                part = data[off:off + 0xFFFFF0]
+                if len(part) >= self.MIN_COMPRESS:
+                    body, ulen = zlib.compress(part), len(part)
+                else:
+                    body, ulen = part, 0
+                hdr = (struct.pack("<I", len(body))[:3] + bytes([self.cseq]) +
+                       struct.pack("<I", ulen)[:3])
+                self.cseq = (self.cseq + 1) & 0xFF
+                self.writer.write(hdr + body)
         await self.writer.drain()
 
     def _status(self) -> int:
@@ -114,8 +156,12 @@ class Connection:
                 return
         self.send(P.ok_packet(status=self._status()))
         await self.flush()
+        # the handshake exchange is always uncompressed; the negotiated
+        # compressed framing starts with the first command
+        self.compressed = bool(creds["capabilities"] & P.CLIENT_COMPRESS)
         while not self.closed:
             self.seq = 0
+            self.cseq = 0
             try:
                 payload = await self.read_packet()
             except (asyncio.IncompleteReadError, ConnectionResetError):
