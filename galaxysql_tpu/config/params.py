@@ -36,6 +36,10 @@ AP_ROW_THRESHOLD = _p("AP_ROW_THRESHOLD", 50_000,
 BATCH_ROWS = _p("BATCH_ROWS", 1 << 20, "scan batch size (rows)")
 MAX_GROUPS = _p("MAX_GROUPS", 1 << 22, "hash-agg output capacity ceiling")
 JOIN_OUTPUT_FACTOR = _p("JOIN_OUTPUT_FACTOR", 2, "initial join output capacity factor")
+SORT_SPILL_BYTES = _p("SORT_SPILL_BYTES", 256 << 20,
+                      "ORDER BY input bytes above which sorted runs spill to disk")
+JOIN_SPILL_BYTES = _p("JOIN_SPILL_BYTES", 256 << 20,
+                      "join build bytes above which the grace hash spill engages")
 PARALLELISM = _p("PARALLELISM", 0, "local parallel drivers (0 = auto)")
 
 # --- plan cache / optimizer --------------------------------------------------

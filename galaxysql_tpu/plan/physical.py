@@ -42,6 +42,8 @@ class ExecContext:
         self.archive = archive        # ArchiveManager (cold parquet scans)
         self.archive_instance = archive_instance
         self.hints = hints or {}  # statement hints (sql/hints.py)
+        self.sort_spill_bytes = 256 << 20   # SORT_SPILL_BYTES (session override)
+        self.join_spill_bytes = 256 << 20   # JOIN_SPILL_BYTES
         self.collect_stats = False       # EXPLAIN ANALYZE per-operator stats
         self.op_stats: List[dict] = []   # filled by StatsOp when collecting
         self.trace: List[str] = []
@@ -352,7 +354,8 @@ def _build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
         return _build_join(node, ctx)
     if isinstance(node, L.Sort):
         return ops.SortOp(build_operator(node.child, ctx), node.keys,
-                          node.limit, node.offset)
+                          node.limit, node.offset,
+                          spill_threshold=ctx.sort_spill_bytes)
     if isinstance(node, L.Limit):
         return ops.LimitOp(build_operator(node.child, ctx), node.limit, node.offset)
     if isinstance(node, L.Union):
@@ -411,15 +414,18 @@ def _build_join(node: L.Join, ctx: ExecContext) -> ops.Operator:
         # probe side MUST be the preserved/output (left) side
         return ops.HashJoinOp(right, left, rkeys, lkeys, node.kind,
                               residual=node.residual, build_schema=right_schema,
-                              enable_bloom=bloom)
+                              enable_bloom=bloom,
+                              spill_threshold=ctx.join_spill_bytes)
     # inner: build the smaller estimated side
     l_est = estimate_rows(node.left)
     r_est = estimate_rows(node.right)
     if r_est <= l_est:
         return ops.HashJoinOp(right, left, rkeys, lkeys, "inner",
                               residual=node.residual, build_schema=right_schema,
-                              enable_bloom=bloom)
+                              enable_bloom=bloom,
+                              spill_threshold=ctx.join_spill_bytes)
     left_schema = {fid: (typ, d) for fid, typ, d in node.left.fields()}
     return ops.HashJoinOp(left, right, lkeys, rkeys, "inner",
                           residual=node.residual, build_schema=left_schema,
-                          enable_bloom=bloom)
+                          enable_bloom=bloom,
+                          spill_threshold=ctx.join_spill_bytes)

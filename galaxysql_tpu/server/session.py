@@ -486,6 +486,10 @@ class Session:
                           archive=self.instance.archive,
                           archive_instance=self.instance,
                           hints=getattr(plan, "hints", None))
+        ctx.sort_spill_bytes = self.instance.config.get("SORT_SPILL_BYTES",
+                                                        self.vars)
+        ctx.join_spill_bytes = self.instance.config.get("JOIN_SPILL_BYTES",
+                                                        self.vars)
         from galaxysql_tpu.plan import logical as L
         mdl_keys = {f"{n.table.schema.lower()}.{n.table.name.lower()}"
                     for n in L.walk(plan.rel) if isinstance(n, L.Scan)}
@@ -664,7 +668,18 @@ class Session:
         ts = self._snapshot_ts()
         txn_id = self.txn.txn_id if self.txn is not None else 0
         for pid, p in enumerate(store.partitions):
-            vis = p.visible_mask(ts, txn_id)
+            # snapshot visibility + lane references under the partition lock: a
+            # concurrent append REBINDS the lanes (longer arrays), and mixing
+            # pre-append visibility with post-append lanes tears the read
+            # (caught by the concurrency stress suite).  The captured refs are
+            # an immutable-length prefix, so the predicate can run unlocked;
+            # the caller re-checks conflicts under the lock before stamping.
+            with p.lock:
+                vis = p.visible_mask(ts, txn_id)
+                env = {}
+                for c in tm.columns:
+                    env[f"{alias}.{c.name}"] = (p.lanes[c.name],
+                                                p.valid[c.name])
             if not vis.any():
                 continue
             if pred is None:
@@ -672,9 +687,6 @@ class Session:
                 self._check_write_conflict(p, ids0)
                 yield store, pid, ids0
                 continue
-            env = {}
-            for c in tm.columns:
-                env[f"{alias}.{c.name}"] = (p.lanes[c.name], p.valid[c.name])
             mask = pred(env) & vis
             ids = np.nonzero(mask)[0]
             if ids.size:
