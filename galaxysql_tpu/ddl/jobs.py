@@ -57,7 +57,7 @@ class DdlContext:
 
     def bump(self, tm: TableMeta):
         tm.bump_version()
-        self.instance.catalog.version += 1
+        self.instance.catalog.bump_schema()
         if self.instance.metadb is not None:
             self.instance.metadb.save_table(tm)
             self.instance.metadb.notify(f"table.{tm.schema}.{tm.name}")
@@ -123,6 +123,7 @@ class AddColumnTask(DdlTask):
                 fill, valid = col.np_data(), col.np_valid()
             p.lanes[cm.name] = fill
             p.valid[cm.name] = valid
+            p.invalidate_indexes()
         ctx.bump(tm)
 
     def undo(self, ctx):
@@ -138,6 +139,7 @@ class AddColumnTask(DdlTask):
             for p in store.partitions:
                 p.lanes.pop(name, None)
                 p.valid.pop(name, None)
+                p.invalidate_indexes()
             ctx.bump(tm)
 
 
@@ -164,6 +166,7 @@ class DropColumnTask(DdlTask):
         for p in store.partitions:
             p.lanes.pop(name, None)
             p.valid.pop(name, None)
+            p.invalidate_indexes()
         ctx.bump(tm)
     # undo of a drop would need the saved lane; the engine runs destructive tasks
     # LAST so rollback never has to restore them (reference does the same)

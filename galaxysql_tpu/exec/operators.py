@@ -417,8 +417,11 @@ class HashAggOp(Operator):
                 # MIN/MAX on dictionary strings must compare collation ranks, not codes;
                 # _finalize maps ranks back to codes (count is rank-insensitive)
                 d_ = _find_dictionary(e) if e.dtype.is_string else None
-                if d_ is not None and len(d_) and not d_.is_sorted:
-                    rank = d_.rank_array()
+                from galaxysql_tpu.types import collation as _coll
+                if d_ is not None and len(d_) and (
+                        not d_.is_sorted or
+                        _coll.collation_of_expr(e) is not None):
+                    rank = _coll.sort_rank_array(e, d_)
 
                     def ranked(env, _f=f, _r=rank):
                         dd, vv = _f(env)
@@ -549,19 +552,17 @@ class HashAggOp(Operator):
         if not partials and not spiller.spilled_files:
             if self.group_exprs:
                 return None  # grouped agg over empty input: no rows at all
-            empty = [(jnp.zeros(1, jnp.int64), jnp.zeros(1, jnp.bool_))
+            empty = [(np.zeros(1, np.int64), np.zeros(1, np.bool_))
                      for _ in lane_names]
-            r = K.GroupByResult(tuple(), tuple(empty), jnp.zeros(1, jnp.bool_),
-                                jnp.int32(0), jnp.bool_(False))
-            return self._finalize(jax.tree.map(jnp.asarray,
-                                               jax.tree.map(np.asarray, r)),
-                                  lane_names)
+            r = K.GroupByResult(tuple(), tuple(empty), np.zeros(1, np.bool_),
+                                np.int32(0), np.bool_(False))
+            return self._finalize(r, lane_names)
 
         if len(partials) == 1 and not spiller.spilled_files:
             # single partial (the common fused-scan case): it IS the result —
             # partial and merge lane layouts coincide, skip the merge kernel
-            return self._finalize(jax.tree.map(jnp.asarray, partials[0]),
-                                  lane_names)
+            # (finalize is pure host math; partials are already np)
+            return self._finalize(partials[0], lane_names)
 
         acc: Optional[K.GroupByResult] = None
         wave: List[K.GroupByResult] = []
@@ -588,20 +589,24 @@ class HashAggOp(Operator):
             if wave_bytes > self.spill_threshold:
                 flush()
         flush()
-        return self._finalize(jax.tree.map(jnp.asarray, acc), lane_names)
+        return self._finalize(acc, lane_names)
 
     def _finalize(self, r: K.GroupByResult, lane_names: Tuple[str, ...]) -> ColumnBatch:
-        """Materialize final output batch; avg = sum/count with MySQL decimal scale."""
+        """Materialize final output batch; avg = sum/count with MySQL decimal
+        scale.  Pure host math over the (already host) partial result — no
+        device round trips for what is a tiny per-group fix-up."""
         cols: Dict[str, Column] = {}
         for i, (name, ge) in enumerate(self.group_exprs):
             d, v = r.keys[i]
-            cols[name] = Column(d, v, ge.dtype, _find_dictionary(ge))
+            cols[name] = Column(np.asarray(d),
+                                None if v is None else np.asarray(v),
+                                ge.dtype, _find_dictionary(ge))
         lanes = {n: r.aggs[j] for j, n in enumerate(lane_names)}
-        n_groups_live = r.live
-        if not self.group_exprs:
+        n_groups_live = np.asarray(r.live)
+        if not self.group_exprs and n_groups_live.shape[0]:
             # global aggregation always yields exactly one row
-            n_groups_live = jnp.ones_like(r.live).at[1:].set(False) \
-                if r.live.shape[0] else r.live
+            n_groups_live = np.zeros_like(n_groups_live)
+            n_groups_live[0] = True
         for a in self.aggs:
             if a.kind == "avg":
                 s, sv = lanes[a.name + "$sum"]
@@ -620,22 +625,27 @@ class HashAggOp(Operator):
                     data = s.astype(np.float64) / safe
                     data = data.astype(np.float32)
                 valid = (c > 0)
-                cols[a.name] = Column(jnp.asarray(data), jnp.asarray(valid), rt, None)
+                cols[a.name] = Column(data, valid, rt, None)
             else:
                 d, v = lanes[a.name]
+                d = np.asarray(d)
+                v = None if v is None else np.asarray(v)
                 rt = a.dtype
                 if a.kind == "sum" and rt.clazz == dt.TypeClass.FLOAT:
-                    d = jnp.asarray(np.asarray(d, dtype=np.float32))
+                    d = d.astype(np.float32)
                 if a.kind in ("count", "count_star"):
                     v = None  # COUNT over empty group is 0, not NULL
                 dict_ = _find_dictionary(a.arg) if (a.kind in ("min", "max") and
                                                     a.arg is not None and
                                                     a.arg.dtype.is_string) else None
-                if dict_ is not None and len(dict_) and not dict_.is_sorted:
+                from galaxysql_tpu.types import collation as _coll
+                if dict_ is not None and len(dict_) and (
+                        not dict_.is_sorted or
+                        _coll.collation_of_expr(a.arg) is not None):
                     # min/max ran on collation ranks; map winners back to codes
-                    order = dict_.sorted_order()
-                    ranks = np.clip(np.asarray(d), 0, len(order) - 1)
-                    d = jnp.asarray(order[ranks])
+                    order = _coll.sort_order_array(a.arg, dict_)
+                    ranks = np.clip(d, 0, len(order) - 1)
+                    d = order[ranks]
                 cols[a.name] = Column(d, v, rt, dict_)
         return ColumnBatch(cols, n_groups_live)
 
@@ -744,6 +754,52 @@ class HashJoinOp(Operator):
                 pkeys = [f(penv) for f in pk]
                 return K.hash_join_pairs(bkeys, pkeys, build.live_mask(),
                                          probe.live_mask(), cap)
+            return jax.jit(run)
+        return global_jit(key, build_fn)
+
+    def _csr_host(self, build_batch: ColumnBatch):
+        """Host-built slot CSR over the build side (CPU backend).
+
+        The slot-id lane is computed on device (hash math shared with the
+        probe kernel); the argsort + bincount run in numpy — XLA:CPU's
+        comparator sort is ~12x slower and was the single largest cost of the
+        whole join (the CSR is also reused across probe batches/retries)."""
+        nb = build_batch.capacity
+        M = 1 << max(4, int(nb * 4 - 1).bit_length())
+        key = ("join_build_slots", jax.default_backend(), nb, M,
+               tuple(expr_cache_key(e) for e in self.build_keys))
+
+        def build_fn():
+            bk, _ = self._key_compilers()
+
+            def run(build: ColumnBatch):
+                benv = batch_env(build)
+                bkeys = [f(benv) for f in bk]
+                return K.hash_join_build_slots(bkeys, build.live_mask(), M)
+            return jax.jit(run)
+        s_b = np.asarray(global_jit(key, build_fn)(build_batch))
+        perm = np.argsort(s_b, kind="stable").astype(np.int32)
+        counts = np.bincount(s_b, minlength=M + 1)[:M].astype(np.int32)
+        ends = np.cumsum(counts, dtype=np.int64)
+        starts = (ends - counts).astype(np.int64)
+        return (jnp.asarray(perm), jnp.asarray(starts), jnp.asarray(counts), M)
+
+    def _probe_csr_fn(self, cap: int, M: int, nb: int):
+        key = ("join_probe_csr", jax.default_backend(), cap, M, nb,
+               tuple(expr_cache_key(e) for e in self.build_keys),
+               tuple(expr_cache_key(e) for e in self.probe_keys))
+
+        def build_fn():
+            bk, pk = self._key_compilers()
+
+            def run(build: ColumnBatch, probe: ColumnBatch,
+                    perm, slot_starts, slot_counts):
+                benv, penv = batch_env(build), batch_env(probe)
+                bkeys = [f(benv) for f in bk]
+                pkeys = [f(penv) for f in pk]
+                return K.hash_join_probe_csr(bkeys, pkeys, build.live_mask(),
+                                             probe.live_mask(), perm,
+                                             slot_starts, slot_counts, M, cap)
             return jax.jit(run)
         return global_jit(key, build_fn)
 
@@ -957,6 +1013,116 @@ class HashJoinOp(Operator):
             for s in b_spill + p_spill:
                 s.close()
 
+    # -- native CPU join (ParallelHashJoinExec.java:131-226 analog) ----------
+
+    def _np_key_lanes(self, kfns, batch: ColumnBatch):
+        env = {n: (c.np_data(), None if c.valid is None else c.np_valid())
+               for n, c in batch.columns.items()}
+        out = []
+        for f in kfns:
+            d, v = f(env)
+            d = np.broadcast_to(np.asarray(d), (batch.capacity,))
+            if v is not None:
+                v = np.broadcast_to(np.asarray(v), (batch.capacity,))
+            out.append((d, v))
+        return out
+
+    def _native_batches(self, build_batch: ColumnBatch) -> Iterator[ColumnBatch]:
+        """CPU-backend join: the native chained-hash hot loop (galaxystore
+        gx_join_build/probe) with vectorized numpy verification/gathers.
+
+        The XLA formulations stay the TPU path; on a scalar core the chained
+        probe walks the build table at L2 speed, which no scatter/sort
+        reformulation matches.  Exact-key verification keeps 64-bit hash
+        collisions harmless; NULL keys never match (effective-live masks)."""
+        from galaxysql_tpu import native
+        bk, pk = self._key_compilers_np()
+        blanes = self._np_key_lanes(bk, build_batch)
+        b_eff = build_batch.np_live()
+        for _d, v in blanes:
+            if v is not None:
+                b_eff = b_eff & v
+        # single integer-domain key (FK/PK joins, dictionary codes, dates,
+        # scaled decimals): chain on the key lane itself — exact matches, no
+        # hash materialization and no verification pass
+        single_int = len(blanes) == 1 and \
+            not np.issubdtype(blanes[0][0].dtype, np.floating)
+        if single_int:
+            table = native.join_build_k1(blanes[0][0], b_eff)
+        else:
+            bh = None
+            for d, v in blanes:
+                bh = native.hash_combine(bh, d, v)
+            table = native.join_build(bh, b_eff)
+        res_np = ExprCompiler(np).compile_predicate(self.residual) \
+            if self.residual is not None else None
+
+        for pb in self.probe.batches():
+            planes = self._np_key_lanes(pk, pb)
+            p_live_mask = pb.np_live()
+            p_eff = p_live_mask
+            for _d, v in planes:
+                if v is not None:
+                    p_eff = p_eff & v
+            if single_int and \
+                    not np.issubdtype(planes[0][0].dtype, np.floating):
+                b_of, p_of = native.join_probe_k1(planes[0][0], p_eff, table)
+            else:
+                if single_int:  # float probe lane against int build: generic
+                    bh = native.hash_combine(None, blanes[0][0], blanes[0][1])
+                    table = native.join_build(bh, b_eff)
+                    single_int = False
+                ph = None
+                for d, v in planes:
+                    ph = native.hash_combine(ph, d, v)
+                b_of, p_of = native.join_probe(ph, p_eff, bh, table)
+                # exact-key verification (hash collisions filtered here)
+                if b_of.size:
+                    ver = np.ones(b_of.shape[0], dtype=np.bool_)
+                    for (bd, _bv), (pd, _pv) in zip(blanes, planes):
+                        ver &= bd[b_of] == pd[p_of]
+                    if not ver.all():
+                        b_of, p_of = b_of[ver], p_of[ver]
+            fast_semi = res_np is None and self.join_type in ("semi", "anti")
+            if not fast_semi:
+                n = b_of.shape[0]
+                cols: Dict[str, Column] = {}
+                for name, c in build_batch.columns.items():
+                    cols[name] = Column(
+                        c.np_data()[b_of],
+                        c.np_valid()[b_of] if c.valid is not None else None,
+                        c.dtype, c.dictionary)
+                for name, c in pb.columns.items():
+                    cols[name] = Column(
+                        c.np_data()[p_of],
+                        c.np_valid()[p_of] if c.valid is not None else None,
+                        c.dtype, c.dictionary)
+                keep = None
+                if res_np is not None and n:
+                    env = {nm: (cc.data, cc.valid) for nm, cc in cols.items()}
+                    keep = np.broadcast_to(np.asarray(res_np(env)), (n,))
+            if self.join_type in ("semi", "anti"):
+                matched = np.zeros(pb.capacity, dtype=np.bool_)
+                sel = p_of if res_np is None else p_of[keep]
+                matched[sel] = True
+                live = p_live_mask & (matched if self.join_type == "semi"
+                                      else ~matched)
+                yield ColumnBatch(pb.columns, live)
+                continue
+            out = ColumnBatch(cols, keep)
+            yield out.pad_to(bucket_capacity(max(n, 1)))
+            if self.join_type == "left":
+                matched = np.zeros(pb.capacity, dtype=np.bool_)
+                matched[p_of if keep is None else p_of[keep]] = True
+                unmatched = p_live_mask & ~matched
+                ncols: Dict[str, Column] = {}
+                for name, c in build_batch.columns.items():
+                    z = np.zeros(pb.capacity, dtype=c.np_data().dtype)
+                    ncols[name] = Column(z, np.zeros(pb.capacity, np.bool_),
+                                         c.dtype, c.dictionary)
+                ncols.update(pb.columns)
+                yield ColumnBatch(ncols, unmatched)
+
     @staticmethod
     def _gather(batch: ColumnBatch, idx, live) -> Dict[str, Column]:
         cols = {}
@@ -981,6 +1147,12 @@ class HashJoinOp(Operator):
                 yield from self._grace_batches(build_parts, build_iter)
                 return
         build_batch = concat_batches(build_parts)
+        if K.prefer_scatter() and build_batch.capacity:
+            # CPU: every downstream build-side cost (CSR bincount domain, slot
+            # table size M, verify gathers) scales with CAPACITY, and a build
+            # side gathered out of an upstream join is mostly dead rows —
+            # host-compact first (sub-ms at build sizes)
+            build_batch = build_batch.compact()
         if build_batch.capacity == 0:
             # empty build: inner/semi yield nothing; anti passes probe rows through;
             # left null-extends using the declared build schema
@@ -996,6 +1168,10 @@ class HashJoinOp(Operator):
                     ncols[name] = Column(z, jnp.zeros(pb.capacity, jnp.bool_), typ, d_)
                 ncols.update(pb.columns)
                 yield ColumnBatch(ncols, pb.live)
+            return
+        from galaxysql_tpu import native as _native
+        if K.prefer_scatter() and _native.AVAILABLE:
+            yield from self._native_batches(build_batch)
             return
         build_batch = build_batch.pad_to(bucket_capacity(build_batch.capacity))
 
@@ -1013,13 +1189,19 @@ class HashJoinOp(Operator):
             _, pk = self._key_compilers()
             bloom_filter = self._build_bloom(build_batch, pk[0])
 
+        csr = self._csr_host(build_batch) if K.prefer_scatter() else None
         for pb in self.probe.batches():
             if bloom_filter is not None:
                 pb = bloom_filter(pb)
             n_live = pb.num_live()
             cap = bucket_capacity(max(n_live * 2, MIN_BUCKET))
             while True:
-                pairs = self._pairs_fn(cap)(build_batch, pb)
+                if csr is not None:
+                    perm, starts, counts, M = csr
+                    pairs = self._probe_csr_fn(cap, M, build_batch.capacity)(
+                        build_batch, pb, perm, starts, counts)
+                else:
+                    pairs = self._pairs_fn(cap)(build_batch, pb)
                 if not bool(pairs.overflow):
                     break
                 cap *= 2
@@ -1144,7 +1326,10 @@ class SortOp(Operator):
         self.spilled_runs = 0  # observable spill counter (tests, EXPLAIN)
 
     def _compiled(self):
-        key = ("sort", tuple((expr_cache_key(e), desc) for e, desc in self.keys),
+        from galaxysql_tpu.types import collation as _coll
+        key = ("sort", tuple((expr_cache_key(e), desc,
+                              _coll.collation_of_expr(e))
+                             for e, desc in self.keys),
                self.limit, self.offset)
 
         def build():
@@ -1159,8 +1344,11 @@ class SortOp(Operator):
                     # dictionary codes are assignment-ordered, not collation-ordered:
                     # sort by the host-computed rank of each code
                     d_ = _find_dictionary(e)
-                    if d_ is not None and len(d_) and not d_.is_sorted:
-                        rank = d_.rank_array()
+                    from galaxysql_tpu.types import collation as _coll
+                    if d_ is not None and len(d_) and (
+                            not d_.is_sorted or
+                            _coll.collation_of_expr(e) is not None):
+                        rank = _coll.sort_rank_array(e, d_)
 
                         def ranked(env, _f=f, _r=rank):
                             dta, vld = _f(env)
@@ -1232,8 +1420,11 @@ class SortOp(Operator):
             d = np.broadcast_to(np.asarray(d), (batch.capacity,))
             if e.dtype.is_string:
                 d_ = _find_dictionary(e)
-                if d_ is not None and len(d_) and not d_.is_sorted:
-                    d = d_.rank_array()[np.clip(d, 0, len(d_) - 1)]
+                from galaxysql_tpu.types import collation as _coll
+                if d_ is not None and len(d_) and (
+                        not d_.is_sorted or
+                        _coll.collation_of_expr(e) is not None):
+                    d = _coll.sort_rank_array(e, d_)[np.clip(d, 0, len(d_) - 1)]
             nulls_first = not desc  # MySQL: NULLs first asc, last desc
             if v is None:
                 nk = np.ones(batch.capacity, np.int8)

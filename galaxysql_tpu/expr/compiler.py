@@ -516,18 +516,30 @@ class ExprCompiler:
                 # the dictionary, so its effective rank depends on the operator (half-open
                 # boundary): lt/ge compare against bisect_left, le/gt against
                 # bisect_right - 1.  The operator itself may be flipped below when the
-                # literal is the left operand.
-                rank = d.rank_array()
-                import bisect
-                svals = sorted(d.values)
+                # literal is the left operand.  Under a COLLATE the ranks are
+                # the collation's class ranks and the literal bisects over the
+                # sorted distinct folds (collation ordering, not binary).
+                from galaxysql_tpu.types import collation as _coll
+                _cname = _coll.collation_of_expr(colexpr)
                 effective_op = e.op
                 if colexpr is not a:  # literal on the left: lit OP col == col FLIP(OP) lit
                     effective_op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}.get(
                         e.op, e.op)
-                if effective_op in ("lt", "ge"):
-                    lrank = bisect.bisect_left(svals, str(litexpr.value))
+                if _cname is not None:
+                    rank = _coll.rank_under(d, _cname)[0]
+                    side = "left" if effective_op in ("lt", "ge") else "right"
+                    lrank = _coll.class_bound(d, _cname, str(litexpr.value),
+                                              side)
+                    if side == "right":
+                        lrank -= 1
                 else:
-                    lrank = bisect.bisect_right(svals, str(litexpr.value)) - 1
+                    rank = d.rank_array()
+                    import bisect
+                    svals = sorted(d.values)
+                    if effective_op in ("lt", "ge"):
+                        lrank = bisect.bisect_left(svals, str(litexpr.value))
+                    else:
+                        lrank = bisect.bisect_right(svals, str(litexpr.value)) - 1
                 cf0 = self._compile(colexpr)
                 rank_np = rank
 
@@ -546,7 +558,10 @@ class ExprCompiler:
         if da is db_:
             if e.op in ("eq", "ne"):
                 return ca, cb, dt.VARCHAR
-            ranks = da.rank_array()
+            from galaxysql_tpu.types import collation as _coll2
+            _cn = _coll2.collation_of_expr(a) or _coll2.collation_of_expr(b)
+            ranks = _coll2.rank_under(da, _cn)[0] if _cn is not None \
+                else da.rank_array()
 
             def wrapr(f):
                 return lambda env: (lambda dv: (xp.asarray(ranks)[dv[0]], dv[1]))(f(env))

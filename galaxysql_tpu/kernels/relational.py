@@ -749,6 +749,66 @@ def _hash_join_pairs_table(build_keys, probe_keys, build_live, probe_live,
     return JoinPairs(b_of, p_of, verified, probe_matched, starts, offsets, overflow)
 
 
+def hash_join_build_slots(build_keys: Sequence[Tuple[Any, Optional[Any]]],
+                          build_live: Any, M: int) -> Any:
+    """Build-side slot ids for the host-CSR join (CPU backend).
+
+    XLA:CPU's comparator sort is ~12x slower than numpy's introsort (measured
+    106ms vs 8ms argsorting 327k int32), so the CSR construction (argsort +
+    bincount of these slot ids) runs on the host; this device kernel only
+    computes the slot id lane (hash + mask) that both sides must agree on.
+    Dead/NULL-key rows get the scratch slot M."""
+    b_live = _effective_live(build_keys, build_live)
+    h_b = hash_columns(build_keys)
+    s_b = (h_b & jnp.uint64(M - 1)).astype(jnp.int32)
+    return jnp.where(b_live, s_b, jnp.int32(M))
+
+
+def hash_join_probe_csr(build_keys, probe_keys, build_live, probe_live,
+                        perm, slot_starts, slot_counts,
+                        M: int, cap: int) -> JoinPairs:
+    """Probe half of the CPU slot-table join against a host-built CSR.
+
+    Identical pair enumeration to `_hash_join_pairs_table` from the probe hash
+    onward; the build-side argsort/cumsum live outside (host numpy, see
+    `hash_join_build_slots`).  The CSR is reused across probe batches and
+    overflow retries — the build side is never re-sorted."""
+    b_live = _effective_live(build_keys, build_live)
+    p_live = _effective_live(probe_keys, probe_live)
+    nb = build_keys[0][0].shape[0]
+    npr = probe_keys[0][0].shape[0]
+
+    h_p = hash_columns(probe_keys)
+    s_p = (h_p & jnp.uint64(M - 1)).astype(jnp.int32)
+    counts = jnp.where(p_live, slot_counts[s_p].astype(jnp.int64), 0)
+
+    offsets = jnp.cumsum(counts)
+    total = offsets[-1] if npr else jnp.int64(0)
+    overflow = total > cap
+    starts = offsets - counts
+
+    slots = jnp.arange(cap, dtype=jnp.int64)
+    scatter_at = jnp.where(counts > 0, starts, jnp.int64(cap))
+    p_of = jnp.zeros(cap, jnp.int32).at[scatter_at].max(
+        jnp.arange(npr, dtype=jnp.int32), mode="drop")
+    p_of = jax.lax.cummax(p_of)
+    k = slots - starts[p_of]
+    pair_live = slots < jnp.minimum(total, cap)
+    bpos = jnp.clip(slot_starts[s_p[p_of]].astype(jnp.int64) + k, 0,
+                    max(nb - 1, 0))
+    b_of = perm[bpos]
+
+    verified = pair_live
+    for (bd, bv), (pd, pv) in zip(build_keys, probe_keys):
+        verified = verified & (bd[b_of] == pd[p_of])
+    verified = verified & b_live[b_of] & p_live[p_of]
+
+    probe_matched = probe_matched_from(verified, starts, offsets) \
+        if npr else jnp.zeros(0, jnp.bool_)
+
+    return JoinPairs(b_of, p_of, verified, probe_matched, starts, offsets, overflow)
+
+
 def probe_matched_from(pair_live: Any, starts: Any, offsets: Any) -> Any:
     """matched[p] = any pair in [starts[p], offsets[p]) is live (prefix-sum ranges)."""
     cap = pair_live.shape[0]

@@ -99,6 +99,10 @@ class TableMeta:
         # CN->worker plane: non-None marks a remote table served by a worker
         # process via shipped SQL ({"host":..., "port":...}; net/dn.py)
         self.remote: Optional[Dict[str, Any]] = None
+        # read replicas of a remote table: [{"host","port","weight","stale"}]
+        # — weighted read routing with fence-triggered failover
+        # (TGroupDataSource analog, polardbx-executor group/*)
+        self.replicas: List[Dict[str, Any]] = []
         self.by_name: Dict[str, ColumnMeta] = {c.name.lower(): c for c in self.columns}
         # one shared host dictionary per string column (codes stable table-wide)
         self.dictionaries: Dict[str, Dictionary] = {
@@ -160,6 +164,15 @@ class Catalog:
     def __init__(self):
         self.schemas: Dict[str, SchemaMeta] = {}
         self.version = 0
+        # schema-only counter: bumped by DDL (create/drop/alter of tables, views,
+        # schemas) but NOT by DML commits.  SPM baselines key on this — a write
+        # must not invalidate plan baselines (PlanManager invalidates on schema
+        # change only; `version` also moves on data changes for scan caches).
+        self.schema_version = 0
+
+    def bump_schema(self):
+        self.version += 1
+        self.schema_version += 1
 
     def create_schema(self, name: str, if_not_exists: bool = False) -> SchemaMeta:
         key = name.lower()
@@ -169,7 +182,7 @@ class Catalog:
             raise errors.TddlError(f"Can't create database '{name}'; database exists")
         s = SchemaMeta(name)
         self.schemas[key] = s
-        self.version += 1
+        self.bump_schema()
         return s
 
     def drop_schema(self, name: str, if_exists: bool = False):
@@ -179,7 +192,7 @@ class Catalog:
                 return
             raise errors.UnknownDatabaseError(f"Can't drop database '{name}'")
         del self.schemas[key]
-        self.version += 1
+        self.bump_schema()
 
     def schema(self, name: str) -> SchemaMeta:
         s = self.schemas.get(name.lower())
@@ -202,7 +215,7 @@ class Catalog:
         if key in s.tables:
             raise errors.TableExistsError(f"'{v.name}' is a base table")
         s.views[key] = v
-        self.version += 1
+        self.bump_schema()
 
     def drop_view(self, schema: str, name: str, if_exists: bool = False) -> bool:
         s = self.schema(schema)
@@ -212,7 +225,7 @@ class Catalog:
                 return False
             raise errors.UnknownTableError(f"Unknown view '{schema}.{name}'")
         del s.views[key]
-        self.version += 1
+        self.bump_schema()
         return True
 
     def add_table(self, tm: TableMeta, if_not_exists: bool = False) -> bool:
@@ -223,7 +236,7 @@ class Catalog:
                 return False
             raise errors.TableExistsError(f"Table '{tm.name}' already exists")
         s.tables[key] = tm
-        self.version += 1
+        self.bump_schema()
         return True
 
     def drop_table(self, schema: str, name: str, if_exists: bool = False) -> bool:
@@ -234,7 +247,7 @@ class Catalog:
                 return False
             raise errors.UnknownTableError(f"Unknown table '{schema}.{name}'")
         del s.tables[key]
-        self.version += 1
+        self.bump_schema()
         return True
 
 

@@ -149,6 +149,7 @@ def analyze_store(tm, store, sample_cap: int = 262144):
     for c in tm.columns:
         sk = NdvSketch()
         samples: List[np.ndarray] = []
+        col_min = col_max = None
         for p in store.partitions:
             lane = p.lanes[c.name][:p.num_rows]
             valid = p.valid[c.name][:p.num_rows]
@@ -156,7 +157,18 @@ def analyze_store(tm, store, sample_cap: int = 262144):
             if vals.size == 0:
                 continue
             sk.add_array(vals)  # per-partition sketch; np.maximum.at merges
-            samples.append(vals[:per_part])
+            if vals.size > per_part:
+                # strided sample: a leading-prefix slice of insertion-ordered
+                # data (e.g. monotone timestamps) sees only the oldest rows and
+                # skews every bucket; a stride covers the whole value range
+                stride = (vals.size + per_part - 1) // per_part
+                samples.append(vals[::stride][:per_part])
+            else:
+                samples.append(vals)
+            if not c.dtype.is_string:
+                lo, hi = vals.min().item(), vals.max().item()
+                col_min = lo if col_min is None else min(col_min, lo)
+                col_max = hi if col_max is None else max(col_max, hi)
         vals = np.concatenate(samples) if samples else np.zeros(0)
         ndv = sk.estimate() if vals.size else 0
         # small columns: exact beats the sketch's floor error
@@ -165,5 +177,6 @@ def analyze_store(tm, store, sample_cap: int = 262144):
         tm.stats.ndv[c.name] = ndv
         tm.stats.sketches[c.name] = sk
         if vals.size and not c.dtype.is_string:
-            tm.stats.min_max[c.name] = (vals.min().item(), vals.max().item())
+            # min/max over the FULL valid lanes, not the sample
+            tm.stats.min_max[c.name] = (col_min, col_max)
             tm.stats.histograms[c.name] = Histogram.build(vals, ndv)

@@ -34,6 +34,18 @@ class TimestampOracle:
             self._last_physical = phys
             return (phys << LOGICAL_BITS) | self._logical
 
+    def observe(self, ts: int):
+        """Advance past an externally issued timestamp (a coordinator's commit
+        TSO): local snapshots taken after this must order after `ts` even under
+        clock skew between hosts."""
+        with self._lock:
+            phys = ts >> LOGICAL_BITS
+            if phys > self._last_physical or (
+                    phys == self._last_physical and
+                    (ts & ((1 << LOGICAL_BITS) - 1)) > self._logical):
+                self._last_physical = phys
+                self._logical = ts & ((1 << LOGICAL_BITS) - 1)
+
     def next_timestamps(self, n: int) -> list:
         """Batched fetch (the reference batches TSO requests, ClusterTimestampOracle
         taskQueue)."""

@@ -59,6 +59,15 @@ def _load():
         lib.gx_hash_partition.argtypes = [i64p, i32p, st, ctypes.c_int32]
         lib.gx_visible_mask.argtypes = [i64p, i64p, u8p, st, ctypes.c_int64,
                                         ctypes.c_int64]
+        lib.gx_join_build.argtypes = [u64p, u8p, st, i32p, st, i32p]
+        lib.gx_join_probe.argtypes = [u64p, u8p, st, u64p, i32p, st, i32p,
+                                      i32p, i32p, st]
+        lib.gx_join_probe.restype = st
+        lib.gx_join_build_k1.argtypes = [i64p, u8p, st, i32p, st, i32p]
+        lib.gx_join_probe_k1.argtypes = [i64p, u8p, st, i64p, i32p, st, i32p,
+                                         i32p, i32p, st]
+        lib.gx_join_probe_k1.restype = st
+        lib.gx_hash_combine.argtypes = [u64p, i64p, u8p, st, ctypes.c_int32]
         lib.gx_bloom_build.argtypes = [i64p, st, u64p, st]
         lib.gx_bloom_query.argtypes = [i64p, st, u64p, st, u8p]
         lib.gx_crc32c.argtypes = [u8p, st, ctypes.c_uint32]
@@ -159,6 +168,155 @@ def bloom_query(keys: np.ndarray, words: np.ndarray) -> np.ndarray:
     hit1 = (w1 >> (h & np.uint64(63))) & np.uint64(1)
     hit2 = (w2 >> ((h >> np.uint64(32)) & np.uint64(63))) & np.uint64(1)
     return (hit1 & hit2).astype(np.bool_)
+
+
+def hash_combine(h: Optional[np.ndarray], lane: np.ndarray,
+                 valid: Optional[np.ndarray]) -> np.ndarray:
+    """Fold one key lane into the running combined hash — the host twin of
+    kernels/relational.py::hash_columns (identical constants; the two must agree
+    or nothing, since build and probe both hash here)."""
+    lane = np.ascontiguousarray(lane, dtype=np.int64)
+    n = lane.shape[0]
+    first = h is None
+    if first:
+        h = np.empty(n, dtype=np.uint64)
+    if AVAILABLE and n:
+        v = None if valid is None else \
+            np.ascontiguousarray(valid, dtype=np.uint8)
+        _lib.gx_hash_combine(_ptr(h, ctypes.c_uint64),
+                             _ptr(lane, ctypes.c_int64),
+                             None if v is None else _ptr(v, ctypes.c_uint8),
+                             n, 1 if first else 0)
+        return h
+    with np.errstate(over="ignore"):
+        l = _mix_np(lane.astype(np.uint64))
+        if valid is not None:
+            l = np.where(valid, l, np.uint64(0xDEADBEEFCAFEBABE))
+        if first:
+            return l
+        return _mix_np(h * np.uint64(31) + l + np.uint64(0x9E3779B97F4A7C15))
+
+
+def _as_u8(mask: np.ndarray) -> np.ndarray:
+    """bool mask -> uint8 lane, as a zero-copy view when already contiguous."""
+    if mask.dtype == np.bool_ and mask.flags["C_CONTIGUOUS"]:
+        return mask.view(np.uint8)
+    return np.ascontiguousarray(mask, dtype=np.uint8)
+
+
+def join_build(hashes: np.ndarray, live: np.ndarray):
+    """Chained hash table over build hashes -> (heads, next, M)."""
+    nb = hashes.shape[0]
+    M = 1 << max(4, int(max(nb, 1) * 2 - 1).bit_length())
+    heads = np.full(M, -1, dtype=np.int32)
+    nxt = np.empty(max(nb, 1), dtype=np.int32)
+    live8 = _as_u8(live)
+    if AVAILABLE and nb:
+        _lib.gx_join_build(_ptr(hashes, ctypes.c_uint64),
+                           _ptr(live8, ctypes.c_uint8), nb,
+                           _ptr(heads, ctypes.c_int32), M,
+                           _ptr(nxt, ctypes.c_int32))
+        return heads, nxt, M
+    # fallback marker: heads=None, nxt = LIVE row ids in hash-sorted order
+    ids = np.nonzero(np.asarray(live))[0]
+    order = ids[np.argsort(hashes[ids], kind="stable")]
+    return None, order, M
+
+
+def join_probe(probe_hashes: np.ndarray, probe_live: np.ndarray,
+               build_hashes: np.ndarray, table) -> tuple:
+    """Candidate pairs (b_idx, p_idx) for every probe row whose 64-bit hash
+    matches a build row's; exact-key verification is the caller's."""
+    heads, nxt, M = table
+    npr = probe_hashes.shape[0]
+    live8 = _as_u8(probe_live)
+    if AVAILABLE and heads is not None:
+        # start at npr/4: selective joins rarely exceed it, and buffer
+        # allocation is the dominant cost at large npr (a miss re-probes at
+        # the now-exact size — one extra pass over the lanes, ~1ms/M rows)
+        cap = max(int(npr) // 4, 1024)
+        while True:
+            out_b = np.empty(cap, dtype=np.int32)
+            out_p = np.empty(cap, dtype=np.int32)
+            total = _lib.gx_join_probe(
+                _ptr(probe_hashes, ctypes.c_uint64),
+                _ptr(live8, ctypes.c_uint8), npr,
+                _ptr(build_hashes, ctypes.c_uint64),
+                _ptr(heads, ctypes.c_int32), M,
+                _ptr(nxt, ctypes.c_int32),
+                _ptr(out_b, ctypes.c_int32), _ptr(out_p, ctypes.c_int32), cap)
+            if total <= cap:
+                return out_b[:total], out_p[:total]
+            cap = int(total)
+    # fallback: sort/searchsorted over the LIVE build hashes (see join_build)
+    order = nxt  # live build row ids in hash order
+    sh = build_hashes[order]
+    lo = np.searchsorted(sh, probe_hashes, side="left")
+    hi = np.searchsorted(sh, probe_hashes, side="right")
+    counts = np.where(probe_live, hi - lo, 0).astype(np.int64)
+    total = int(counts.sum())
+    p_of = np.repeat(np.arange(npr, dtype=np.int32), counts)
+    offs = np.concatenate([[0], np.cumsum(counts)])[:-1]
+    k = np.arange(total, dtype=np.int64) - np.repeat(offs, counts)
+    b_of = order[(np.repeat(lo, counts) + k).astype(np.int64)].astype(np.int32)
+    return b_of, p_of
+
+
+def join_build_k1(keys: np.ndarray, live: np.ndarray):
+    """Single-int64-key chained table; matching compares keys exactly (no
+    verification pass needed).  Returns (keys, heads, next, M)."""
+    keys = np.ascontiguousarray(keys, dtype=np.int64)
+    nb = keys.shape[0]
+    M = 1 << max(4, int(max(nb, 1) * 2 - 1).bit_length())
+    heads = np.full(M, -1, dtype=np.int32)
+    nxt = np.empty(max(nb, 1), dtype=np.int32)
+    live8 = _as_u8(live)
+    if AVAILABLE and nb:
+        _lib.gx_join_build_k1(_ptr(keys, ctypes.c_int64),
+                              _ptr(live8, ctypes.c_uint8), nb,
+                              _ptr(heads, ctypes.c_int32), M,
+                              _ptr(nxt, ctypes.c_int32))
+        return keys, heads, nxt, M
+    # fallback marker: heads=None, nxt = LIVE row ids in key-sorted order
+    ids = np.nonzero(np.asarray(live))[0]
+    order = ids[np.argsort(keys[ids], kind="stable")]
+    return keys, None, order, M
+
+
+def join_probe_k1(probe_keys: np.ndarray, probe_live: np.ndarray,
+                  table) -> tuple:
+    """Exact (b_idx, p_idx) pairs for a single-int64-key join."""
+    build_keys, heads, nxt, M = table
+    probe_keys = np.ascontiguousarray(probe_keys, dtype=np.int64)
+    npr = probe_keys.shape[0]
+    live8 = _as_u8(probe_live)
+    if AVAILABLE and heads is not None:
+        cap = max(int(npr) // 4, 1024)
+        while True:
+            out_b = np.empty(cap, dtype=np.int32)
+            out_p = np.empty(cap, dtype=np.int32)
+            total = _lib.gx_join_probe_k1(
+                _ptr(probe_keys, ctypes.c_int64),
+                _ptr(live8, ctypes.c_uint8), npr,
+                _ptr(build_keys, ctypes.c_int64),
+                _ptr(heads, ctypes.c_int32), M,
+                _ptr(nxt, ctypes.c_int32),
+                _ptr(out_b, ctypes.c_int32), _ptr(out_p, ctypes.c_int32), cap)
+            if total <= cap:
+                return out_b[:total], out_p[:total]
+            cap = int(total)
+    # numpy fallback: sorted live build keys + searchsorted expansion (exact)
+    order = nxt  # live build row ids in key order (see join_build_k1)
+    sk = build_keys[order]
+    lo = np.searchsorted(sk, probe_keys, side="left")
+    hi = np.searchsorted(sk, probe_keys, side="right")
+    counts = np.where(probe_live, hi - lo, 0).astype(np.int64)
+    total = int(counts.sum())
+    p_of = np.repeat(np.arange(npr, dtype=np.int32), counts)
+    offs = np.concatenate([[0], np.cumsum(counts)])[:-1]
+    k = np.arange(total, dtype=np.int64) - np.repeat(offs, counts)
+    b_of = order[(np.repeat(lo, counts) + k).astype(np.int64)].astype(np.int32)
+    return b_of, p_of
 
 
 def crc32c(data: bytes, seed: int = 0) -> int:

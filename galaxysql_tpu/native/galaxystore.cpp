@@ -69,6 +69,101 @@ void gx_bloom_query(const int64_t* keys, size_t n, const uint64_t* words,
     }
 }
 
+// ---- vectorized equi-join hot loop ----
+// Reference analog: ParallelHashJoinExec.java:131-226 / ConcurrentRawHashTable
+// (SURVEY.md §3.3).  Chained hash table over 64-bit key hashes: build links
+// rows per slot through a next[] array; probe walks the chain comparing the
+// FULL 64-bit hash (slot collisions cost chain hops, hash collisions cost
+// duplicate candidate pairs that the caller's exact-key verification filters —
+// never correctness).  This is the CPU-backend twin of the XLA formulations in
+// kernels/relational.py (TPU keeps sort/searchsorted + CSR: scatters serialize
+// there, while this loop is exactly what a scalar core does well).
+
+void gx_join_build(const uint64_t* hashes, const uint8_t* live, size_t nb,
+                   int32_t* heads, size_t M, int32_t* next) {
+    const uint64_t mask = (uint64_t)M - 1;
+    for (size_t i = 0; i < nb; i++) {
+        next[i] = -1;
+        if (!live[i]) continue;
+        size_t s = (size_t)(hashes[i] & mask);
+        next[i] = heads[s];
+        heads[s] = (int32_t)i;
+    }
+}
+
+// Emits candidate (build,probe) pairs; returns the TOTAL number of matches.
+// If the total exceeds cap only the first cap pairs are written and the caller
+// retries with a larger buffer (exact size now known).
+size_t gx_join_probe(const uint64_t* hashes, const uint8_t* live, size_t npr,
+                     const uint64_t* build_hashes,
+                     const int32_t* heads, size_t M, const int32_t* next,
+                     int32_t* out_b, int32_t* out_p, size_t cap) {
+    const uint64_t mask = (uint64_t)M - 1;
+    size_t o = 0;
+    for (size_t i = 0; i < npr; i++) {
+        if (!live[i]) continue;
+        const uint64_t h = hashes[i];
+        for (int32_t j = heads[(size_t)(h & mask)]; j >= 0; j = next[j]) {
+            if (build_hashes[j] == h) {
+                if (o < cap) { out_b[o] = j; out_p[o] = (int32_t)i; }
+                o++;
+            }
+        }
+    }
+    return o;
+}
+
+// Single-int64-key specialization: the chain stores row ids and matching
+// compares the KEY LANE itself — exact equality, so the caller skips both the
+// hash materialization and the verification pass (the dominant join shape:
+// FK/PK equi joins on integer/dictionary-code/date/decimal lanes).
+
+void gx_join_build_k1(const int64_t* keys, const uint8_t* live, size_t nb,
+                      int32_t* heads, size_t M, int32_t* next) {
+    const uint64_t mask = (uint64_t)M - 1;
+    for (size_t i = 0; i < nb; i++) {
+        next[i] = -1;
+        if (!live[i]) continue;
+        size_t s = (size_t)(mix64((uint64_t)keys[i]) & mask);
+        next[i] = heads[s];
+        heads[s] = (int32_t)i;
+    }
+}
+
+size_t gx_join_probe_k1(const int64_t* keys, const uint8_t* live, size_t npr,
+                        const int64_t* build_keys,
+                        const int32_t* heads, size_t M, const int32_t* next,
+                        int32_t* out_b, int32_t* out_p, size_t cap) {
+    const uint64_t mask = (uint64_t)M - 1;
+    size_t o = 0;
+    for (size_t i = 0; i < npr; i++) {
+        if (!live[i]) continue;
+        const int64_t k = keys[i];
+        for (int32_t j = heads[(size_t)(mix64((uint64_t)k) & mask)]; j >= 0;
+             j = next[j]) {
+            if (build_keys[j] == k) {
+                if (o < cap) { out_b[o] = j; out_p[o] = (int32_t)i; }
+                o++;
+            }
+        }
+    }
+    return o;
+}
+
+// Combined key-lane hashing (the np/jnp hash_columns twin): fold `lane` into
+// the running combined hash the same way kernels/relational.py::hash_columns
+// does.  first=1 initializes; null slots carry the NULL tag so NULL keys chain
+// together (verification decides join semantics).
+void gx_hash_combine(uint64_t* h, const int64_t* lane, const uint8_t* valid,
+                     size_t n, int32_t first) {
+    for (size_t i = 0; i < n; i++) {
+        uint64_t l = mix64((uint64_t)lane[i]);
+        if (valid && !valid[i]) l = 0xdeadbeefcafebabeULL;
+        h[i] = first ? l
+                     : mix64(h[i] * 31ULL + l + 0x9e3779b97f4a7c15ULL);
+    }
+}
+
 // ---- page checksum (persistence integrity; crc32c, software table) ----
 
 static uint32_t crc_table[256];

@@ -26,13 +26,14 @@ class MySQLError(Exception):
 class MiniClient:
     def __init__(self, host: str, port: int, user: str = "root", password: str = "",
                  database: Optional[str] = None, timeout: float = 30.0,
-                 compress: bool = False):
+                 compress: bool = False, use_ssl: bool = False):
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.seq = 0
         self.more_results = False
         # compressed protocol: negotiated at handshake, framing active after
         self.compress = compress
         self.compressed = False
+        self.use_ssl = use_ssl
         self.cseq = 0
         self._inbuf = b""
         self._handshake(user, password, database)
@@ -88,14 +89,24 @@ class MiniClient:
             self.sock.sendall(data)
             return
         import zlib
-        if len(data) >= 50:
-            body, ulen = zlib.compress(data), len(data)
-        else:
-            body, ulen = data, 0
-        hdr = (struct.pack("<I", len(body))[:3] + bytes([self.cseq]) +
-               struct.pack("<I", ulen)[:3])
-        self.cseq = (self.cseq + 1) & 0xFF
-        self.sock.sendall(hdr + body)
+        # chunk at the same bound the server uses: one compressed frame may not
+        # describe more than 2^24-1 payload bytes (3-byte lengths on the wire)
+        out = []
+        while data:
+            chunk, data = data[:0xFFFFF0], data[0xFFFFF0:]
+            body, ulen = chunk, 0
+            if len(chunk) >= 50:
+                z = zlib.compress(chunk)
+                # MySQL rule: ship uncompressed (ulen=0) when zlib does not
+                # shrink — worst-case expansion on incompressible input would
+                # overflow the 3-byte compressed-length field
+                if len(z) < len(chunk):
+                    body, ulen = z, len(chunk)
+            hdr = (struct.pack("<I", len(body))[:3] + bytes([self.cseq]) +
+                   struct.pack("<I", ulen)[:3])
+            self.cseq = (self.cseq + 1) & 0xFF
+            out.append(hdr + body)
+        self.sock.sendall(b"".join(out))
 
     def _command(self, payload: bytes):
         self.seq = 0
@@ -126,6 +137,18 @@ class MiniClient:
             caps |= P.CLIENT_COMPRESS
         if database:
             caps |= P.CLIENT_CONNECT_WITH_DB
+        if self.use_ssl:
+            # SSLRequest: short header-only response with CLIENT_SSL, then the
+            # TLS handshake; the credentialed response goes over the ciphertext
+            import ssl as _ssl
+            sslreq = struct.pack("<IIB", caps | P.CLIENT_SSL, 1 << 24, 255) + \
+                b"\0" * 23
+            self._send(sslreq)
+            ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = _ssl.CERT_NONE  # self-signed server cert (tests)
+            self.sock = ctx.wrap_socket(self.sock)
+            caps |= P.CLIENT_SSL
         auth = P.native_password_scramble(password.encode(), seed[:20])
         payload = struct.pack("<IIB", caps, 1 << 24, 255) + b"\0" * 23
         payload += user.encode() + b"\0"
@@ -160,6 +183,29 @@ class MiniClient:
     def ping(self) -> bool:
         self._command(bytes([P.COM_PING]))
         return self._read_packet()[0] == 0
+
+    def binlog_dump(self, since_seq: int = 0, non_block: bool = True) -> list:
+        """COM_BINLOG_DUMP: pull the CDC change stream from a SEQ position.
+
+        Returns the decoded event dicts (non-blocking mode reads to the log's
+        end).  Each event carries seq/commit_ts/schema/table/kind/payload —
+        the server's logical binlog wire form (txn/cdc.py); resume from the
+        max seq seen."""
+        import json
+        flags = 0x01 if non_block else 0
+        payload = (bytes([P.COM_BINLOG_DUMP]) +
+                   struct.pack("<I", since_seq & 0xFFFFFFFF) +
+                   struct.pack("<H", flags) +
+                   struct.pack("<I", 1) + struct.pack("<Q", since_seq))
+        self._command(payload)
+        events = []
+        while True:
+            pkt = self._read_packet()
+            if pkt[0] == 0xFF:
+                raise self._err(pkt)
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                return events  # EOF
+            events.append(json.loads(pkt[1:].decode("utf8")))
 
     def prepare(self, sql: str) -> int:
         self._command(bytes([P.COM_STMT_PREPARE]) + sql.encode("utf8"))

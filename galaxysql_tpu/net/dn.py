@@ -105,12 +105,30 @@ class WorkerClient:
             raise errors.TddlError(f"worker {self.addr}: {resp['error']}")
         return resp, arrs
 
-    def execute(self, sql: str, schema: str = "") -> Tuple[List[str], List[str],
-                                                           Dict[str, np.ndarray],
-                                                           Dict[str, np.ndarray]]:
-        """Ship SQL; returns (columns, sql_types, data arrays, valid arrays)."""
-        resp, arrs = self.request({"op": "exec_sql", "sql": sql,
-                                   "schema": schema})
+    def execute(self, sql: str, schema: str = "",
+                xid: Optional[str] = None) -> Tuple[List[str], List[str],
+                                                    Dict[str, np.ndarray],
+                                                    Dict[str, np.ndarray]]:
+        """Ship SQL; returns (columns, sql_types, data arrays, valid arrays).
+        With `xid`, the worker runs it in that txn branch's session (reads see
+        the branch's uncommitted writes)."""
+        hdr = {"op": "exec_sql", "sql": sql, "schema": schema}
+        if xid is not None:
+            hdr["xid"] = xid
+        resp, arrs = self.request(hdr)
+        cols = resp["columns"]
+        data = {c: arrs[f"d::{c}"] for c in cols}
+        valid = {c: arrs[f"v::{c}"] for c in cols if f"v::{c}" in arrs}
+        return cols, resp["types"], data, valid
+
+    def exec_plan(self, fragment: dict) -> Tuple[List[str], List[str],
+                                                 Dict[str, np.ndarray],
+                                                 Dict[str, np.ndarray]]:
+        """Ship a serialized physical fragment (XPlan analog,
+        `RelToXPlanConverter.java:41` / `XPlanTemplate.java:86`): the worker
+        executes it straight against its store — no re-parse, no re-plan.
+        Raises on an unsupported fragment; the caller degrades to exec_sql."""
+        resp, arrs = self.request({"op": "exec_plan", "fragment": fragment})
         cols = resp["columns"]
         data = {c: arrs[f"d::{c}"] for c in cols}
         valid = {c: arrs[f"v::{c}"] for c in cols if f"v::{c}" in arrs}

@@ -25,6 +25,7 @@ CLIENT_LONG_FLAG = 4
 CLIENT_CONNECT_WITH_DB = 8
 CLIENT_COMPRESS = 32
 CLIENT_PROTOCOL_41 = 512
+CLIENT_SSL = 2048
 CLIENT_TRANSACTIONS = 8192
 CLIENT_SECURE_CONNECTION = 32768
 CLIENT_MULTI_STATEMENTS = 1 << 16
@@ -55,6 +56,7 @@ COM_STMT_SEND_LONG_DATA = 0x18
 COM_STMT_CLOSE = 0x19
 COM_STMT_RESET = 0x1A
 COM_SET_OPTION = 0x1B
+COM_BINLOG_DUMP = 0x12
 
 # column type codes
 T_DECIMAL = 0x00
@@ -141,16 +143,17 @@ def native_password_scramble(password: bytes, seed: bytes) -> bytes:
 # server -> client packets (payloads; framing added by the transport)
 # ---------------------------------------------------------------------------
 
-def handshake_v10(conn_id: int, seed: bytes) -> bytes:
+def handshake_v10(conn_id: int, seed: bytes, caps: int = 0) -> bytes:
+    caps = caps or SERVER_CAPABILITIES
     out = bytearray()
     out.append(PROTOCOL_VERSION)
     out += SERVER_VERSION + b"\0"
     out += struct.pack("<I", conn_id)
     out += seed[:8] + b"\0"
-    out += struct.pack("<H", SERVER_CAPABILITIES & 0xFFFF)
+    out += struct.pack("<H", caps & 0xFFFF)
     out.append(CHARSET_UTF8MB4)
     out += struct.pack("<H", SERVER_STATUS_AUTOCOMMIT)
-    out += struct.pack("<H", (SERVER_CAPABILITIES >> 16) & 0xFFFF)
+    out += struct.pack("<H", (caps >> 16) & 0xFFFF)
     out.append(len(seed) + 1)
     out += b"\0" * 10
     out += seed[8:] + b"\0"

@@ -104,10 +104,13 @@ class Connection:
             self._outbuf = []
             for off in range(0, len(data), 0xFFFFF0):
                 part = data[off:off + 0xFFFFF0]
+                body, ulen = part, 0
                 if len(part) >= self.MIN_COMPRESS:
-                    body, ulen = zlib.compress(part), len(part)
-                else:
-                    body, ulen = part, 0
+                    z = zlib.compress(part)
+                    # incompressible payloads ship verbatim (ulen=0): zlib
+                    # expansion could overflow the 3-byte length field
+                    if len(z) < len(part):
+                        body, ulen = z, len(part)
                 hdr = (struct.pack("<I", len(body))[:3] + bytes([self.cseq]) +
                        struct.pack("<I", ulen)[:3])
                 self.cseq = (self.cseq + 1) & 0xFF
@@ -137,9 +140,23 @@ class Connection:
     async def _run_inner(self):
         # salt bytes must avoid NUL: clients read the second half null-terminated
         seed = bytes(secrets.choice(range(1, 256)) for _ in range(20))
-        self.send(P.handshake_v10(self.session.conn_id, seed))
+        caps = P.SERVER_CAPABILITIES | \
+            (P.CLIENT_SSL if self.server.ssl_context is not None else 0)
+        self.send(P.handshake_v10(self.session.conn_id, seed, caps))
         await self.flush()
         payload = await self.read_packet()
+        # SSLRequest (FrontendCommandHandler.java:99 / net/ssl analog): a short
+        # response with CLIENT_SSL set means "switch to TLS now"; the real
+        # handshake response then arrives over the encrypted stream
+        if len(payload) < 36 and \
+                struct.unpack_from("<I", payload, 0)[0] & P.CLIENT_SSL:
+            if self.server.ssl_context is None:
+                self.send(P.err_packet(3159, "HY000",
+                                       "SSL is not enabled on this server"))
+                await self.flush()
+                return
+            await self.writer.start_tls(self.server.ssl_context)
+            payload = await self.read_packet()
         creds = P.parse_handshake_response(payload)
         if not self.server.authenticate(creds["user"], creds["auth"], seed):
             self.send(P.err_packet(1045, "28000",
@@ -213,12 +230,54 @@ class Connection:
                 self.send(P.ok_packet(status=self._status()))
             elif cmd == P.COM_SET_OPTION:
                 self.send(P.eof_packet(self._status()))
+            elif cmd == P.COM_BINLOG_DUMP:
+                await self.binlog_dump(payload)
             else:
                 self.send(P.err_packet(1047, "08S01", f"Unknown command {cmd:#x}"))
         except errors.TddlError as e:
             self.send(P.err_packet(e.errno, e.sqlstate, e.message))
         except Exception as e:  # pragma: no cover - hardening
             self.send(P.err_packet(1105, "HY000", f"{type(e).__name__}: {e}"))
+
+    BINLOG_DUMP_NON_BLOCK = 0x01
+
+    async def binlog_dump(self, payload: bytes):
+        """COM_BINLOG_DUMP: stream the CDC change log from a position.
+
+        Reference analog: `FrontendCommandHandler.java:99-104` routes the
+        binlog-dump op to the CDC component; like the reference's logical
+        binlog, events here are the engine's row-image records — each packet is
+        [0x00][json event] with seq/commit_ts/schema/table/kind/payload fields
+        (txn/cdc.py's wire form, replayable via cdc.replay).  Position = the
+        last-seen event SEQ (0 = from the start) — seq, not commit_ts, so a
+        transaction whose events straddle a page boundary resumes without
+        loss.  With BINLOG_DUMP_NON_BLOCK the stream ends in EOF at the log's
+        end; otherwise it keeps tailing until the client drops."""
+        import json
+        pos = struct.unpack_from("<I", payload, 1)[0]
+        flags = struct.unpack_from("<H", payload, 5)[0] \
+            if len(payload) >= 7 else self.BINLOG_DUMP_NON_BLOCK
+        since = int(pos)
+        if len(payload) >= 19:
+            # seq positions may exceed the 4-byte pos field: clients append
+            # the full 64-bit watermark where the filename would sit
+            since = struct.unpack_from("<Q", payload, 11)[0]
+        cdc = self.session.instance.cdc
+        PAGE = 10000
+        while not self.closed:
+            events = await self.run_blocking(cdc.events_after_seq, since, PAGE)
+            for seq, cts, schema, table, kind, pl in events:
+                ev = {"seq": seq, "commit_ts": cts, "schema": schema,
+                      "table": table, "kind": kind, "payload": pl}
+                self.send(b"\x00" + json.dumps(ev).encode("utf8"))
+                since = max(since, seq)
+            await self.flush()
+            if len(events) == PAGE:
+                continue  # more pages pending: drain before EOF/tail decision
+            if flags & self.BINLOG_DUMP_NON_BLOCK:
+                self.send(P.eof_packet(self._status()))
+                return
+            await asyncio.sleep(0.2)  # tail the log
 
     async def run_blocking(self, fn, *args):
         loop = asyncio.get_running_loop()
@@ -280,7 +339,9 @@ class MySQLServer:
     """The frontend acceptor (CobarServer.startupServer analog, §3.1)."""
 
     def __init__(self, instance: Instance, host: str = "127.0.0.1", port: int = 3406,
-                 users: Optional[Dict[str, str]] = None, pool_size: int = 16):
+                 users: Optional[Dict[str, str]] = None, pool_size: int = 16,
+                 ssl_certfile: Optional[str] = None,
+                 ssl_keyfile: Optional[str] = None):
         self.instance = instance
         self.host = host
         self.port = port
@@ -288,6 +349,14 @@ class MySQLServer:
         self.pool = ThreadPoolExecutor(max_workers=pool_size,
                                        thread_name_prefix="exec")
         self._server: Optional[asyncio.AbstractServer] = None
+        # TLS (net/ssl analog): when a cert is configured the handshake
+        # advertises CLIENT_SSL and honors the SSLRequest upgrade
+        self.ssl_context = None
+        if ssl_certfile:
+            import ssl as _ssl
+            ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(ssl_certfile, ssl_keyfile)
+            self.ssl_context = ctx
 
     def authenticate(self, user: str, auth: bytes, seed: bytes) -> bool:
         # explicit user map (tests) takes precedence; otherwise the metadb

@@ -36,6 +36,8 @@ class Worker:
         self.instance = Instance(data_dir=data_dir)
         self.queries: list = []  # shipped-SQL log (tests assert pushdown)
         self._lock = threading.Lock()
+        # open distributed-txn branches: xid -> Session with an open local txn
+        self._branches: Dict[str, object] = {}
 
     # -- request handlers ----------------------------------------------------
 
@@ -47,42 +49,356 @@ class Worker:
             return self._exec_sql(header)
         if op == "sync":
             return self._sync(header)
+        if op == "exec_plan":
+            return self._exec_plan(header)
+        if op == "dml":
+            return self._dml(header)
+        if op == "xa_prepare":
+            return self._xa_prepare(header)
+        if op == "xa_commit":
+            return self._xa_commit(header)
+        if op == "xa_rollback":
+            return self._xa_rollback(header)
+        if op == "xa_recover":
+            return self._xa_recover()
         return {"error": f"unknown op {op!r}"}, {}
+
+    # -- distributed-txn branch ops (the DN side of TsoTransaction 2PC,
+    # TsoTransaction.java:166-216: per-shard XA PREPARE/COMMIT) --------------
+
+    def _dml(self, header: dict):
+        """Execute shipped DML inside the branch's open local transaction."""
+        from galaxysql_tpu.server.session import Session
+        xid = header["xid"]
+        with self._lock:
+            self.queries.append(header["sql"])
+            s = self._branches.get(xid)
+            if s is None:
+                s = Session(self.instance, schema=header.get("schema") or None)
+                s.autocommit = False
+                s._begin()
+                self._branches[xid] = s
+        if header.get("schema"):
+            s.schema = header["schema"]
+        rs = s.execute(header["sql"], header.get("params") or [])
+        return {"ok": True, "affected": rs.affected}, {}
+
+    def _xa_prepare(self, header: dict):
+        import json
+        from galaxysql_tpu.txn.xa import participants_of
+        xid = header["xid"]
+        s = self._branches.get(xid)
+        if s is None or s.txn is None:
+            return {"ok": False, "error": f"unknown branch {xid!r}"}, {}
+        parts = participants_of(s.txn)
+        for sp in parts:
+            if not sp.prepare():
+                for done in parts:
+                    done.rollback()
+                self._branches.pop(xid, None)
+                s.txn = None
+                s.close()  # deregister: a leaked session reads as an open txn
+                return {"ok": False, "error": "branch prepare failed"}, {}
+        # durability order: store snapshots FIRST, marker LAST — a crash before
+        # the marker means prepare was never acked (presumed abort is correct);
+        # after the marker the provisional rows are on disk and recovery holds
+        # them in doubt (recover_persisted skips marked branches)
+        self.instance.save()
+        self.instance.metadb.kv_put(
+            f"xa.branch.{xid}",
+            json.dumps({"txn_id": s.txn.txn_id, "state": "PREPARED"}))
+        return {"ok": True}, {}
+
+    def _branch_txn_id(self, xid: str):
+        import json
+        v = self.instance.metadb.kv_get(f"xa.branch.{xid}")
+        if v is None:
+            return None
+        try:
+            return int(json.loads(v)["txn_id"])
+        except Exception:
+            return None
+
+    def _finalize_stamps(self, txn_id: int, commit_ts):
+        """Resolve ±txn_id provisional stamps across all stores (used when the
+        branch session died with the process; mirrors recover_persisted)."""
+        from galaxysql_tpu.storage.table_store import INFINITY_TS
+        own = -txn_id
+        for store in self.instance.stores.values():
+            for p in store.partitions:
+                with p.lock:
+                    if commit_ts is not None:
+                        p.begin_ts[p.begin_ts == own] = commit_ts
+                        p.end_ts[p.end_ts == own] = commit_ts
+                    else:
+                        p.end_ts[p.end_ts == own] = INFINITY_TS
+                        mine = p.begin_ts == own
+                        p.begin_ts[mine] = INFINITY_TS
+                        p.end_ts[mine] = 0
+            store.table.bump_version()
+        self.instance.catalog.version += 1
+
+    def _xa_commit(self, header: dict):
+        import json
+        from galaxysql_tpu.txn.xa import participants_of
+        xid = header["xid"]
+        commit_ts = int(header["commit_ts"])
+        # the coordinator's TSO is the clock: local snapshots must advance past
+        # the commit stamp or the new rows would be invisible to local reads
+        self.instance.tso.observe(commit_ts)
+        s = self._branches.pop(xid, None)
+        if s is not None and s.txn is not None:
+            txn = s.txn
+            s.txn = None
+            for sp in participants_of(txn):
+                sp.commit(commit_ts)
+            self.instance.cdc.flush_txn(txn, commit_ts)
+            self.instance.catalog.version += 1
+            s.close()
+            txn_id = txn.txn_id
+        else:
+            txn_id = self._branch_txn_id(xid)
+            if txn_id is None:
+                # idempotent: branch already resolved (re-sent commit)
+                return {"ok": True, "already": True}, {}
+            self._finalize_stamps(txn_id, commit_ts)
+        self.instance.metadb.tx_log_put(txn_id, "DONE", commit_ts)
+        self.instance.metadb.kv_put(f"xa.branch.{xid}",
+                                    json.dumps({"txn_id": txn_id,
+                                                "state": "DONE"}))
+        self.instance.save()
+        return {"ok": True}, {}
+
+    def _xa_rollback(self, header: dict):
+        import json
+        from galaxysql_tpu.txn.xa import participants_of
+        xid = header["xid"]
+        s = self._branches.pop(xid, None)
+        if s is not None and s.txn is not None:
+            txn = s.txn
+            s.txn = None
+            for sp in participants_of(txn):
+                sp.rollback()
+            s.close()
+            txn_id = txn.txn_id
+        else:
+            txn_id = self._branch_txn_id(xid)
+            if txn_id is None:
+                return {"ok": True, "already": True}, {}
+            self._finalize_stamps(txn_id, None)
+        self.instance.metadb.tx_log_put(txn_id, "ABORTED")
+        self.instance.metadb.kv_put(f"xa.branch.{xid}",
+                                    json.dumps({"txn_id": txn_id,
+                                                "state": "ABORTED"}))
+        self.instance.save()
+        return {"ok": True}, {}
+
+    def _xa_recover(self):
+        """List PREPARED (in-doubt) branches for the coordinator to resolve."""
+        import json
+        xids = []
+        for k, v in self.instance.metadb.kv_scan("xa.branch."):
+            try:
+                if json.loads(v).get("state") == "PREPARED":
+                    xids.append(k[len("xa.branch."):])
+            except Exception:
+                continue
+        return {"ok": True, "xids": xids}, {}
 
     def _exec_sql(self, header: dict):
         from galaxysql_tpu.server.session import Session
         sql = header["sql"]
         with self._lock:
             self.queries.append(sql)
+        # an xid routes the statement through that branch's open session so
+        # reads observe the branch's own uncommitted writes (the degrade path
+        # must keep the same txn visibility the fragment path has)
+        branch = self._branches.get(header.get("xid")) \
+            if header.get("xid") else None
+        if branch is not None:
+            if header.get("schema"):
+                branch.schema = header["schema"]
+            return self._serialize_rs(branch.execute(sql))
         s = Session(self.instance, schema=header.get("schema") or None)
         try:
-            rs = s.execute(sql)
-            cols = rs.names
-            arrays: Dict[str, np.ndarray] = {}
-            types = []
-            for i, (name, typ) in enumerate(zip(rs.names, rs.types)):
-                vals = [r[i] for r in rs.rows]
-                valid = np.array([v is not None for v in vals], dtype=bool)
-                if typ.is_string:
-                    data = np.array([v if v is not None else "" for v in vals],
-                                    dtype=object).astype(str)
-                elif typ.sql_name().startswith(("DECIMAL", "DOUBLE", "FLOAT")):
-                    data = np.array([v if v is not None else 0.0 for v in vals],
-                                    dtype=np.float64)
-                elif typ.sql_name() in ("DATE", "DATETIME"):
-                    data = np.array([v if v is not None else "" for v in vals],
-                                    dtype=object).astype(str)
-                else:
-                    data = np.array([v if v is not None else 0 for v in vals],
-                                    dtype=np.int64)
+            return self._serialize_rs(s.execute(sql))
+        finally:
+            s.close()
+
+    @staticmethod
+    def _serialize_rs(rs):
+        """ResultSet -> wire response (shared by the plain and branch paths)."""
+        cols = rs.names
+        arrays: Dict[str, np.ndarray] = {}
+        types = []
+        batch_cols = None
+        if rs.batch is not None:
+            bc = rs.batch.compact()
+            if len(bc.names()) == len(rs.names):
+                batch_cols = [bc.columns[n] for n in bc.names()]
+        for i, (name, typ) in enumerate(zip(rs.names, rs.types)):
+            vals = [r[i] for r in rs.rows]
+            valid = np.array([v is not None for v in vals], dtype=bool)
+            if typ.is_string:
+                data = np.array([v if v is not None else "" for v in vals],
+                                dtype=object).astype(str)
+            elif typ.sql_name().startswith("DECIMAL") and batch_cols is not None:
+                # lane-exact: scaled int64 straight from the engine lane —
+                # a float round-trip truncates >15-16 significant digits
+                data = batch_cols[i].np_data().astype(np.int64)
                 arrays[f"d::{name}"] = data
                 if not valid.all():
                     arrays[f"v::{name}"] = valid
-                types.append(typ.sql_name())
-            return ({"columns": cols, "types": types, "rows": len(rs.rows),
-                     "affected": rs.affected}, arrays)
-        finally:
-            s.close()
+                types.append(typ.sql_name() + "#scaled")
+                continue
+            elif typ.sql_name().startswith(("DECIMAL", "DOUBLE", "FLOAT")):
+                data = np.array([v if v is not None else 0.0 for v in vals],
+                                dtype=np.float64)
+            elif typ.sql_name() in ("DATE", "DATETIME"):
+                data = np.array([v if v is not None else "" for v in vals],
+                                dtype=object).astype(str)
+            else:
+                data = np.array([v if v is not None else 0 for v in vals],
+                                dtype=np.int64)
+            arrays[f"d::{name}"] = data
+            if not valid.all():
+                arrays[f"v::{name}"] = valid
+            types.append(typ.sql_name())
+        return ({"columns": cols, "types": types, "rows": len(rs.rows),
+                 "affected": rs.affected}, arrays)
+
+    _SARG_OPS = {"eq": np.equal, "lt": np.less, "le": np.less_equal,
+                 "gt": np.greater, "ge": np.greater_equal}
+
+    @staticmethod
+    def _wire_lane(tm, cname: str, lane: np.ndarray):
+        """Lane -> wire array + type tag: the ONE encoder for fragment results
+        and deleted-key lists (strings decode via the dictionary, DATE/DATETIME
+        format to text, DECIMAL ships scaled int64 tagged '#scaled')."""
+        cm = tm.column(cname)
+        tname = cm.dtype.sql_name()
+        if cm.dtype.is_string:
+            d = tm.dictionaries.get(cname.lower())
+            vals = d.decode(lane) if d is not None else [""] * lane.size
+            arr = np.array([x if x is not None else "" for x in vals],
+                           dtype=object).astype(str) if lane.size else \
+                np.zeros(0, dtype="U1")
+            return arr, tname
+        if tname.startswith("DECIMAL"):
+            return lane.astype(np.int64), tname + "#scaled"
+        if tname in ("DATE", "DATETIME"):
+            from galaxysql_tpu.types import temporal
+            fmt = temporal.format_date if tname == "DATE" \
+                else temporal.format_datetime
+            arr = np.array([fmt(int(x)) for x in lane],
+                           dtype=object).astype(str) if lane.size else \
+                np.zeros(0, dtype="U1")
+            return arr, tname
+        if tname in ("DOUBLE", "FLOAT"):
+            return lane.astype(np.float64), tname
+        return lane.astype(np.int64), tname
+
+    def _exec_plan(self, header: dict):
+        """Execute a shipped physical scan fragment straight against the store.
+
+        Reference analog: `PolarxExecPlan` key-Get/scan execution
+        (`MyJdbcHandler.java:691-742`, `RelToXPlanConverter.java:41`): the
+        coordinator ships a bound fragment — table, pruned column list,
+        lane-domain SARGs, optional point key — and the worker runs it with
+        zero parse/plan work.  Unsupported shapes raise; the coordinator
+        degrades to SQL text (`XPlanTemplate.java:132` fallback)."""
+        f = header["fragment"]
+        with self._lock:
+            self.queries.append(f"PLAN:{f['schema']}.{f['table']}"
+                                f":{','.join(f['columns'])}")
+        inst = self.instance
+        tm = inst.catalog.table(f["schema"], f["table"])
+        store = inst.store(f["schema"], f["table"])
+        snapshot = inst.tso.next_timestamp()
+        # read-your-own-writes across the seam: a fragment carrying the
+        # session's branch xid sees that branch's provisional rows (the
+        # reference reads through the txn-bound DN connection)
+        txn_id = 0
+        bs = self._branches.get(f.get("xid")) if f.get("xid") else None
+        if bs is not None and bs.txn is not None:
+            txn_id = bs.txn.txn_id
+        point = f.get("point")
+        lane_point = None
+        if point is not None:
+            # the CN ships point keys ALREADY in lane domain (scan.point_eq is
+            # _lane_encode'd there); re-encoding would double-scale decimals
+            lane_point = point[1]
+        sargs = f.get("sargs") or []
+        since = f.get("since")  # delta reads (online table move catchup)
+        del_of = f.get("deleted_since_of")
+        cols_out: Dict[str, list] = {c: [] for c in f["columns"]}
+        valid_out: Dict[str, list] = {c: [] for c in f["columns"]}
+        deleted_keys: list = []
+        for p in store.partitions:
+            if p.num_rows == 0:
+                continue
+            with p.lock:
+                if lane_point is not None:
+                    ids = p.key_candidates(point[0], lane_point)
+                    if ids.size == 0:
+                        continue
+                    from galaxysql_tpu import native as _native
+                    # visibility over the CANDIDATE slice only — a full-lane
+                    # mask would cost O(partition) on the point hot path
+                    keep = p.valid[point[0]][ids] & _native.visible_mask(
+                        p.begin_ts[ids], p.end_ts[ids], snapshot, txn_id)
+                    ids = ids[keep]
+                else:
+                    vis = p.visible_mask(snapshot, txn_id)
+                    if since is not None:
+                        vis = vis & (p.begin_ts > int(since))
+                    for col, op, val in sargs:
+                        opf = self._SARG_OPS.get(op)
+                        if opf is None:
+                            return {"error": f"unsupported sarg op {op!r}"}, {}
+                        lane = p.lanes[col]
+                        # integer lanes compare in int64 — a float64 cast
+                        # collapses values beyond 2^53 and worker-side
+                        # exclusion is load-bearing (rows never reach the CN)
+                        if isinstance(val, int) and \
+                                np.issubdtype(lane.dtype, np.integer):
+                            vis = vis & p.valid[col] & \
+                                opf(lane.astype(np.int64), np.int64(val))
+                        else:
+                            vis = vis & p.valid[col] & \
+                                opf(lane.astype(np.float64), float(val))
+                    ids = np.nonzero(vis)[0]
+                if del_of is not None:
+                    dmask = (p.end_ts >= 0) & (p.end_ts > int(since or 0)) & \
+                        (p.end_ts <= snapshot)
+                    if dmask.any():
+                        deleted_keys.append(p.lanes[del_of][dmask])
+                if ids.size == 0:
+                    continue
+                for c in f["columns"]:
+                    cols_out[c].append(p.lanes[c][ids])
+                    valid_out[c].append(p.valid[c][ids])
+        arrays: Dict[str, np.ndarray] = {}
+        types = []
+        for c in f["columns"]:
+            lane = (np.concatenate(cols_out[c]) if cols_out[c]
+                    else np.zeros(0, dtype=tm.column(c).dtype.lane))
+            v = (np.concatenate(valid_out[c]) if valid_out[c]
+                 else np.zeros(0, dtype=np.bool_))
+            arr, tname = self._wire_lane(tm, c, lane)
+            arrays[f"d::{c}"] = arr
+            if lane.size and not bool(v.all()):
+                arrays[f"v::{c}"] = v
+            types.append(tname)
+        if del_of is not None:
+            dk = (np.concatenate(deleted_keys) if deleted_keys
+                  else np.zeros(0, dtype=np.int64))
+            # wire-value domain (decoded strings / formatted dates / scaled
+            # ints) so the caller's DELETE literals match what it inserted
+            arrays["deleted::keys"], _ = self._wire_lane(tm, del_of, dk)
+        n = int(arrays[f"d::{f['columns'][0]}"].shape[0]) if f["columns"] else 0
+        return ({"columns": list(f["columns"]), "types": types, "rows": n,
+                 "affected": 0, "snapshot": snapshot}, arrays)
 
     def _sync(self, header: dict):
         """Sync-action bus (SyncManagerHelper analog)."""

@@ -298,8 +298,11 @@ class MppExecutor:
             for e in inputs:
                 f = comp.compile(e)
                 d_ = _find_dictionary(e) if e.dtype.is_string else None
-                if d_ is not None and len(d_) and not d_.is_sorted:
-                    rank = d_.rank_array()
+                from galaxysql_tpu.types import collation as _coll
+                if d_ is not None and len(d_) and (
+                        not d_.is_sorted or
+                        _coll.collation_of_expr(e) is not None):
+                    rank = _coll.sort_rank_array(e, d_)
 
                     def ranked(env, _f=f, _r=rank):
                         dd, vv = _f(env)

@@ -991,7 +991,14 @@ class Binder:
                 e = self._bind_expr(oexpr, scope)
                 kid = self.fresh("sk")
                 extra.append((kid, e))
-                keys.append((ir.ColRef(kid, e.dtype, _find_dictionary(e)), desc))
+                ref = ir.ColRef(kid, e.dtype, _find_dictionary(e))
+                from galaxysql_tpu.types import collation as _coll
+                if _coll.collation_of_expr(e) is not None:
+                    # the hidden sort column holds fold-class representative
+                    # codes; the SORT must rank them under the collation, so
+                    # the collation tag rides the key reference
+                    ref.meta = e.meta
+                keys.append((ref, desc))
         node = L.Project(node, out_exprs + extra)
         node = L.Sort(node, keys, sel.limit and self._limit_value(sel.limit),
                       self._limit_value(sel.offset) if sel.offset else 0)

@@ -82,6 +82,11 @@ class Scan(RelNode):
         # for parquet min-max file pruning (SARG analog); the Filter above the
         # scan still applies, so sargs are never load-bearing for correctness
         self.sargs: List[Tuple[str, str, Any]] = []
+        # index access path (DirectShardingKeyTableOperation / XPlan key-Get
+        # analog, Planner.java:914): (table_column, lane_value) equality on an
+        # indexed column — the physical scan reads index candidates instead of
+        # full lanes.  Advisory like sargs: the Filter above re-verifies.
+        self.point_eq: Optional[Tuple[str, Any]] = None
 
     def fields(self) -> List[Field]:
         out = []

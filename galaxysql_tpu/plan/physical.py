@@ -42,6 +42,10 @@ class ExecContext:
         self.archive = archive        # ArchiveManager (cold parquet scans)
         self.archive_instance = archive_instance
         self.hints = hints or {}  # statement hints (sql/hints.py)
+        # open worker branches of the session's txn: addr -> xid.  Remote scans
+        # ship the xid so the worker reads through the branch (read-your-own-
+        # writes across the seam, like the reference's txn-bound DN connection)
+        self.remote_xids: Dict = {}
         self.sort_spill_bytes = 256 << 20   # SORT_SPILL_BYTES (session override)
         self.join_spill_bytes = 256 << 20   # JOIN_SPILL_BYTES
         self.collect_stats = False       # EXPLAIN ANALYZE per-operator stats
@@ -112,6 +116,9 @@ class ScanSource(ops.Operator):
             (f" as_of={as_of}" if as_of is not None else ""))
         yield from self._archive_batches(t, storage_cols, rename, snap)
         from galaxysql_tpu.exec.operators import bucket_capacity
+        if self.node.point_eq is not None:
+            yield from self._point_batches(t, store, snap, txn_id)
+            return
         cache = self.ctx.device_cache
         if cache is None:
             for b in store.scan(storage_cols, self.node.partitions,
@@ -173,6 +180,42 @@ class ScanSource(ops.Operator):
             yield ColumnBatch(cols, live)
 
 
+    def _point_batches(self, t, store, snap, txn_id) -> Iterator[ColumnBatch]:
+        """Index access path: candidate rows from the partition's sorted key
+        index instead of full lanes (XPlan key-Get / DirectShardingKey plan,
+        Planner.java:914).  The Filter above the scan re-verifies the whole
+        predicate, so candidates only need to be a superset of the matches
+        for the indexed column; MVCC visibility is applied here."""
+        from galaxysql_tpu import native
+        from galaxysql_tpu.exec.operators import bucket_capacity
+        col, val = self.node.point_eq
+        pids = (range(len(store.partitions)) if self.node.partitions is None
+                else self.node.partitions)
+        for pid in pids:
+            p = store.partitions[pid]
+            if p.num_rows == 0:
+                continue
+            with p.lock:
+                ids = p.key_candidates(col, val)
+                if ids.size == 0:
+                    continue
+                vis = native.visible_mask(p.begin_ts[ids], p.end_ts[ids],
+                                          snap, txn_id)
+                ids = ids[vis]
+                if ids.size == 0:
+                    continue
+                cols = {}
+                for oid, cname in self.node.columns:
+                    cm = t.column(cname)
+                    d = p.lanes[cname][ids]
+                    v = p.valid[cname][ids]
+                    cols[oid] = Column(d, None if bool(v.all()) else v,
+                                       cm.dtype,
+                                       t.dictionaries.get(cname.lower()))
+            self.ctx.trace.append(f"point-get {t.name} p{pid} rows={ids.size}")
+            yield ColumnBatch(cols, None).pad_to(
+                bucket_capacity(max(int(ids.size), 1)))
+
     def _remote_batches(self, t) -> Iterator[ColumnBatch]:
         """Plan shipping: the scan compiles to SQL executed by the worker
         process that owns the table (MyJdbcHandler.java:691 analog) — column
@@ -184,26 +227,75 @@ class ScanSource(ops.Operator):
         if inst is None:
             raise errors.TddlError(
                 f"remote table {t.name} needs an owning instance context")
-        addr = (t.remote["host"], t.remote["port"])
-        client = inst.workers.get(addr)
-        if client is None:
-            raise errors.TddlError(f"remote table {t.name}: no worker attached")
-        if inst.ha.worker_fenced(addr):
-            # fail fast on a fenced worker instead of hanging on a dead socket
-            raise errors.TddlError(
-                f"remote table {t.name}: worker {addr[0]}:{addr[1]} is fenced "
-                "(liveness probe failed)")
+        # weighted read routing over primary + replicas with fence-triggered
+        # failover (TGroupDataSource analog): a request failure fences the
+        # endpoint and retries another until none remain
+        last_err = None
+        for _attempt in range(1 + len(getattr(t, "replicas", []))):
+            addr, client = inst.read_endpoint(t)
+            try:
+                # materialize BEFORE yielding: a mid-stream failover retry must
+                # not re-emit rows already handed downstream
+                got = list(self._remote_batches_from(t, inst, addr, client))
+                yield from got
+                return
+            except (errors.TddlError, ConnectionError, OSError) as e:
+                last_err = e
+                if not client.ping():
+                    inst.ha.fence_worker(addr, True)
+                    continue  # endpoint alive but errored: a real error
+                raise
+        raise errors.TddlError(
+            f"remote table {t.name}: no serving endpoint ({last_err})")
+
+    def _remote_batches_from(self, t, inst, addr, client
+                             ) -> Iterator[ColumnBatch]:
+        from galaxysql_tpu.chunk.batch import column_from_pylist
+        from galaxysql_tpu.exec.operators import bucket_capacity
         storage_cols = [c for _, c in self.node.columns]
-        sql = (f"SELECT {', '.join(storage_cols)} FROM "
-               f"{t.schema}.{t.name}")
-        self.ctx.trace.append(f"remote-scan {t.name} -> {addr[0]}:{addr[1]}")
-        names, _types, data, valid = client.execute(sql, t.schema)
+        # ship the BOUND FRAGMENT first (XPlan analog): table + pruned columns
+        # + lane-domain SARGs + numeric point key; the worker executes it with
+        # no parse/plan work.  Any error degrades to SQL text, exactly the
+        # XPlanTemplate.java:86,132 fallback ladder.
+        def lane_safe(v):
+            return int(v) if float(v).is_integer() else float(v)
+        frag = {"schema": t.schema, "table": t.name, "columns": storage_cols,
+                "sargs": [[c, op, lane_safe(v)] for c, op, v in
+                          getattr(self.node, "sargs", [])]}
+        xid = self.ctx.remote_xids.get(addr)
+        if xid is not None:
+            frag["xid"] = xid  # read through the session's open worker branch
+        pe = self.node.point_eq
+        if pe is not None and not t.column(pe[0]).dtype.is_string and \
+                isinstance(pe[1], (int, np.integer)):
+            frag["point"] = [pe[0], int(pe[1])]
+        try:
+            names, rtypes, data, valid = client.exec_plan(frag)
+            self.ctx.trace.append(
+                f"remote-plan {t.name} -> {addr[0]}:{addr[1]}")
+        except errors.TddlError:
+            sql = (f"SELECT {', '.join(storage_cols)} FROM "
+                   f"{t.schema}.{t.name}")
+            self.ctx.trace.append(
+                f"remote-scan {t.name} -> {addr[0]}:{addr[1]}")
+            # the degrade path keeps the branch xid: txn visibility must not
+            # depend on which wire form served the scan
+            names, rtypes, data, valid = client.execute(sql, t.schema, xid=xid)
+        scaled = {nm for nm, ty in zip(names, rtypes)
+                  if isinstance(ty, str) and ty.endswith("#scaled")}
         n = len(next(iter(data.values()))) if data else 0
         cols = {}
         for oid, cname in self.node.columns:
             cm = t.column(cname)
             arr = data[cname]
             v = valid.get(cname)
+            if cname in scaled:
+                # worker shipped the DECIMAL lane as scaled int64 — adopt it
+                # directly (no float re-round; exact to the lane's 18 digits)
+                cols[oid] = Column(arr.astype(cm.dtype.lane),
+                                   None if v is None else v.astype(np.bool_),
+                                   cm.dtype, None)
+                continue
             vals = arr.tolist()
             if v is not None:
                 vals = [x if ok else None for x, ok in zip(vals, v.tolist())]
@@ -253,23 +345,32 @@ class ScanSource(ops.Operator):
             # lazy: a cache hit must not pay the O(table) host concatenation
             return cache.get_lane_built(store, -1, name, t.version, cap, build)
 
+        meta = _scan_meta(store, t.version)
         cols = {}
         for oid, cname in self.node.columns:
             cm = t.column(cname)
             data = fused(cname, [p.lanes[cname] for p in store.partitions])
             valid = None
-            if not all(bool(p.valid[cname].all()) for p in store.partitions):
+            v_all = meta["valid_all"].get(cname)
+            if v_all is None:
+                v_all = all(bool(p.valid[cname].all())
+                            for p in store.partitions)
+                meta["valid_all"][cname] = v_all
+            if not v_all:
                 valid = fused(f"valid::{cname}",
                               [p.valid[cname] for p in store.partitions], False)
             cols[oid] = Column(data, valid, cm.dtype,
                                t.dictionaries.get(cname.lower()))
-        all_current = all(bool((p.end_ts == np.iinfo(np.int64).max).all()) and
-                          bool((p.begin_ts >= 0).all()) and
-                          (ts is None or
-                           (p.num_rows and int(p.begin_ts.max()) <= ts) or
-                           p.num_rows == 0)
-                          for p in store.partitions)
-        pad_live = jnp.arange(cap) < total if cap != total else None
+        # O(table) host reductions cached per (store, version) — a warm scan
+        # must not re-reduce every timestamp lane per query
+        all_current = meta["all_current"] and \
+            (ts is None or meta["max_begin"] <= ts)
+        pad_live = None
+        if cap != total:
+            # the pad mask is version-static: cache it beside the lanes
+            pad_live = cache.get_lane_built(
+                store, -1, "::padlive", t.version, cap,
+                lambda: np.arange(cap) < total)
         if all_current:
             live = pad_live
         else:

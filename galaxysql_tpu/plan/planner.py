@@ -55,6 +55,11 @@ def scanned_rows_estimate(rel: L.RelNode) -> float:
     total = 0.0
     for n in L.walk(rel):
         if isinstance(n, L.Scan):
+            if n.point_eq is not None:
+                # index access path: the scan touches candidate rows, not the
+                # table (DirectShardingKeyTableOperation => TP classification)
+                total += 2.0
+                continue
             frac = 1.0
             if n.partitions is not None and n.table.partition.num_partitions > 0:
                 frac = len(n.partitions) / n.table.partition.num_partitions
@@ -164,12 +169,12 @@ class Planner:
         hinted = forced_orders is None and (bool(hints.get("join_order")) or
                                             hints.get("baseline_off"))
         if forced is None and spm_key is not None and not hinted:
-            forced = self.spm.choose(spm_key, self.catalog.version)
+            forced = self.spm.choose(spm_key, self.catalog.schema_version)
         spm_ctx = SpmContext(forced)
-        rel = optimize(rel, spm_ctx)
+        rel = optimize(rel, spm_ctx, catalog=self.catalog)
         if forced_orders is None and not hinted and spm_key is not None and \
                 spm_ctx.chosen:
-            self.spm.capture(spm_key, spm_ctx.chosen, self.catalog.version,
+            self.spm.capture(spm_key, spm_ctx.chosen, self.catalog.schema_version,
                              followed_baseline=forced is not None,
                              cost_preferred=spm_ctx.cost_preferred)
         plan = ExecutionPlan(rel, names, stmt, self.catalog.version, len(params))

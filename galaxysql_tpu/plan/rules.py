@@ -18,6 +18,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+import numpy as np
+
 from galaxysql_tpu.expr import ir
 from galaxysql_tpu.meta.catalog import PartitionRouter
 from galaxysql_tpu.plan import logical as L
@@ -509,6 +511,7 @@ def prune_partitions(node: L.RelNode) -> L.RelNode:
         return node
     scan = node.child
     _extract_sargs(node.cond, scan)
+    _choose_point_eq(node.cond, scan)
     info = scan.table.partition
     if info.method in ("single", "broadcast") or info.num_partitions <= 1:
         return node
@@ -516,12 +519,29 @@ def prune_partitions(node: L.RelNode) -> L.RelNode:
     id_to_col = {oid: col for oid, col in scan.columns}
     parts: Optional[Set[int]] = None
     for c in conjuncts(node.cond):
-        got = _prune_one(c, router, id_to_col)
+        got = _prune_one(c, router, id_to_col, scan.table)
         if got is not None:
             parts = set(got) if parts is None else (parts & set(got))
     if parts is not None:
         scan.partitions = sorted(parts)
     return node
+
+
+def _lane_encode(tm, col: str, value):
+    """Literal -> lane-domain value for routing (hash routing keys off LANE
+    values: dictionary codes for strings, scaled ints for decimals, day
+    numbers for dates).  Returns None when unencodable; a string absent from
+    the dictionary encodes to -1 (matches no stored row)."""
+    cm = tm.column(col)
+    if cm.dtype.is_string:
+        d = tm.dictionaries.get(col.lower())
+        return None if d is None else d.encode_one(str(value), add=False)
+    from galaxysql_tpu.expr.compiler import _encode_literal_value
+    try:
+        v = _encode_literal_value(value, cm.dtype)
+    except (TypeError, ValueError):
+        return None
+    return None if isinstance(v, str) else v
 
 
 def _extract_sargs(cond: ir.Expr, scan: L.Scan):
@@ -549,16 +569,118 @@ def _extract_sargs(cond: ir.Expr, scan: L.Scan):
         scan.sargs.append((cm.name, op, v))
 
 
-def _prune_one(c: ir.Expr, router: PartitionRouter, id_to_col) -> Optional[List[int]]:
+def _choose_point_eq(cond: ir.Expr, scan: L.Scan):
+    """Access-path choice: equality on an indexed column marks the scan for
+    index-candidate reads (DirectShardingKeyTableOperation / XPlan key-Get,
+    reference Planner.java:914, RelToXPlanConverter.java:41).
+
+    Candidate columns, best first: primary-key lead, partition-key lead (the
+    shard key — also how a routed GSI table is read), any PUBLIC local index
+    lead.  The value is stored in LANE domain; the physical scan serves
+    candidate rows through the partition's sorted key index and the Filter
+    above re-verifies, so this is advisory like sargs."""
+    tm = scan.table
+    id_to_col = {oid: col for oid, col in scan.columns}
+    eqs: Dict[str, ir.Literal] = {}
+    for c in conjuncts(cond):
+        if not (isinstance(c, ir.Call) and c.op == "eq" and len(c.args) == 2):
+            continue
+        cl = _col_lit_cmp(c)
+        if cl is None:
+            continue
+        col, lit, _ = cl
+        if col.name in id_to_col:
+            eqs[id_to_col[col.name].lower()] = lit
+    if not eqs:
+        return
+    cands: List[str] = []
+    if tm.primary_key:
+        cands.append(tm.primary_key[0])
+    if tm.partition.columns:
+        cands.append(tm.partition.columns[0])
+    for i in tm.indexes:
+        if i.status == "PUBLIC" and not i.global_index and i.columns:
+            cands.append(i.columns[0])
+    for cname in cands:
+        lit = eqs.get(cname.lower())
+        if lit is None:
+            continue
+        cm = tm.column(cname)
+        v = _lane_encode(tm, cm.name, lit.value)
+        if v is None:
+            continue
+        if cm.dtype.is_string:
+            v = np.int32(v)
+        scan.point_eq = (cm.name, v)
+        return
+
+
+def route_covering_gsi(node: L.RelNode, catalog) -> L.RelNode:
+    """Rewrite a filtered base-table scan onto a covering GSI backing table.
+
+    Reference analog: CBO index selection over global secondary indexes
+    (SURVEY.md App.D; `polardbx-optimizer/.../index`): when a predicate has an
+    equality on a PUBLIC GSI's leading column and the GSI's backing table
+    carries every referenced column (index + covering + PK), the scan reads
+    the GSI table instead — partition pruning then routes on the GSI's
+    partition key and the point-eq path serves it as an index lookup.  Skipped
+    when the predicate already pins the base table's own point key."""
+    node.children = [route_covering_gsi(c, catalog) for c in node.children]
+    if not isinstance(node, L.Filter) or not isinstance(node.child, L.Scan):
+        return node
+    scan = node.child
+    tm = scan.table
+    if getattr(tm, "remote", None) is not None or "$" in tm.name:
+        return node
+    id_to_col = {oid: col.lower() for oid, col in scan.columns}
+    eq_cols = set()
+    for c in conjuncts(node.cond):
+        if isinstance(c, ir.Call) and c.op == "eq" and len(c.args) == 2:
+            cl = _col_lit_cmp(c)
+            if cl is not None and cl[0].name in id_to_col:
+                eq_cols.add(id_to_col[cl[0].name])
+    if not eq_cols:
+        return node
+    if tm.primary_key and tm.primary_key[0].lower() in eq_cols:
+        return node  # base point read is already optimal
+    if tm.partition.columns and tm.partition.columns[0].lower() in eq_cols:
+        return node  # already routable to one shard of the base table
+    referenced = {col.lower() for _, col in scan.columns}
+    for i in tm.indexes:
+        if not (i.global_index and i.status == "PUBLIC" and i.columns):
+            continue
+        if i.columns[0].lower() not in eq_cols:
+            continue
+        try:
+            gtm = catalog.table(tm.schema, f"{tm.name}${i.name}")
+        except Exception:
+            continue
+        if not referenced <= {c.name.lower() for c in gtm.columns}:
+            continue  # not covering: would need a back-lookup join
+        scan.table = gtm
+        scan.partitions = None
+        scan.sargs = []
+        return node
+    return node
+
+
+def _prune_one(c: ir.Expr, router: PartitionRouter, id_to_col,
+               tm) -> Optional[List[int]]:
     if isinstance(c, ir.Call) and c.op == "eq":
         col, lit = _col_lit(c.args[0], c.args[1], id_to_col)
         if col is not None:
-            return router.prune_eq(col, lit)
+            v = _lane_encode(tm, col, lit)
+            if v is None:
+                return None
+            return router.prune_eq(col, v)
     if isinstance(c, ir.InList) and not c.negated:
         if isinstance(c.arg, ir.ColRef) and c.arg.name in id_to_col:
             out: List[int] = []
             for v in c.values:
-                got = router.prune_eq(id_to_col[c.arg.name], v)
+                lv = _lane_encode(tm, id_to_col[c.arg.name], v)
+                if lv is None:
+                    return None
+                got = router.prune_eq(id_to_col[c.arg.name], lv)
                 if got is None:
                     return None
                 out.extend(got)
@@ -574,17 +696,22 @@ def _col_lit(a: ir.Expr, b: ir.Expr, id_to_col):
     return None, None
 
 
-def optimize(node: L.RelNode, spm=None) -> L.RelNode:
+def optimize(node: L.RelNode, spm=None, catalog=None) -> L.RelNode:
     """The full RBO pipeline.
 
     push_filters runs BEFORE join-tree construction: subquery unnesting wraps the
     cross-join forest in semi/anti joins, and the WHERE conjuncts above them must reach
     the forest first or the forest would be ordered without its predicates.
 
-    `spm` (SpmContext) pins/reports join orders — see build_join_tree."""
+    `spm` (SpmContext) pins/reports join orders — see build_join_tree.
+    `catalog` (when given) enables GSI access-path routing."""
     node = push_filters(node)
     node = build_join_tree(node, spm)
     node = push_filters(node)
-    node = prune_partitions(node)
     node = prune_columns(node)
+    if catalog is not None:
+        # after column pruning: covering is judged on the columns actually
+        # referenced, not the table's full column list
+        node = route_covering_gsi(node, catalog)
+    node = prune_partitions(node)
     return node

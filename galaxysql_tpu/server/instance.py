@@ -20,6 +20,7 @@ from galaxysql_tpu.meta.gms import ConfigListener, MetaDb
 from galaxysql_tpu.meta.tso import TimestampOracle
 from galaxysql_tpu.plan.planner import Planner
 from galaxysql_tpu.storage.table_store import TableStore
+from galaxysql_tpu.utils import errors
 
 
 class Instance:
@@ -63,6 +64,9 @@ class Instance:
         self.ha = HaManager(self)
         import collections
         self.counters = collections.Counter()  # engine_counters virtual table
+        # (schema, parameterized-sql) -> PointPlan: binder-free execution of
+        # archetypal point SELECTs (DirectShardingKeyTableOperation analog)
+        self.point_plans: Dict[tuple, object] = {}
         self.lock = threading.RLock()
         self.next_conn_id = 1
         self.sessions: Dict[int, object] = {}
@@ -165,9 +169,185 @@ class Instance:
                        SINGLE)
         tm.remote = {"host": host, "port": port}
         self.catalog.create_schema(schema, if_not_exists=True)
-        self.catalog.add_table(tm, if_not_exists=True)
-        self.catalog.version += 1
+        if not self.catalog.add_table(tm, if_not_exists=True):
+            # re-attach (worker restarted on a new port): repoint the existing
+            # meta so in-flight plans route to the live endpoint
+            tm = self.catalog.table(schema, name)
+            tm.remote = {"host": host, "port": port}
         return tm
+
+    def attach_replica(self, schema: str, name: str, host: str, port: int,
+                       weight: int = 1):
+        """Register a read replica for a remote table (read-write splitting,
+        `TGroupDataSource` weighted-random analog).  Writes go to every live
+        endpoint as branches of the same distributed txn (synchronous
+        replication); reads pick a weighted-random unfenced endpoint."""
+        from galaxysql_tpu.net.dn import WorkerClient
+        key = (host, port)
+        if key not in self.workers:
+            client = WorkerClient(host, port)
+            self.workers[key] = client
+            self.sync_bus.attach(client)
+        tm = self.catalog.table(schema, name)
+        if getattr(tm, "remote", None) is None:
+            raise ValueError(f"{schema}.{name} is not a remote table")
+        for r in tm.replicas:
+            if (r["host"], r["port"]) == key:
+                r["weight"] = weight
+                r["stale"] = False
+                return tm
+        tm.replicas.append({"host": host, "port": port, "weight": weight,
+                            "stale": False})
+        return tm
+
+    @staticmethod
+    def _sql_literal(typ: str, v, valid: bool) -> str:
+        if not valid:
+            return "NULL"
+        if typ.endswith("#scaled"):
+            import re as _re
+            m = _re.search(r"DECIMAL\(\d+,\s*(\d+)\)", typ)
+            scale = int(m.group(1)) if m else 0
+            s = str(int(v))
+            neg = s.startswith("-")
+            s = s.lstrip("-").rjust(scale + 1, "0")
+            val = (s[:-scale] + "." + s[-scale:]) if scale else s
+            return ("-" if neg else "") + val
+        if isinstance(v, (int, float)):
+            return repr(v)
+        return "'" + str(v).replace("\\", "\\\\").replace("'", "''") + "'"
+
+    def _bulk_insert_remote(self, client, schema, table, names, types,
+                            data, valid, batch: int = 1000):
+        n = len(next(iter(data.values()))) if data else 0
+        for off in range(0, n, batch):
+            hi = min(off + batch, n)
+            rows = []
+            for i in range(off, hi):
+                vals = []
+                for c, ty in zip(names, types):
+                    ok_ = bool(valid[c][i]) if c in valid else True
+                    vals.append(self._sql_literal(ty, data[c][i], ok_))
+                rows.append("(" + ", ".join(vals) + ")")
+            client.execute(f"INSERT INTO {table} ({', '.join(names)}) "
+                           f"VALUES {', '.join(rows)}", schema)
+
+    def move_remote_table(self, schema: str, name: str, host: str, port: int):
+        """Relocate a worker-resident table to another worker online.
+
+        Reference analog: `executor/balancer/Balancer.java` data movement +
+        the repartition backfill/catchup/cutover shape (ddl/repartition.py):
+
+        1. snapshot backfill under SHARED MDL (writes keep flowing to the
+           source),
+        2. delta catchup + cutover under EXCLUSIVE MDL: rows inserted/deleted
+           since the snapshot are replayed onto the target, then the table's
+           primary endpoint swaps."""
+        from galaxysql_tpu.net.dn import WorkerClient
+        tm = self.catalog.table(schema, name)
+        if getattr(tm, "remote", None) is None:
+            raise ValueError(f"{schema}.{name} is not a remote table")
+        src = self.workers[(tm.remote["host"], tm.remote["port"])]
+        key = (host, port)
+        dst = self.workers.get(key)
+        if dst is None:
+            dst = WorkerClient(host, port)
+            self.workers[key] = dst
+            self.sync_bus.attach(dst)
+        # target bootstrap: schema + table shape from this CN's meta
+        cols_sql = ", ".join(
+            f"{c.name} {c.dtype.sql_name()}" + ("" if c.nullable else " NOT NULL")
+            for c in tm.columns)
+        pk_sql = (f", PRIMARY KEY ({', '.join(tm.primary_key)})"
+                  if tm.primary_key else "")
+        dst.execute(f"CREATE DATABASE IF NOT EXISTS {schema}", "")
+        dst.execute(f"CREATE TABLE IF NOT EXISTS {name} ({cols_sql}{pk_sql})",
+                    schema)
+        cols = tm.column_names()
+        mdl_key = f"{schema.lower()}.{name.lower()}"
+        pk = tm.primary_key[0] if tm.primary_key else cols[0]
+        # phase 1: snapshot backfill (shared MDL: concurrent writes continue)
+        with self.mdl.shared({mdl_key}):
+            s0 = self.tso.next_timestamp()
+            names, types, data, valid = src.exec_plan(
+                {"schema": schema, "table": name, "columns": cols})
+            self._bulk_insert_remote(dst, schema, name, names, types, data,
+                                     valid)
+        # phase 2: delta catchup + cutover (exclusive MDL: writes drained)
+        with self.mdl.exclusive(mdl_key):
+            # drain OPEN txns holding branches on the source worker: their
+            # commits bypass MDL (statement-scoped) and would land on the old
+            # primary after cutover — a silently lost write.  New DML is
+            # blocked on our exclusive MDL, so waiting converges.
+            import time as _time
+            src_addr = (src.addr[0], src.addr[1])
+            deadline = _time.time() + 30.0
+            def _pinned():
+                for sess in list(self.sessions.values()):
+                    txn = getattr(sess, "txn", None)
+                    if txn is not None and src_addr in getattr(txn, "remote", {}):
+                        return True
+                with self.xa_coordinator._lock:
+                    for parts in self.xa_coordinator._in_doubt.values():
+                        for sp in parts:
+                            if getattr(sp, "addr", None) == src_addr:
+                                return True
+                return False
+            while _pinned():
+                if _time.time() > deadline:
+                    raise errors.TddlError(
+                        f"move {schema}.{name}: open transactions pin the "
+                        f"source worker {src_addr}; retry later")
+                _time.sleep(0.05)
+            resp, arrs = src.request(
+                {"op": "exec_plan",
+                 "fragment": {"schema": schema, "table": name,
+                              "columns": cols, "since": s0,
+                              "deleted_since_of": pk}})
+            ddata = {c: arrs[f"d::{c}"] for c in cols}
+            dvalid = {c: arrs[f"v::{c}"] for c in cols if f"v::{c}" in arrs}
+            gone = arrs.get("deleted::keys")
+            new_keys = list(ddata[pk].tolist()) if cols else []
+            drop = set(new_keys) | set(gone.tolist() if gone is not None else [])
+            if drop:
+                # literal rendering follows the PK's wire type (scaled
+                # decimals, quoted strings/dates) — the same encoding the
+                # backfill INSERTs used, so the DELETE actually matches
+                pk_type = dict(zip(resp["columns"], resp["types"]))[pk]
+                in_list = ", ".join(self._sql_literal(pk_type, k, True)
+                                    for k in drop)
+                dst.execute(f"DELETE FROM {name} WHERE {pk} IN ({in_list})",
+                            schema)
+            self._bulk_insert_remote(dst, schema, name, resp["columns"],
+                                     resp["types"], ddata, dvalid)
+            tm.remote = {"host": host, "port": port}
+            self.catalog.bump_schema()
+        self.counters["table_moves"] += 1
+        return tm
+
+    def read_endpoint(self, tm):
+        """Pick the endpoint to serve a read of `tm`: weighted random over the
+        primary + non-stale replicas, skipping fenced workers.  Returns
+        (addr, client) or raises if every endpoint is down."""
+        import random
+        from galaxysql_tpu.utils import errors as _errors
+        cands = [((tm.remote["host"], tm.remote["port"]),
+                  tm.remote.get("weight", 1))]
+        for r in tm.replicas:
+            if not r.get("stale"):
+                cands.append(((r["host"], r["port"]), r.get("weight", 1)))
+        live = [(a, w) for a, w in cands
+                if a in self.workers and not self.ha.worker_fenced(a)]
+        if not live:
+            raise _errors.TddlError(
+                f"remote table {tm.name}: every endpoint is fenced/unattached")
+        total = sum(w for _, w in live)
+        pick = random.random() * total
+        for a, w in live:
+            pick -= w
+            if pick <= 0:
+                return a, self.workers[a]
+        return live[-1][0], self.workers[live[-1][0]]
 
     def mesh(self):
         """The instance's device mesh for MPP execution (None on a single device)."""

@@ -40,6 +40,10 @@ class ResultSet:
     affected: int = 0
     last_insert_id: int = 0
     info: str = ""
+    # compacted result ColumnBatch (queries only): lane-exact values for callers
+    # that re-encode columns — the worker wire plane ships DECIMAL lanes from
+    # here instead of the float round-trip of the Python rows
+    batch: Any = None
 
     @property
     def is_query(self) -> bool:
@@ -81,6 +85,9 @@ class Transaction:
         self.inserted: List[Tuple[Any, int, int, int]] = []
         # (store, pid, row_ids, old_end_ts) provisional deletes
         self.deleted: List[Tuple[Any, int, np.ndarray, np.ndarray]] = []
+        # worker branches of this txn: (host, port) -> xid (TsoTransaction's
+        # per-shard XA branches; committed via the 2PC coordinator)
+        self.remote: Dict[Tuple[str, int], str] = {}
 
     def touched_tables(self):
         seen = {}
@@ -135,7 +142,16 @@ class Session:
 
     # -- dispatch ----------------------------------------------------------------
 
+    _SELECT_RE = __import__("re").compile(
+        r"^\s*(?:/\*.*?\*/\s*)*select\b", __import__("re").I | __import__("re").S)
+
     def _execute_one(self, sql: str, params: Optional[list]) -> ResultSet:
+        if self._SELECT_RE.match(sql):
+            # SELECT hot path: the plan cache keys on the PARAMETERIZED text and
+            # carries the AST, so re-parsing the raw text (distinct per literal,
+            # ~1ms) per execution is pure waste; authorization runs against the
+            # plan's AST in _run_query_admitted (TP latency floor, SURVEY §3.2)
+            return self._run_query(None, sql, params)
         stmt = parse(sql)
         return self.execute_statement(stmt, sql, params)
 
@@ -195,6 +211,9 @@ class Session:
     def execute_statement(self, stmt: ast.Statement, sql: str = "",
                           params: Optional[list] = None) -> ResultSet:
         self._authorize(stmt)
+        # kept for remote-DML shipping (the worker re-plans the statement text)
+        self._current_sql = sql
+        self._current_params = params
         if isinstance(stmt, (ast.Select, ast.SetOpSelect)):
             return self._run_query(stmt, sql, params)
         if isinstance(stmt, (ast.Insert, ast.Update, ast.Delete)):
@@ -344,7 +363,10 @@ class Session:
             fh = open(stmt.path, newline="")
         except OSError as e:
             raise errors.TddlError(f"Can't read file '{stmt.path}' ({e.strerror})")
-        with fh as f:
+        # statement-scope shared MDL like every other DML path: a concurrent
+        # ADD/DROP COLUMN swapping partition lanes mid-load is a torn write
+        with fh as f, self.instance.mdl.shared(
+                {f"{tm.schema.lower()}.{tm.name.lower()}"}):
             reader = csv.reader(f, delimiter=delim, quotechar=quote)
             rows: List[List[Any]] = []
             for i, row in enumerate(reader):
@@ -471,10 +493,18 @@ class Session:
 
     def _run_query_admitted(self, stmt, sql, params, schema, t0) -> ResultSet:
         if sql:
+            if self.instance.point_plans:
+                rs = self._try_point_exec(sql, params, schema, t0)
+                if rs is not None:
+                    return rs
             plan = self.instance.planner.plan_select(sql, schema, params, self)
         else:
             plan = self.instance.planner.bind_statement(stmt, schema, params or [],
                                                         self)
+        if stmt is None:
+            # SELECT hot path skipped the raw parse; authorize on the plan's
+            # (parameterized) AST — same table names, no second parse
+            self._authorize(plan.statement)
         cache = None
         if plan.workload == "AP" and self.instance.config.get("ENABLE_TPU_ENGINE",
                                                               self.vars):
@@ -490,11 +520,151 @@ class Session:
                                                         self.vars)
         ctx.join_spill_bytes = self.instance.config.get("JOIN_SPILL_BYTES",
                                                         self.vars)
+        if self.txn is not None and self.txn.remote:
+            ctx.remote_xids = dict(self.txn.remote)
         from galaxysql_tpu.plan import logical as L
         mdl_keys = {f"{n.table.schema.lower()}.{n.table.name.lower()}"
                     for n in L.walk(plan.rel) if isinstance(n, L.Scan)}
         with self.instance.mdl.shared(mdl_keys):
             return self._run_query_locked(plan, ctx, sql, t0)
+
+    # -- point-plan fast path (DirectShardingKeyTableOperation / XPlan key-Get
+    # analog, Planner.java:914): archetypal `SELECT cols FROM t WHERE pk = ?`
+    # statements skip binder+optimizer entirely on re-execution — the cached
+    # PointPlan routes to the owning partition and reads index candidates.
+
+    def _register_point_plan(self, plan, batch):
+        from galaxysql_tpu.expr import ir as _ir
+        from galaxysql_tpu.plan import logical as L
+        from galaxysql_tpu.plan.rules import _col_lit_cmp
+        if plan.spm_key is None or plan.param_count != 1 or \
+                getattr(plan, "hints", None):
+            return
+        rel = plan.rel
+        proj = rel if isinstance(rel, L.Project) else None
+        inner = proj.child if proj is not None else rel
+        if not (isinstance(inner, L.Filter) and isinstance(inner.child, L.Scan)):
+            return
+        scan = inner.child
+        if scan.point_eq is None or scan.as_of is not None or \
+                getattr(scan.table, "remote", None) is not None:
+            return
+        cond = inner.cond
+        if not (isinstance(cond, _ir.Call) and cond.op == "eq"):
+            return
+        cl = _col_lit_cmp(cond)
+        if cl is None:
+            return
+        col, lit, _flip = cl
+        id_to_col = {oid: c for oid, c in scan.columns}
+        if id_to_col.get(col.name, "").lower() != scan.point_eq[0].lower():
+            return
+        bound = getattr(plan, "bound_params", None)
+        from galaxysql_tpu.sql.parameterize import DecimalParam
+        b0 = bound[0] if bound else None
+        if isinstance(b0, DecimalParam):
+            b0 = b0.value
+        if not bound or lit.value != b0:
+            return  # the one param must BE the point key value
+        out = []
+        if proj is not None:
+            for name, e in proj.exprs:
+                if not isinstance(e, _ir.ColRef) or e.name not in id_to_col:
+                    return
+                out.append(id_to_col[e.name])
+        else:
+            out = [c for _, c in scan.columns]
+        tm = scan.table
+        fields = plan.fields()
+        pp = {
+            "schema": tm.schema, "table": tm.name,
+            "key_col": scan.point_eq[0], "out_cols": out,
+            "names": list(plan.display_names),
+            "types": [t for _, t, _ in fields],
+            "schema_version": self.instance.catalog.schema_version,
+        }
+        if len(self.instance.point_plans) > 512:
+            self.instance.point_plans.clear()
+        self.instance.point_plans[plan.spm_key] = pp
+
+    def _try_point_exec(self, sql, params, schema, t0):
+        from galaxysql_tpu.sql.parameterize import parameterize, DecimalParam
+        p = parameterize(sql)
+        pp = self.instance.point_plans.get((schema.lower(), p.cache_key))
+        if pp is None:
+            return None
+        if pp["schema_version"] != self.instance.catalog.schema_version:
+            self.instance.point_plans.pop((schema.lower(), p.cache_key), None)
+            return None
+        vals = p.resolve(params or [])
+        if len(vals) != 1:
+            return None
+        # same privilege gate the planned path applies to its statement AST
+        self.instance.privileges.check(self.user, "SELECT",
+                                       pp["schema"], pp["table"])
+        value = vals[0]
+        if isinstance(value, DecimalParam):
+            value = value.value
+        try:
+            tm = self.instance.catalog.table(pp["schema"], pp["table"])
+            store = self.instance.store(pp["schema"], pp["table"])
+        except Exception:
+            return None
+        inst_key = f"{tm.schema.lower()}.{tm.name.lower()}"
+        if self.instance.archive.files_for(inst_key, None):
+            return None  # cold rows live outside the index: full path
+        key_col = pp["key_col"]
+        if value is None:
+            rows = []  # eq NULL matches nothing
+        else:
+            from galaxysql_tpu.plan.rules import _lane_encode
+            lane_val = _lane_encode(tm, key_col, value)
+            if lane_val is None:
+                return None
+            from galaxysql_tpu.meta.catalog import PartitionRouter
+            # route in LANE domain: hash routing on insert keys off the lane
+            # values (dictionary codes for strings, scaled ints for decimals).
+            # int() matches route_rows' astype(int64) truncation of float
+            # lanes, so a float key routes to the same shard it was written to
+            pids = PartitionRouter(tm).prune_eq(key_col, int(lane_val))
+            if pids is None:
+                pids = range(len(store.partitions))
+            snap = self._snapshot_ts()
+            txn_id = self.txn.txn_id if self.txn is not None else 0
+            from galaxysql_tpu import native
+            rows = []
+            with self.instance.mdl.shared({inst_key}):
+                for pid in pids:
+                    part = store.partitions[pid]
+                    if part.num_rows == 0:
+                        continue
+                    with part.lock:
+                        ids = part.key_candidates(key_col, lane_val)
+                        if ids.size == 0:
+                            continue
+                        keep = part.valid[key_col][ids] & native.visible_mask(
+                            part.begin_ts[ids], part.end_ts[ids], snap, txn_id)
+                        ids = ids[keep]
+                        if ids.size == 0:
+                            continue
+                        from galaxysql_tpu.chunk.batch import Column
+                        out_cols = []
+                        for cname, typ in zip(pp["out_cols"], pp["types"]):
+                            c = Column(part.lanes[cname][ids],
+                                       part.valid[cname][ids],
+                                       tm.column(cname).dtype,
+                                       tm.dictionaries.get(cname.lower()))
+                            out_cols.append(c.to_pylist())
+                    rows.extend(zip(*out_cols))
+        elapsed = time.time() - t0
+        self.last_trace = [f"point-plan {pp['table']}.{key_col}",
+                           f"elapsed={elapsed:.3f}s workload=TP"]
+        slow_ms = self.instance.config.get("SLOW_SQL_MS", self.vars)
+        if slow_ms is not None and slow_ms >= 0 and elapsed * 1000 >= slow_ms:
+            from galaxysql_tpu.utils.tracing import SLOW_LOG
+            SLOW_LOG.record(sql or "<stmt>", elapsed, self.conn_id)
+        self.instance.counters["point_plan_queries"] += 1
+        return ResultSet(pp["names"], pp["types"], rows)
 
     def _run_query_locked(self, plan, ctx, sql, t0) -> ResultSet:
         batch = None
@@ -528,8 +698,11 @@ class Session:
                 if (plan.workload == "TP" or engine_hint == "TP") else _NULL_CTX
             with device_ctx:
                 batch = run_to_batch(op)
+        batch = batch.compact()
         rows = batch.to_pylist()
         fields = plan.fields()
+        if plan.workload == "TP":
+            self._register_point_plan(plan, batch)
         elapsed = time.time() - t0
         if getattr(plan, "spm_key", None) is not None:
             self.instance.planner.spm.record_execution(
@@ -542,7 +715,8 @@ class Session:
         if slow_ms is not None and slow_ms >= 0 and elapsed * 1000 >= slow_ms:
             from galaxysql_tpu.utils.tracing import SLOW_LOG
             SLOW_LOG.record(sql or "<stmt>", elapsed, self.conn_id)
-        return ResultSet(plan.display_names, [t for _, t, _ in fields], rows)
+        return ResultSet(plan.display_names, [t for _, t, _ in fields], rows,
+                         batch=batch)
 
     # -- DML -------------------------------------------------------------------------
 
@@ -556,12 +730,24 @@ class Session:
         if txn is None:
             return
         policy = str(self.instance.config.get("TRANSACTION_POLICY", self.vars))
-        if policy.upper() == "XA":
-            # two-phase commit across the touched stores, with a logged commit
-            # point and recovery (TsoTransaction 2PC analog, SURVEY.md §3.4)
+        if policy.upper() == "XA" or txn.remote:
+            # two-phase commit across the touched stores (+ worker branches),
+            # with a logged commit point and recovery (TsoTransaction 2PC
+            # analog, SURVEY.md §3.4) — a txn spanning a worker ALWAYS takes
+            # this path regardless of policy: its branches need the protocol
             from galaxysql_tpu.txn.xa import TwoPhaseCoordinator
             coord = self.instance.xa_coordinator
-            cts = coord.commit(txn)
+            try:
+                cts = coord.commit(txn)
+            except errors.TransactionError as e:
+                cts = getattr(e, "commit_ts", None)
+                if cts is not None:
+                    # committed with in-doubt branches: the outcome is decided,
+                    # so the binlog must still record it at the commit ts
+                    self.instance.cdc.flush_txn(txn, cts)
+                    if txn.inserted or txn.deleted:
+                        self.instance.catalog.version += 1
+                raise
             self.instance.cdc.flush_txn(txn, cts)
             if txn.inserted or txn.deleted:
                 self.instance.catalog.version += 1
@@ -591,9 +777,11 @@ class Session:
         # undo via the XA participant helper: stamps own appended rows permanently
         # dead and restores provisional delete stamps — lanes never shrink (see
         # StoreParticipant.rollback for the concurrent-writer invariant)
-        from galaxysql_tpu.txn.xa import participants_of
+        from galaxysql_tpu.txn.xa import participants_of, remote_participants_of
         for sp in participants_of(txn):
             sp.rollback()
+        for rp in remote_participants_of(self.instance, txn):
+            rp.rollback()
 
     def _dml_ts(self) -> Tuple[int, Optional[Transaction]]:
         """Timestamp to stamp writes with: provisional (-txn_id) inside a transaction,
@@ -606,7 +794,9 @@ class Session:
         schema = self._require_schema()
         tname = stmt.table.table
         tm = self.instance.catalog.table(stmt.table.schema or schema, tname)
-        self._reject_remote_dml(tm)
+        rrs = self._remote_dml(tm)
+        if rrs is not None:
+            return rrs
         store = self.instance.store(tm.schema, tm.name)
         ts, txn = self._dml_ts()
 
@@ -645,12 +835,69 @@ class Session:
         self.instance.catalog.version += 1
         return ok(affected=n)
 
-    @staticmethod
-    def _reject_remote_dml(tm):
-        if getattr(tm, "remote", None) is not None:
-            raise errors.NotSupportedError(
-                f"table {tm.name} lives on a worker process; DML must run "
-                "there (read-only from this CN)")
+    def _remote_dml(self, tm) -> Optional[ResultSet]:
+        """DML on a worker-resident table: ship the statement to the owning
+        worker inside a distributed-txn branch (MyJdbcHandler.java:136 physical
+        DML execution; the branch is committed by the XA coordinator with the
+        local stores as co-participants)."""
+        if getattr(tm, "remote", None) is None:
+            return None
+        primary = (tm.remote["host"], tm.remote["port"])
+        if self.instance.workers.get(primary) is None:
+            raise errors.TddlError(
+                f"remote table {tm.name}: no worker attached")
+        if self.instance.ha.worker_fenced(primary):
+            raise errors.TddlError(
+                f"remote table {tm.name}: worker {primary[0]}:{primary[1]} "
+                "is fenced")
+        # synchronous replication: the statement ships to the primary AND every
+        # live replica as branches of the same distributed txn; a fenced
+        # replica is marked stale and excluded from read routing until rebuilt
+        endpoints = [primary]
+        for r in tm.replicas:
+            a = (r["host"], r["port"])
+            if r.get("stale") or a not in self.instance.workers:
+                continue
+            if self.instance.ha.worker_fenced(a):
+                r["stale"] = True
+                continue
+            endpoints.append(a)
+        auto = self.txn is None
+        self._begin()
+        affected = 0
+        for addr in endpoints:
+            xid = self.txn.remote.setdefault(addr, f"g{self.txn.txn_id}")
+            try:
+                resp, _ = self.instance.workers[addr].request({
+                    "op": "dml", "xid": xid, "schema": tm.schema,
+                    "sql": self._current_sql,
+                    "params": list(self._current_params or [])})
+                err = resp.get("error")
+            except (errors.TddlError, ConnectionError, OSError) as e:
+                err = str(e)
+            if err:
+                if addr != primary:
+                    # a failed REPLICA write must not diverge silently: drop
+                    # its branch, mark it stale (excluded from reads until
+                    # rebuilt), and let the statement succeed on the primary
+                    for r in tm.replicas:
+                        if (r["host"], r["port"]) == addr:
+                            r["stale"] = True
+                    self.txn.remote.pop(addr, None)
+                    try:
+                        self.instance.workers[addr].request(
+                            {"op": "xa_rollback", "xid": xid})
+                    except Exception:
+                        pass
+                    continue
+                if auto:
+                    self._rollback()
+                raise errors.TddlError(f"worker DML failed: {err}")
+            if addr == primary:
+                affected = int(resp.get("affected", 0))
+        if auto:
+            self._commit()
+        return ok(affected=affected)
 
     def _dml_match(self, tm: TableMeta, where: Optional[ast.ExprNode],
                    params: Optional[list], alias: str):
@@ -712,7 +959,9 @@ class Session:
     def _run_delete(self, stmt: ast.Delete, params: Optional[list]) -> ResultSet:
         schema = self._require_schema()
         tm = self.instance.catalog.table(stmt.table.schema or schema, stmt.table.table)
-        self._reject_remote_dml(tm)
+        rrs = self._remote_dml(tm)
+        if rrs is not None:
+            return rrs
         ts, txn = self._dml_ts()
         alias = (stmt.table.alias or stmt.table.table).lower()
         n = 0
@@ -740,7 +989,9 @@ class Session:
         if not isinstance(stmt.table, ast.TableName):
             raise errors.NotSupportedError("multi-table UPDATE")
         tm = self.instance.catalog.table(stmt.table.schema or schema, stmt.table.table)
-        self._reject_remote_dml(tm)
+        rrs = self._remote_dml(tm)
+        if rrs is not None:
+            return rrs
         ts, txn = self._dml_ts()
         alias = (stmt.table.alias or stmt.table.table).lower()
         binder = Binder(self.instance.catalog, schema, params or [])
@@ -971,8 +1222,14 @@ class Session:
                               archive_instance=self.instance)
             ctx.collect_stats = True  # per-operator rows/time (RuntimeStatistics)
             op = build_operator(plan.rel, ctx)
+            from galaxysql_tpu.plan import logical as L
+            mdl_keys = {f"{n.table.schema.lower()}.{n.table.name.lower()}"
+                        for n in L.walk(plan.rel) if isinstance(n, L.Scan)}
             t0 = time.time()
-            batch = run_to_batch(op)
+            # statement-scope shared MDL: concurrent column DDL must not swap
+            # partition lanes mid-execution (same torn-read class as SELECT)
+            with self.instance.mdl.shared(mdl_keys):
+                batch = run_to_batch(op)
             elapsed = time.time() - t0
             lines += [f"-- rows: {batch.num_live()}", f"-- elapsed: {elapsed:.3f}s"] + \
                 [f"-- {t}" for t in ctx.trace]

@@ -44,6 +44,45 @@ class Partition:
         self.begin_ts = np.zeros(0, dtype=np.int64)
         self.end_ts = np.zeros(0, dtype=np.int64)
         self.lock = threading.RLock()
+        # append-aware sorted key indexes: col -> (lane_gen, n0, perm, sorted_keys)
+        # where perm sorts rows [0, n0).  Appends don't invalidate (MVCC rows are
+        # immutable; the [n0, n) tail is probed linearly until it outgrows
+        # _INDEX_TAIL); wholesale lane replacement (column DDL, load) bumps
+        # lane_gen and forces a rebuild.
+        self._key_indexes: Dict[str, Tuple[int, int, np.ndarray, np.ndarray]] = {}
+        self.lane_gen = 0
+
+    _INDEX_TAIL = 8192
+
+    def invalidate_indexes(self):
+        """Call after replacing lane arrays in place (column DDL, reload)."""
+        self.lane_gen += 1
+        self._key_indexes.clear()
+
+    def key_candidates(self, col: str, lane_value) -> np.ndarray:
+        """Row ids whose `col` lane equals the (lane-encoded) value.
+
+        MVCC-unaware: returns every physical row version with that key; the
+        caller applies visibility.  O(log n) over the indexed prefix plus a
+        linear probe of the unsorted appended tail (XPlan key-Get analog,
+        RelToXPlanConverter.java:41)."""
+        with self.lock:
+            n = self.num_rows
+            lane = self.lanes[col]
+            entry = self._key_indexes.get(col)
+            if entry is None or entry[0] != self.lane_gen or \
+                    n - entry[1] > self._INDEX_TAIL:
+                perm = np.argsort(lane[:n], kind="stable")
+                entry = (self.lane_gen, n, perm, lane[:n][perm])
+                self._key_indexes[col] = entry
+            _gen, n0, perm, skeys = entry
+            lo = np.searchsorted(skeys, lane_value, side="left")
+            hi = np.searchsorted(skeys, lane_value, side="right")
+            ids = perm[lo:hi]
+            if n > n0:
+                tail = np.nonzero(lane[n0:n] == lane_value)[0] + n0
+                ids = np.concatenate([ids, tail]) if tail.size else ids
+            return ids
 
     @property
     def num_rows(self) -> int:
@@ -266,4 +305,5 @@ class TableStore:
             for c in self.table.columns:
                 p.lanes[c.name] = z[f"lane__{c.name}"]
                 p.valid[c.name] = z[f"valid__{c.name}"]
+            p.invalidate_indexes()
         self.table.stats.row_count = self.row_count()

@@ -114,6 +114,15 @@ class CdcManager:
             "FROM binlog_events WHERE commit_ts > ? ORDER BY seq LIMIT ?",
             (since_ts, limit))
 
+    def events_after_seq(self, seq: int = 0, limit: int = 10000) -> List[Tuple]:
+        """Stream pagination by SEQ: commit_ts-keyed resume would skip the
+        remainder of a commit whose events straddle a page boundary (one big
+        txn shares one commit_ts across all its events)."""
+        return self.instance.metadb.query(
+            "SELECT seq, commit_ts, schema_name, table_name, kind, payload "
+            "FROM binlog_events WHERE seq > ? ORDER BY seq LIMIT ?",
+            (seq, limit))
+
     def purge(self, before_ts: int):
         self.instance.metadb.execute(
             "DELETE FROM binlog_events WHERE commit_ts < ?", (before_ts,))

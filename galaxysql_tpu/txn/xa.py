@@ -87,6 +87,58 @@ class StoreParticipant:
         self.store.table.bump_version()
 
 
+class RemoteBranchParticipant:
+    """A worker process's branch of a distributed transaction.
+
+    Reference analog: the per-shard XA branch of `TsoTransaction` — each DN
+    connection PREPAREs/COMMITs its branch (`TsoTransaction.java:166-216`);
+    here the branch lives in a worker's engine and is driven over the RPC
+    plane (ops dml / xa_prepare / xa_commit / xa_rollback)."""
+
+    def __init__(self, instance, addr, xid: str):
+        self.instance = instance
+        self.addr = addr
+        self.xid = xid
+
+    def _client(self):
+        return self.instance.workers.get(self.addr)
+
+    def prepare(self) -> bool:
+        c = self._client()
+        if c is None:
+            return False
+        try:
+            resp, _ = c.request({"op": "xa_prepare", "xid": self.xid})
+            return bool(resp.get("ok"))
+        except Exception:
+            return False
+
+    def commit(self, commit_ts: int):
+        c = self._client()
+        if c is None:
+            raise errors.TransactionError(
+                f"branch {self.xid}: worker {self.addr} unreachable")
+        resp, _ = c.request({"op": "xa_commit", "xid": self.xid,
+                             "commit_ts": int(commit_ts)})
+        if resp.get("error"):
+            raise errors.TransactionError(
+                f"branch {self.xid} commit failed: {resp['error']}")
+
+    def rollback(self):
+        c = self._client()
+        if c is None:
+            return  # branch resolves via xa_recover when the worker returns
+        try:
+            c.request({"op": "xa_rollback", "xid": self.xid})
+        except Exception:
+            pass
+
+
+def remote_participants_of(instance, txn) -> List[RemoteBranchParticipant]:
+    return [RemoteBranchParticipant(instance, addr, xid)
+            for addr, xid in getattr(txn, "remote", {}).items()]
+
+
 def participants_of(txn) -> List[StoreParticipant]:
     """Group a session Transaction's undo entries by store (one participant each)."""
     by_store: Dict[int, StoreParticipant] = {}
@@ -119,6 +171,19 @@ def recover_persisted(instance) -> Dict[int, str]:
     out: Dict[int, str] = {}
     resolutions: Dict[int, Optional[int]] = {}  # txn_id -> commit_ts or None
 
+    # PREPARED branches of a DISTRIBUTED txn (this node acting as a worker /
+    # participant) stay in doubt: the coordinator owns the outcome and resolves
+    # them via xa_recover after reattach — presumed abort must not apply here
+    held: set = set()
+    for k, v in instance.metadb.kv_scan("xa.branch."):
+        try:
+            import json as _json
+            d = _json.loads(v)
+            if d.get("state") == "PREPARED":
+                held.add(int(d["txn_id"]))
+        except Exception:
+            continue
+
     def resolve(txn_id: int) -> Optional[int]:
         if txn_id not in resolutions:
             state = instance.metadb.tx_log_get(txn_id)
@@ -138,6 +203,9 @@ def recover_persisted(instance) -> Dict[int, str]:
                 ids = np.unique(np.concatenate(
                     [-p.begin_ts[bneg], -p.end_ts[eneg]])).astype(np.int64)
                 for txn_id in (int(t) for t in ids):
+                    if txn_id in held:
+                        out[txn_id] = "in_doubt"
+                        continue
                     own = -txn_id
                     commit_ts = resolve(txn_id)
                     if commit_ts is not None:
@@ -153,7 +221,7 @@ def recover_persisted(instance) -> Dict[int, str]:
     for txn_id, res in out.items():
         if res == "committed":
             instance.metadb.tx_log_put(txn_id, "DONE", resolutions[txn_id])
-        else:
+        elif res == "rolled_back":
             instance.metadb.tx_log_put(txn_id, "ABORTED")
     if out:
         for store in instance.stores.values():
@@ -172,11 +240,11 @@ class TwoPhaseCoordinator:
         self._lock = threading.Lock()
 
     def commit(self, txn) -> int:
-        parts = participants_of(txn)
+        parts = participants_of(txn) + remote_participants_of(self.instance, txn)
         if not parts:
             return self.instance.tso.next_timestamp()
         metadb = self.instance.metadb
-        # phase 1: prepare every participant
+        # phase 1: prepare every participant (local stores + worker branches)
         for sp in parts:
             if not sp.prepare():
                 for done in parts:
@@ -191,8 +259,24 @@ class TwoPhaseCoordinator:
         # commits (the reference's GlobalTxLogManager.append + commitTimestamp)
         commit_ts = self.instance.tso.next_timestamp()
         metadb.tx_log_put(txn.txn_id, "COMMITTED", commit_ts)
+        failed = []
         for sp in parts:
-            sp.commit(commit_ts)
+            try:
+                sp.commit(commit_ts)
+            except Exception as e:
+                # past the commit point the outcome is decided: a dead worker
+                # branch stays in doubt and is re-committed by recover() /
+                # xa_recover when it returns — never rolled back
+                failed.append((sp, e))
+        if failed:
+            err = errors.TransactionError(
+                f"txn {txn.txn_id} committed at {commit_ts} but "
+                f"{len(failed)} branch(es) are in doubt (will re-commit): "
+                f"{failed[0][1]}")
+            # past the commit point the txn IS committed: callers must still
+            # apply commit-dependent follow-ups (CDC flush) at this ts
+            err.commit_ts = commit_ts
+            raise err
         metadb.tx_log_put(txn.txn_id, "DONE", commit_ts)
         with self._lock:
             self._in_doubt.pop(txn.txn_id, None)
@@ -222,4 +306,37 @@ class TwoPhaseCoordinator:
                 out[txn_id] = "done"
             with self._lock:
                 self._in_doubt.pop(txn_id, None)
+        return out
+
+    def recover_remote(self) -> Dict[str, str]:
+        """Resolve in-doubt branches REPORTED BY workers (XA RECOVER analog).
+
+        After a worker restart its PREPARED branches are in doubt on the worker
+        side; the coordinator asks each attached worker (`xa_recover`), decides
+        from its own durable commit-point log (xid encodes this coordinator's
+        txn id), and drives xa_commit / xa_rollback."""
+        out: Dict[str, str] = {}
+        for addr, client in list(self.instance.workers.items()):
+            try:
+                resp, _ = client.request({"op": "xa_recover"})
+            except Exception:
+                continue
+            for xid in resp.get("xids", []):
+                try:
+                    txn_id = int(str(xid).lstrip("g"))
+                except ValueError:
+                    continue
+                state = self.instance.metadb.tx_log_get(txn_id)
+                try:
+                    if state is not None and state[0] in ("COMMITTED", "DONE") \
+                            and state[1]:
+                        client.request({"op": "xa_commit", "xid": xid,
+                                        "commit_ts": int(state[1])})
+                        out[xid] = "committed"
+                        self.instance.metadb.tx_log_put(txn_id, "DONE", state[1])
+                    else:
+                        client.request({"op": "xa_rollback", "xid": xid})
+                        out[xid] = "rolled_back"
+                except Exception as e:
+                    out[xid] = f"unresolved: {e}"
         return out

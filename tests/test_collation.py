@@ -76,3 +76,78 @@ class TestCollateQueries:
         with pytest.raises(errors.NotSupportedError):
             session.execute(
                 "SELECT id FROM t WHERE name = 'x' COLLATE klingon_sorting")
+
+
+class TestCollationOrdering:
+    """Differential ORDER BY under collations vs known MySQL orderings.
+
+    Reference analog: sort keys of `common/collation/*CollationHandler` —
+    ordering under *_ci groups case variants ('a' < 'B' although binary code
+    order says 'B' < 'a'), *_unicode/_0900_ai_ci also merge accents."""
+
+    @pytest.fixture()
+    def osess(self):
+        inst = Instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE oc")
+        s.execute("USE oc")
+        s.execute("CREATE TABLE w (id INT, s VARCHAR(20))")
+        rows = [(1, "banana"), (2, "Apple"), (3, "cherry"), (4, "apple"),
+                (5, "Banana"), (6, "CHERRY")]
+        vals = ", ".join(f"({i}, '{v}')" for i, v in rows)
+        s.execute(f"INSERT INTO w VALUES {vals}")
+        return s
+
+    def test_order_by_ci_matches_mysql(self, osess):
+        # MySQL utf8mb4_general_ci: apple-class < banana-class < cherry-class
+        r = osess.execute(
+            "SELECT s FROM w ORDER BY s COLLATE utf8mb4_general_ci, id")
+        got = [x[0].lower() for x in r.rows]
+        assert got == ["apple", "apple", "banana", "banana",
+                       "cherry", "cherry"]
+        # binary ordering differs: uppercase sorts first
+        rb = osess.execute("SELECT s FROM w ORDER BY s COLLATE utf8mb4_bin")
+        assert [x[0] for x in rb.rows] == sorted(
+            ["banana", "Apple", "cherry", "apple", "Banana", "CHERRY"])
+
+    def test_order_by_unicode_ci_accents(self, osess):
+        osess.execute("CREATE TABLE acc (id INT, s VARCHAR(20))")
+        osess.execute("INSERT INTO acc VALUES (1,'zebra'), (2,'école'), "
+                      "(3,'edge'), (4,'Énorme'), (5,'apple')")
+        # MySQL utf8mb4_unicode_ci: apple, école/edge/Énorme (e-class,
+        # accent-insensitive), zebra
+        r = osess.execute(
+            "SELECT s FROM acc ORDER BY s COLLATE utf8mb4_unicode_ci")
+        got = [x[0] for x in r.rows]
+        assert got[0] == "apple" and got[-1] == "zebra"
+        assert {g for g in got[1:4]} == {"école", "edge", "Énorme"}
+        # 'école' < 'edge'? MySQL ai_ci folds é->e: 'ecole' < 'edge' (c < d)
+        assert got[1] == "école"
+
+    def test_range_compare_under_ci(self, osess):
+        # s < 'BANANA' under ci: the whole apple class qualifies, banana
+        # class does not (equal under the collation), cherry neither
+        r = osess.execute(
+            "SELECT id FROM w WHERE s COLLATE utf8mb4_general_ci < 'BANANA' "
+            "ORDER BY id")
+        assert [x[0] for x in r.rows] == [2, 4]
+        r = osess.execute(
+            "SELECT id FROM w WHERE s COLLATE utf8mb4_general_ci <= 'BANANA' "
+            "ORDER BY id")
+        assert [x[0] for x in r.rows] == [1, 2, 4, 5]
+
+    def test_min_max_under_ci(self, osess):
+        r = osess.execute(
+            "SELECT min(s COLLATE utf8mb4_general_ci), "
+            "max(s COLLATE utf8mb4_general_ci) FROM w")
+        lo, hi = r.rows[0]
+        assert lo.lower() == "apple" and hi.lower() == "cherry"
+
+    def test_collation_name_surface(self):
+        from galaxysql_tpu.types import collation as coll
+        # the enumerated MySQL name set resolves to handler families
+        assert len(coll.COLLATIONS) >= 30
+        for name in ("utf8mb4_general_ci", "latin1_swedish_ci", "utf8_bin",
+                     "utf8mb4_0900_ai_ci", "gbk_chinese_ci",
+                     "utf8mb4_0900_as_cs"):
+            assert coll.family_of(name)

@@ -205,3 +205,80 @@ class TestCompressedProtocol:
         assert rows2[0][0] in (500, "500")
         c.close()
         c2.close()
+
+
+@pytest.fixture(scope="module")
+def tls_server(tmp_path_factory):
+    """A server with a self-signed cert (TLS upgrade, net/ssl analog)."""
+    import subprocess
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = str(d / "cert.pem"), str(d / "key.pem")
+    subprocess.run(["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                    "-nodes", "-keyout", key, "-out", cert, "-days", "1",
+                    "-subj", "/CN=localhost"], check=True,
+                   capture_output=True)
+    inst = Instance()
+    srv = MySQLServer(inst, port=0, users={"root": ""},
+                      ssl_certfile=cert, ssl_keyfile=key)
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(srv.start())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    started.wait(10)
+    yield srv
+    loop.call_soon_threadsafe(loop.stop)
+
+
+class TestTls:
+    def test_tls_handshake_and_query(self, tls_server):
+        c = MiniClient("127.0.0.1", tls_server.port, use_ssl=True,
+               timeout=120.0)
+        try:
+            assert c.ping()
+            c.query("CREATE DATABASE IF NOT EXISTS enc")
+            c.query("USE enc")
+            c.query("CREATE TABLE s (id INT, v VARCHAR(10))")
+            c.query("INSERT INTO s VALUES (1, 'hush')")
+            names, rows = c.query("SELECT v FROM s WHERE id = 1")
+            assert rows == [("hush",)]
+        finally:
+            c.close()
+
+    def test_plaintext_still_works_on_tls_server(self, tls_server):
+        c = MiniClient("127.0.0.1", tls_server.port)
+        try:
+            assert c.ping()
+        finally:
+            c.close()
+
+
+class TestBinlogDump:
+    def test_stream_changes(self, server):
+        c = MiniClient("127.0.0.1", server.port)
+        try:
+            c.query("CREATE DATABASE IF NOT EXISTS bl")
+            c.query("USE bl")
+            c.query("CREATE TABLE ev (id INT, v VARCHAR(10))")
+            c.query("INSERT INTO ev VALUES (1, 'a'), (2, 'b')")
+            c.query("DELETE FROM ev WHERE id = 1")
+            events = c.binlog_dump(0)
+            mine = [e for e in events if e["table"] == "ev"]
+            kinds = [e["kind"] for e in mine]
+            assert "insert" in {k.lower() for k in kinds}, mine
+            assert any("delete" in k.lower() for k in kinds), mine
+            # resume from the last watermark: nothing new
+            last = max(e["seq"] for e in events)
+            assert c.binlog_dump(last) == []
+            # new change appears after the watermark
+            c.query("INSERT INTO ev VALUES (3, 'c')")
+            tail = c.binlog_dump(last)
+            assert any(e["table"] == "ev" for e in tail)
+        finally:
+            c.close()

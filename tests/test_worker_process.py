@@ -81,22 +81,76 @@ class TestPlanShipping:
             "GROUP BY dim.label ORDER BY dim.label")
         # k=1 rows: ids 0,5,..,95 qty sum = 950; k=2: 970
         assert r.rows == [("alpha", 950), ("beta", 970)]
-        assert any("remote-scan" in t for t in s.last_trace)
+        assert any("remote-plan" in t or "remote-scan" in t
+                   for t in s.last_trace)
 
-    def test_shipped_sql_is_column_pruned(self, session):
+    def test_shipped_fragment_is_column_pruned(self, session):
+        """Scans ship as serialized plan fragments (XPlan analog) carrying only
+        the referenced columns; SQL text is the degrade path."""
         s, port = session
         s.execute("SELECT k FROM dim")
         log = s.instance.workers[("127.0.0.1", port)].sync_action(
             "query_log", {})["queries"]
-        pruned = [q for q in log if q.startswith("SELECT k FROM")]
-        assert pruned, log  # only the referenced column was shipped
+        frags = [q for q in log if q.startswith("PLAN:w.dim:")]
+        assert frags, log  # fragment execution, not SQL re-parse
+        assert frags[-1] == "PLAN:w.dim:k"  # column pruning rode the fragment
 
-    def test_remote_dml_refused(self, session):
+    def test_fragment_sarg_pushdown(self, session):
+        """Range predicates ride the fragment: the worker filters before
+        shipping rows back (runtime SARGs on the DN, not just the CN)."""
+        s, port = session
+        r = s.execute("SELECT k FROM dim WHERE k >= 3 ORDER BY k")
+        assert [x[0] for x in r.rows] == [3, 4, 5]
+
+    def test_remote_dml_autocommit(self, session):
         s, _ = session
-        with pytest.raises(errors.NotSupportedError, match="worker"):
-            s.execute("INSERT INTO dim VALUES (9, 'x', 1.0)")
-        with pytest.raises(errors.NotSupportedError, match="worker"):
-            s.execute("DELETE FROM dim WHERE k = 1")
+        s.execute("INSERT INTO dim VALUES (9, 'iota', 1.10)")
+        try:
+            r = s.execute("SELECT label, price FROM dim WHERE k = 9")
+            assert r.rows == [("iota", 1.1)]
+        finally:
+            s.execute("DELETE FROM dim WHERE k = 9")
+        assert s.execute("SELECT label FROM dim WHERE k = 9").rows == []
+
+    def test_read_your_own_remote_writes(self, session):
+        """A txn's remote writes are visible to its own SELECTs before COMMIT
+        (the scan ships the branch xid; the worker reads through the branch)."""
+        s, _ = session
+        s.execute("BEGIN")
+        s.execute("INSERT INTO dim VALUES (31, 'rw', 3.33)")
+        r = s.execute("SELECT label FROM dim WHERE k = 31")
+        assert r.rows == [("rw",)]
+        # other sessions do NOT see the uncommitted branch row
+        s2 = Session(s.instance, schema="w")
+        assert s2.execute("SELECT label FROM dim WHERE k = 31").rows == []
+        s2.close()
+        s.execute("ROLLBACK")
+        assert s.execute("SELECT label FROM dim WHERE k = 31").rows == []
+
+    def test_remote_dml_atomic_with_local(self, session):
+        """One txn spanning a local store and the worker: COMMIT lands both,
+        ROLLBACK lands neither (XA 2PC with the worker as a branch)."""
+        s, _ = session
+        s.execute("CREATE TABLE localtab (id BIGINT PRIMARY KEY, v BIGINT)")
+        s.execute("BEGIN")
+        s.execute("INSERT INTO localtab VALUES (1, 10)")
+        s.execute("INSERT INTO dim VALUES (21, 'txn', 0.10)")
+        # uncommitted: another session sees neither side
+        s2 = Session(s.instance, schema="w")
+        assert s2.execute("SELECT v FROM localtab").rows == []
+        assert s2.execute("SELECT label FROM dim WHERE k = 21").rows == []
+        s.execute("COMMIT")
+        assert s2.execute("SELECT v FROM localtab").rows == [(10,)]
+        assert s2.execute("SELECT label FROM dim WHERE k = 21").rows == [("txn",)]
+        s2.close()
+        s.execute("DELETE FROM dim WHERE k = 21")
+        # rollback side
+        s.execute("BEGIN")
+        s.execute("INSERT INTO localtab VALUES (2, 20)")
+        s.execute("INSERT INTO dim VALUES (22, 'gone', 0.20)")
+        s.execute("ROLLBACK")
+        assert s.execute("SELECT v FROM localtab WHERE id = 2").rows == []
+        assert s.execute("SELECT label FROM dim WHERE k = 22").rows == []
 
     def test_sync_bus_broadcast(self, session):
         s, port = session
@@ -105,6 +159,177 @@ class TestPlanShipping:
         assert acks and acks[0]["ok"]
         acks = s.instance.sync_bus.broadcast("invalidate_plan_cache", {})
         assert acks[0]["ok"]
+
+
+def _spawn_worker(data_dir, init_sql=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    argv = [sys.executable, "-m", "galaxysql_tpu.net.worker", "--port", "0",
+            "--platform", "cpu", "--data-dir", data_dir]
+    if init_sql:
+        argv += ["--init-sql", init_sql]
+    p = subprocess.Popen(argv,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))),
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         env=env, text=True)
+    line = p.stdout.readline()
+    if not line.startswith("WORKER_READY"):
+        err = p.stderr.read()[-3000:] if p.stderr else ""
+        raise AssertionError(f"worker failed to start: {line!r}\n{err}")
+    return p, int(line.split()[1])
+
+
+class TestCrashRecovery:
+    """2PC crash tests across REAL process boundaries: the worker is
+    SIGKILLed between its XA PREPARE and the branch commit, restarted from
+    its data dir, and the coordinator resolves the in-doubt branch from its
+    durable commit-point log (XARecoverTask analog, SURVEY.md §3.4)."""
+
+    def _setup(self, tmp_path):
+        data_dir = str(tmp_path / "wdata")
+        os.makedirs(data_dir, exist_ok=True)
+        p, port = _spawn_worker(
+            data_dir,
+            "CREATE DATABASE cw; USE cw; "
+            "CREATE TABLE acct (id BIGINT PRIMARY KEY, bal BIGINT); "
+            "INSERT INTO acct VALUES (1, 100)")
+        inst = Instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE cw")
+        s.execute("USE cw")
+        inst.attach_remote_table("cw", "acct", "127.0.0.1", port)
+        return data_dir, p, port, inst, s
+
+    def _prepare_branch(self, inst, s, sql):
+        """Open a txn, ship DML, drive the branch to PREPARED; returns txn."""
+        from galaxysql_tpu.txn.xa import remote_participants_of
+        s.execute("BEGIN")
+        s.execute(sql)
+        txn = s.txn
+        parts = remote_participants_of(inst, txn)
+        assert len(parts) == 1 and parts[0].prepare()
+        return txn
+
+    def test_commit_point_wins_after_worker_crash(self, tmp_path):
+        data_dir, p, port, inst, s = self._setup(tmp_path)
+        try:
+            txn = self._prepare_branch(
+                inst, s, "INSERT INTO acct VALUES (2, 555)")
+            # crash the worker AFTER prepare, then log the commit point: the
+            # outcome is decided even though the branch never saw the commit
+            p.kill()
+            p.wait()
+            cts = inst.tso.next_timestamp()
+            inst.metadb.tx_log_put(txn.txn_id, "COMMITTED", cts)
+            s.txn = None  # the session's txn is resolved by recovery below
+            # restart from the same data dir; reattach at the new port
+            p, port = _spawn_worker(data_dir)
+            inst.attach_remote_table("cw", "acct", "127.0.0.1", port)
+            out = inst.xa_coordinator.recover_remote()
+            assert out == {f"g{txn.txn_id}": "committed"}, out
+            r = s.execute("SELECT bal FROM acct WHERE id = 2")
+            assert r.rows == [(555,)]
+        finally:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    def test_no_commit_point_presumes_abort(self, tmp_path):
+        data_dir, p, port, inst, s = self._setup(tmp_path)
+        try:
+            txn = self._prepare_branch(
+                inst, s, "INSERT INTO acct VALUES (3, 777)")
+            p.kill()
+            p.wait()
+            s.txn = None  # coordinator never logged a commit point
+            p, port = _spawn_worker(data_dir)
+            inst.attach_remote_table("cw", "acct", "127.0.0.1", port)
+            # in doubt until resolved: the restarted worker must HOLD the
+            # prepared rows (not roll them back at boot)
+            out = inst.xa_coordinator.recover_remote()
+            assert out == {f"g{txn.txn_id}": "rolled_back"}, out
+            assert s.execute("SELECT bal FROM acct WHERE id = 3").rows == []
+            # the surviving committed data is intact
+            assert s.execute("SELECT bal FROM acct WHERE id = 1").rows == [(100,)]
+        finally:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+
+class TestReplicaAndMove:
+    """Read-write splitting + fence-triggered failover + online table move
+    between workers (TGroupDataSource / Balancer.java analogs)."""
+
+    def _two_workers(self, tmp_path):
+        init = ("CREATE DATABASE rp; USE rp; "
+                "CREATE TABLE inv (id BIGINT PRIMARY KEY, qty BIGINT); "
+                "INSERT INTO inv VALUES (1, 5), (2, 7)")
+        p1, port1 = _spawn_worker(str(tmp_path / "w1"), init)
+        p2, port2 = _spawn_worker(str(tmp_path / "w2"), init)
+        inst = Instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE rp")
+        s.execute("USE rp")
+        inst.attach_remote_table("rp", "inv", "127.0.0.1", port1)
+        return p1, port1, p2, port2, inst, s
+
+    def test_replica_failover_keeps_reads_serving(self, tmp_path):
+        p1, port1, p2, port2, inst, s = self._two_workers(tmp_path)
+        try:
+            inst.attach_replica("rp", "inv", "127.0.0.1", port2)
+            # writes replicate synchronously to both endpoints
+            s.execute("INSERT INTO inv VALUES (3, 9)")
+            base = sorted(s.execute("SELECT id, qty FROM inv").rows)
+            assert base == [(1, 5), (2, 7), (3, 9)]
+            # kill the PRIMARY: probe fences it, reads fail over to the replica
+            p1.kill()
+            p1.wait()
+            fenced = inst.ha.probe_workers()
+            assert fenced[("127.0.0.1", port1)] is True
+            for _ in range(5):
+                r = sorted(s.execute("SELECT id, qty FROM inv").rows)
+                assert r == base
+        finally:
+            for p in (p1, p2):
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+
+    def test_move_table_between_workers(self, tmp_path):
+        init = ("CREATE DATABASE mv; USE mv; "
+                "CREATE TABLE t (id BIGINT PRIMARY KEY, v VARCHAR(12), "
+                "amt DECIMAL(10,2)); "
+                "INSERT INTO t VALUES (1,'a',1.25), (2,'b',2.50), (3,NULL,0.75)")
+        p1, port1 = _spawn_worker(str(tmp_path / "m1"), init)
+        p2, port2 = _spawn_worker(str(tmp_path / "m2"))  # empty target
+        inst = Instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE mv")
+        s.execute("USE mv")
+        inst.attach_remote_table("mv", "t", "127.0.0.1", port1)
+        try:
+            # concurrent-ish write before the move cutover
+            s.execute("INSERT INTO t VALUES (4, 'd', 4.00)")
+            s.execute("DELETE FROM t WHERE id = 2")
+            inst.move_remote_table("mv", "t", "127.0.0.1", port2)
+            tm = inst.catalog.table("mv", "t")
+            assert (tm.remote["host"], tm.remote["port"]) == ("127.0.0.1", port2)
+            got = sorted(s.execute("SELECT id, v, amt FROM t").rows)
+            assert got == [(1, "a", 1.25), (3, None, 0.75), (4, "d", 4.0)]
+            # the moved table serves reads even with the OLD worker dead
+            p1.kill()
+            p1.wait()
+            got = sorted(s.execute("SELECT id, v, amt FROM t").rows)
+            assert got == [(1, "a", 1.25), (3, None, 0.75), (4, "d", 4.0)]
+            # and accepts writes on the new primary
+            s.execute("INSERT INTO t VALUES (9, 'z', 9.99)")
+            assert s.execute("SELECT v FROM t WHERE id = 9").rows == [("z",)]
+        finally:
+            for p in (p1, p2):
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
 
 
 class TestHaActs:
