@@ -56,6 +56,8 @@ DEADLOCK_DETECT_INTERVAL = _p("DEADLOCK_DETECT_INTERVAL", 1000, "ms")
 
 # --- DML ----------------------------------------------------------------------
 DML_BATCH_SIZE = _p("DML_BATCH_SIZE", 10_000, "insert batch size")
+ENABLE_RECYCLEBIN = _p("ENABLE_RECYCLEBIN", True,
+                       "DROP TABLE parks tables for FLASHBACK ... BEFORE DROP")
 
 # --- MPP ----------------------------------------------------------------------
 ENABLE_MPP = _p("ENABLE_MPP", True, "SPMD mesh execution for AP queries")

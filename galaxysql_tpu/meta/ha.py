@@ -92,6 +92,7 @@ class HaManager:
     def probe_workers(self) -> Dict[Tuple[str, int], bool]:
         """Ping every attached worker; fence the dead, unfence the recovered."""
         results = {}
+        recovered = False
         for client in getattr(self.instance, "workers", {}).values():
             ok = client.ping()
             addr = client.addr
@@ -99,11 +100,20 @@ class HaManager:
                 was = self._fenced.get(addr, False)
                 self._fenced[addr] = not ok
             if was and ok:
+                recovered = True
                 for fn in self.listeners:
                     fn(f"worker:{addr[0]}:{addr[1]}", "DEAD", "ALIVE")
             elif not was and not ok:
                 for fn in self.listeners:
                     fn(f"worker:{addr[0]}:{addr[1]}", "ALIVE", "DEAD")
+        if recovered:
+            # a returning worker may hold in-doubt XA branches whose outcome
+            # this coordinator already logged — resolve them NOW, not on the
+            # next manual recovery call (XARecoverTask runs on reconnect too)
+            try:
+                self.instance.xa_coordinator.recover_remote()
+            except Exception:
+                pass  # probing must never fail because recovery hiccuped
         return dict(self._fenced)
 
     def worker_fenced(self, addr: Tuple[str, int]) -> bool:

@@ -47,6 +47,29 @@ class TimestampOracle:
                 self._logical = ts & ((1 << LOGICAL_BITS) - 1)
 
     def next_timestamps(self, n: int) -> list:
-        """Batched fetch (the reference batches TSO requests, ClusterTimestampOracle
-        taskQueue)."""
-        return [self.next_timestamp() for _ in range(n)]
+        """Batched fetch: ONE lock acquisition allocates a contiguous logical
+        range (the reference batches waiter requests the same way —
+        `ClusterTimestampOracle.java:109-133` drains its taskQueue into one
+        grouped GTS fetch; batching is what keeps a remote TSO off the commit
+        critical path)."""
+        if n <= 0:
+            return []
+        with self._lock:
+            phys = int(time.time() * 1000)
+            if phys <= self._last_physical:
+                phys = self._last_physical
+                base = self._logical + 1
+            else:
+                self._last_physical = phys
+                base = 0
+            out = []
+            logical = base
+            for _ in range(n):
+                if logical >= (1 << LOGICAL_BITS):
+                    phys += 1
+                    self._last_physical = phys
+                    logical = 0
+                out.append((phys << LOGICAL_BITS) | logical)
+                logical += 1
+            self._logical = logical - 1
+            return out

@@ -67,6 +67,9 @@ def _load():
         lib.gx_join_probe_k1.argtypes = [i64p, u8p, st, i64p, i32p, st, i32p,
                                          i32p, i32p, st]
         lib.gx_join_probe_k1.restype = st
+        lib.gx_join_probe_k1_idx.argtypes = [i64p, i32p, st, i64p, i32p, st,
+                                             i32p, i32p, i32p, st]
+        lib.gx_join_probe_k1_idx.restype = st
         lib.gx_hash_combine.argtypes = [u64p, i64p, u8p, st, ctypes.c_int32]
         lib.gx_bloom_build.argtypes = [i64p, st, u64p, st]
         lib.gx_bloom_query.argtypes = [i64p, st, u64p, st, u8p]
@@ -289,19 +292,37 @@ def join_probe_k1(probe_keys: np.ndarray, probe_live: np.ndarray,
     build_keys, heads, nxt, M = table
     probe_keys = np.ascontiguousarray(probe_keys, dtype=np.int64)
     npr = probe_keys.shape[0]
-    live8 = _as_u8(probe_live)
     if AVAILABLE and heads is not None:
-        cap = max(int(npr) // 4, 1024)
+        n_live = int(np.count_nonzero(probe_live))
+        sparse = n_live * 2 < npr
+        if sparse:
+            # sparse live mask: random-pattern `if (!live)` branches mispredict
+            # in the scalar loop; collect ids vectorized, probe dense
+            ids = np.nonzero(probe_live)[0].astype(np.int32)
+        else:
+            live8 = _as_u8(probe_live)
+        cap = max(n_live, 1024)
         while True:
             out_b = np.empty(cap, dtype=np.int32)
             out_p = np.empty(cap, dtype=np.int32)
-            total = _lib.gx_join_probe_k1(
-                _ptr(probe_keys, ctypes.c_int64),
-                _ptr(live8, ctypes.c_uint8), npr,
-                _ptr(build_keys, ctypes.c_int64),
-                _ptr(heads, ctypes.c_int32), M,
-                _ptr(nxt, ctypes.c_int32),
-                _ptr(out_b, ctypes.c_int32), _ptr(out_p, ctypes.c_int32), cap)
+            if sparse:
+                total = _lib.gx_join_probe_k1_idx(
+                    _ptr(probe_keys, ctypes.c_int64),
+                    _ptr(ids, ctypes.c_int32), ids.size,
+                    _ptr(build_keys, ctypes.c_int64),
+                    _ptr(heads, ctypes.c_int32), M,
+                    _ptr(nxt, ctypes.c_int32),
+                    _ptr(out_b, ctypes.c_int32),
+                    _ptr(out_p, ctypes.c_int32), cap)
+            else:
+                total = _lib.gx_join_probe_k1(
+                    _ptr(probe_keys, ctypes.c_int64),
+                    _ptr(live8, ctypes.c_uint8), npr,
+                    _ptr(build_keys, ctypes.c_int64),
+                    _ptr(heads, ctypes.c_int32), M,
+                    _ptr(nxt, ctypes.c_int32),
+                    _ptr(out_b, ctypes.c_int32),
+                    _ptr(out_p, ctypes.c_int32), cap)
             if total <= cap:
                 return out_b[:total], out_p[:total]
             cap = int(total)

@@ -150,6 +150,30 @@ size_t gx_join_probe_k1(const int64_t* keys, const uint8_t* live, size_t npr,
     return o;
 }
 
+// Compact-id probe: iterate a precollected live-row id list instead of
+// branching on a sparse live mask (random-pattern live branches mispredict;
+// np.nonzero collects ids vectorized, this loop then runs dense).
+size_t gx_join_probe_k1_idx(const int64_t* keys, const int32_t* ids,
+                            size_t n_ids, const int64_t* build_keys,
+                            const int32_t* heads, size_t M,
+                            const int32_t* next,
+                            int32_t* out_b, int32_t* out_p, size_t cap) {
+    const uint64_t mask = (uint64_t)M - 1;
+    size_t o = 0;
+    for (size_t t = 0; t < n_ids; t++) {
+        const int32_t i = ids[t];
+        const int64_t k = keys[i];
+        for (int32_t j = heads[(size_t)(mix64((uint64_t)k) & mask)]; j >= 0;
+             j = next[j]) {
+            if (build_keys[j] == k) {
+                if (o < cap) { out_b[o] = j; out_p[o] = i; }
+                o++;
+            }
+        }
+    }
+    return o;
+}
+
 // Combined key-lane hashing (the np/jnp hash_columns twin): fold `lane` into
 // the running combined hash the same way kernels/relational.py::hash_columns
 // does.  first=1 initializes; null slots carry the NULL tag so NULL keys chain

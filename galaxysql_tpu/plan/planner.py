@@ -56,9 +56,13 @@ def scanned_rows_estimate(rel: L.RelNode) -> float:
     for n in L.walk(rel):
         if isinstance(n, L.Scan):
             if n.point_eq is not None:
-                # index access path: the scan touches candidate rows, not the
-                # table (DirectShardingKeyTableOperation => TP classification)
-                total += 2.0
+                # index access path: the scan touches ~rows/NDV candidates,
+                # not the table (DirectShardingKeyTableOperation analog);
+                # ANALYZE stats keep the TP/AP classification honest for
+                # non-unique index leads
+                ndv = n.table.stats.ndv.get(n.point_eq[0], 0)
+                est = (n.table.stats.row_count / ndv) if ndv else 2.0
+                total += max(est, 2.0)
                 continue
             frac = 1.0
             if n.partitions is not None and n.table.partition.num_partitions > 0:

@@ -606,6 +606,13 @@ def _choose_point_eq(cond: ir.Expr, scan: L.Scan):
         if lit is None:
             continue
         cm = tm.column(cname)
+        # access-path cost check: a low-cardinality index lead (status flags
+        # etc.) would return huge candidate sets through the host index path —
+        # worse than the device full scan.  NDV comes from ANALYZE.
+        ndv = tm.stats.ndv.get(cm.name, 0)
+        if ndv and tm.stats.row_count and \
+                tm.stats.row_count / ndv > 65536:
+            continue
         v = _lane_encode(tm, cm.name, lit.value)
         if v is None:
             continue

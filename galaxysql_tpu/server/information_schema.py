@@ -82,6 +82,8 @@ def refresh(instance, session=None):
         if s.name == "information_schema":
             continue
         for tm in s.tables.values():
+            if tm.name.startswith("__recycle__"):
+                continue  # dropped tables surface via SHOW RECYCLEBIN only
             store = instance.stores.get(instance.store_key(tm.schema, tm.name))
             nrows = store.row_count() if store else 0
             tables.append(["def", tm.schema, tm.name, "BASE TABLE", "TPU_COLUMNAR",

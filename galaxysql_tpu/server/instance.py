@@ -67,6 +67,8 @@ class Instance:
         # (schema, parameterized-sql) -> PointPlan: binder-free execution of
         # archetypal point SELECTs (DirectShardingKeyTableOperation analog)
         self.point_plans: Dict[tuple, object] = {}
+        from galaxysql_tpu.server.maintain import RecycleBin
+        self.recycle = RecycleBin(self)
         self.lock = threading.RLock()
         self.next_conn_id = 1
         self.sessions: Dict[int, object] = {}
@@ -163,6 +165,12 @@ class Instance:
             self.sync_bus.attach(client)
         resp = client.sync_action("table_meta", {"schema": schema,
                                                  "table": name})
+        # (re)attachment is the reconnect point: resolve any XA branches this
+        # worker holds in doubt against our commit-point log (XARecoverTask)
+        try:
+            self.xa_coordinator.recover_remote()
+        except Exception:
+            pass
         cols = [ColumnMeta(n, dt.from_sql_name(t, p or 0, s or 0), nullable)
                 for n, t, p, s, nullable in resp["columns"]]
         tm = TableMeta(schema, name, cols, resp.get("primary_key") or [],
@@ -177,28 +185,74 @@ class Instance:
         return tm
 
     def attach_replica(self, schema: str, name: str, host: str, port: int,
-                       weight: int = 1):
+                       weight: int = 1, backfill: Optional[bool] = None):
         """Register a read replica for a remote table (read-write splitting,
         `TGroupDataSource` weighted-random analog).  Writes go to every live
         endpoint as branches of the same distributed txn (synchronous
-        replication); reads pick a weighted-random unfenced endpoint."""
+        replication); reads pick a weighted-random unfenced endpoint.
+
+        A replica must hold the table's data BEFORE it serves reads:
+        `backfill=None` (default) copies from the primary when the replica's
+        table is missing or empty and trusts a pre-seeded identical copy
+        otherwise; True forces the copy (rebuilding a STALE replica requires
+        it); False trusts the caller unconditionally."""
         from galaxysql_tpu.net.dn import WorkerClient
         key = (host, port)
-        if key not in self.workers:
+        client = self.workers.get(key)
+        if client is None:
             client = WorkerClient(host, port)
             self.workers[key] = client
             self.sync_bus.attach(client)
         tm = self.catalog.table(schema, name)
         if getattr(tm, "remote", None) is None:
             raise ValueError(f"{schema}.{name} is not a remote table")
-        for r in tm.replicas:
-            if (r["host"], r["port"]) == key:
-                r["weight"] = weight
-                r["stale"] = False
-                return tm
+        entry = next((r for r in tm.replicas
+                      if (r["host"], r["port"]) == key), None)
+        if entry is not None and entry.get("stale") and backfill is not True:
+            raise errors.TddlError(
+                f"replica {key} is stale (missed writes); re-attach with "
+                f"backfill=True to rebuild it")
+        if backfill is None:
+            backfill = self._replica_needs_backfill(client, schema, name)
+        if backfill:
+            self._backfill_replica(client, schema, name)
+        if entry is not None:
+            entry["weight"] = weight
+            entry["stale"] = False
+            return tm
         tm.replicas.append({"host": host, "port": port, "weight": weight,
                             "stale": False})
         return tm
+
+    def _replica_needs_backfill(self, client, schema: str, name: str) -> bool:
+        try:
+            _cols, _types, data, _valid = client.execute(
+                f"SELECT count(*) FROM {name}", schema)
+            lane = next(iter(data.values())) if data else None
+            return lane is None or lane.size == 0 or int(lane[0]) == 0
+        except Exception:
+            return True  # table (or schema) missing on the replica
+
+    def _backfill_replica(self, client, schema: str, name: str):
+        """Snapshot copy primary -> replica under shared MDL (writes keep
+        flowing; they also ship to the replica's branch once registered, and
+        registration happens only after this copy completes)."""
+        tm = self.catalog.table(schema, name)
+        src = self.workers[(tm.remote["host"], tm.remote["port"])]
+        cols_sql = ", ".join(
+            f"{c.name} {c.dtype.sql_name()}" + ("" if c.nullable else " NOT NULL")
+            for c in tm.columns)
+        pk_sql = (f", PRIMARY KEY ({', '.join(tm.primary_key)})"
+                  if tm.primary_key else "")
+        client.execute(f"CREATE DATABASE IF NOT EXISTS {schema}", "")
+        client.execute(
+            f"CREATE TABLE IF NOT EXISTS {name} ({cols_sql}{pk_sql})", schema)
+        cols = tm.column_names()
+        with self.mdl.shared({f"{schema.lower()}.{name.lower()}"}):
+            names, types, data, valid = src.exec_plan(
+                {"schema": schema, "table": name, "columns": cols})
+            self._bulk_insert_remote(client, schema, name, names, types,
+                                     data, valid)
 
     @staticmethod
     def _sql_literal(typ: str, v, valid: bool) -> str:
@@ -299,10 +353,19 @@ class Instance:
                         f"move {schema}.{name}: open transactions pin the "
                         f"source worker {src_addr}; retry later")
                 _time.sleep(0.05)
+            # delta window widened by a margin: a txn may DRAW its commit_ts
+            # before s0 yet stamp the worker's lanes after the phase-1 read
+            # (commit_ts issue and stamp application are not atomic).  The
+            # delta apply is idempotent (delete-by-PK before insert), so
+            # re-copying recent rows is safe; the margin only costs re-copy
+            # volume.  10 minutes of physical TSO covers any realistic
+            # prepare->stamp descheduling.
+            from galaxysql_tpu.meta.tso import LOGICAL_BITS
+            margin = 600_000 << LOGICAL_BITS  # 10 min of wall clock
             resp, arrs = src.request(
                 {"op": "exec_plan",
                  "fragment": {"schema": schema, "table": name,
-                              "columns": cols, "since": s0,
+                              "columns": cols, "since": max(s0 - margin, 0),
                               "deleted_since_of": pk}})
             ddata = {c: arrs[f"d::{c}"] for c in cols}
             dvalid = {c: arrs[f"v::{c}"] for c in cols if f"v::{c}" in arrs}

@@ -162,6 +162,8 @@ class Session:
         ast.CreateView: "CREATE", ast.DropView: "DROP",
         ast.CreateIndex: "INDEX", ast.DropIndex: "INDEX", ast.LoadData: "INSERT",
         ast.CreateDatabase: "CREATE", ast.DropDatabase: "DROP",
+        ast.CheckTable: "SELECT", ast.FlashbackTable: "CREATE",
+        ast.PurgeRecycleBin: "DROP", ast.AdviseIndex: "SELECT",
     }
 
     @staticmethod
@@ -243,6 +245,7 @@ class Session:
             self.instance.metadb.save_schema(stmt.name)
             return ok()
         if isinstance(stmt, ast.DropDatabase):
+            self.instance.recycle.purge_schema(stmt.name)
             self._drop_database(stmt)
             return ok()
         if isinstance(stmt, ast.UseDb):
@@ -268,6 +271,14 @@ class Session:
             return ok()
         if isinstance(stmt, ast.AnalyzeTable):
             return self._run_analyze(stmt)
+        if isinstance(stmt, ast.CheckTable):
+            return self._run_check_table(stmt)
+        if isinstance(stmt, ast.FlashbackTable):
+            return self._run_flashback_table(stmt)
+        if isinstance(stmt, ast.PurgeRecycleBin):
+            return self._run_purge(stmt)
+        if isinstance(stmt, ast.AdviseIndex):
+            return self._run_advise_index(stmt, params)
         if isinstance(stmt, ast.KillStmt):
             return ok(info="kill acknowledged")
         if isinstance(stmt, ast.BaselineStmt):
@@ -1118,9 +1129,48 @@ class Session:
         schema = self._require_schema()
         for name in stmt.names:
             s = name.schema or schema
+            recycle = self.instance.config.get("ENABLE_RECYCLEBIN", self.vars)
+            if recycle:
+                try:
+                    tm = self.instance.catalog.table(s, name.table)
+                except errors.TddlError:
+                    tm = None
+                if tm is not None and self.instance.recycle.drop(tm):
+                    continue  # parked in the bin (FLASHBACK can restore)
             if self.instance.catalog.drop_table(s, name.table, stmt.if_exists):
                 self.instance.drop_store(s, name.table)
         return ok()
+
+    def _run_check_table(self, stmt: ast.CheckTable) -> ResultSet:
+        from galaxysql_tpu.server.maintain import check_table
+        schema = self._require_schema()
+        rows = []
+        for name in stmt.names:
+            tm = self.instance.catalog.table(name.schema or schema, name.table)
+            store = self.instance.store(tm.schema, tm.name)
+            rows.extend(check_table(self.instance, tm, store))
+        return ResultSet(["Table", "Op", "Msg_type", "Msg_text"],
+                         [dt.VARCHAR] * 4, rows)
+
+    def _run_flashback_table(self, stmt: ast.FlashbackTable) -> ResultSet:
+        schema = stmt.name.schema or self._require_schema()
+        restored = self.instance.recycle.flashback(schema, stmt.name.table,
+                                                   stmt.rename_to)
+        return ok(info=f"restored as {restored}")
+
+    def _run_purge(self, stmt: ast.PurgeRecycleBin) -> ResultSet:
+        n = self.instance.recycle.purge(stmt.name)
+        return ok(affected=n)
+
+    def _run_advise_index(self, stmt: ast.AdviseIndex,
+                          params: Optional[list]) -> ResultSet:
+        from galaxysql_tpu.server.maintain import advise_indexes
+        schema = self._require_schema()
+        plan = self.instance.planner.bind_statement(stmt.select, schema,
+                                                    params or [], self)
+        rows = advise_indexes(self.instance, plan)
+        return ResultSet(["TABLE", "COLUMN", "REASON", "SUGGESTION"],
+                         [dt.VARCHAR] * 4, rows)
 
     def _run_truncate(self, stmt: ast.TruncateTable) -> ResultSet:
         schema = self._require_schema()

@@ -35,9 +35,15 @@ def handle(session, stmt: ast.Show):
         if not schema:
             raise errors.TddlError("No database selected")
         s = inst.catalog.schema(schema)
-        names = sorted(t.name for t in s.tables.values())
+        # recycled (dropped) tables are invisible here; SHOW RECYCLEBIN lists them
+        names = sorted(t.name for t in s.tables.values()
+                       if not t.name.startswith("__recycle__"))
         names = _like_filter(names, stmt.like)
         return ResultSet([f"Tables_in_{schema}"], [dt.VARCHAR], [(n,) for n in names])
+    if kind == "recyclebin":
+        rows = inst.recycle.rows()
+        return ResultSet(["NAME", "ORIGINAL_NAME", "SCHEMA_NAME", "DROP_TIME"],
+                         [dt.VARCHAR] * 4, rows)
     if kind == "columns":
         return session._describe(ast.TableName([stmt.target]))
     if kind == "binlog":
@@ -160,5 +166,20 @@ def handle(session, stmt: ast.Show):
                              [dt.VARCHAR] * 3,
                              [("TPU_COLUMNAR", "DEFAULT",
                                "Device-resident columnar engine")])
+        if kind == "collation":
+            # the enumerated handler registry (types/collation.py; reference
+            # *CollationHandler set) — charset = name prefix, MySQL layout
+            from galaxysql_tpu.types.collation import COLLATIONS
+            rows = []
+            names = _like_filter(sorted(COLLATIONS), stmt.like)
+            for name in names:
+                charset = name.split("_")[0] if "_" in name else name
+                rows.append((name, charset, "", "Yes" if name.endswith("_ci")
+                             else "", "Yes", 1))
+            return ResultSet(
+                ["Collation", "Charset", "Id", "Default", "Compiled",
+                 "Sortlen"],
+                [dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR,
+                 dt.BIGINT], rows)
         return ResultSet(["Variable_name", "Value"], [dt.VARCHAR, dt.VARCHAR], [])
     raise errors.NotSupportedError(f"SHOW {kind}")

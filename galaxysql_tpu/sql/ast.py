@@ -455,6 +455,33 @@ class AnalyzeTable(Statement):
 
 
 @dataclasses.dataclass
+class CheckTable(Statement):
+    """CHECK TABLE t1[, t2]: store integrity + base<->GSI consistency
+    (executor/corrector/Checker.java analog)."""
+    names: List[TableName]
+
+
+@dataclasses.dataclass
+class FlashbackTable(Statement):
+    """FLASHBACK TABLE t TO BEFORE DROP [RENAME TO x] (recycle-bin restore)."""
+    name: TableName
+    rename_to: Optional[str] = None
+
+
+@dataclasses.dataclass
+class PurgeRecycleBin(Statement):
+    """PURGE RECYCLEBIN (all) or PURGE TABLE <recycle-name> (one)."""
+    name: Optional[str] = None
+
+
+@dataclasses.dataclass
+class AdviseIndex(Statement):
+    """ADVISE INDEX <select>: suggest GSIs for the statement's predicates
+    (optimizer/index advisor analog)."""
+    select: Statement
+
+
+@dataclasses.dataclass
 class CreateIndex(Statement):
     index: IndexDef
     table: TableName

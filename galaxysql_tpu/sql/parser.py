@@ -124,6 +124,35 @@ class Parser:
             self.next()
             self.accept_kw("TABLE")
             return ast.TruncateTable(self._table_name())
+        if t.is_kw("CHECK"):
+            self.next()
+            self.expect_kw("TABLE")
+            names = [self._table_name()]
+            while self.accept_op(","):
+                names.append(self._table_name())
+            return ast.CheckTable(names)
+        if t.is_kw("FLASHBACK"):
+            self.next()
+            self.expect_kw("TABLE")
+            name = self._table_name()
+            self.expect_kw("TO")
+            self.expect_kw("BEFORE")
+            self.expect_kw("DROP")
+            rename_to = None
+            if self.accept_kw("RENAME"):
+                self.expect_kw("TO")
+                rename_to = self.expect_ident()
+            return ast.FlashbackTable(name, rename_to)
+        if t.is_kw("PURGE"):
+            self.next()
+            if self.accept_kw("RECYCLEBIN"):
+                return ast.PurgeRecycleBin()
+            self.expect_kw("TABLE")
+            return ast.PurgeRecycleBin(self.expect_ident())
+        if t.is_kw("ADVISE"):
+            self.next()
+            self.expect_kw("INDEX")
+            return ast.AdviseIndex(self._select_with_setops())
         if t.is_kw("USE"):
             self.next()
             return ast.UseDb(self.expect_ident())

@@ -1,0 +1,129 @@
+"""Maintenance surfaces: recycle bin, CHECK TABLE, index advisor, TSO batching.
+
+Reference analogs: recycle bin (`executor/.../recycle`), corrector
+(`executor/corrector/Checker.java`), index advisor
+(`polardbx-optimizer/.../optimizer/index`), batched TSO fetch
+(`ClusterTimestampOracle.java:109-133`).
+"""
+
+import numpy as np
+import pytest
+
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.utils import errors
+
+
+@pytest.fixture()
+def sess():
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE mt")
+    s.execute("USE mt")
+    s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v VARCHAR(10), n INT) "
+              "PARTITION BY HASH(id) PARTITIONS 4")
+    s.execute("INSERT INTO t VALUES (1,'a',10), (2,'b',20), (3,'c',30)")
+    return inst, s
+
+
+class TestRecycleBin:
+    def test_drop_flashback_roundtrip(self, sess):
+        inst, s = sess
+        s.execute("DROP TABLE t")
+        # gone from the visible namespace
+        assert s.execute("SHOW TABLES").rows == []
+        with pytest.raises(errors.TddlError):
+            s.execute("SELECT * FROM t")
+        # listed in the bin
+        bin_rows = s.execute("SHOW RECYCLEBIN").rows
+        assert len(bin_rows) == 1 and bin_rows[0][1] == "t"
+        # restore, data intact
+        s.execute("FLASHBACK TABLE t TO BEFORE DROP")
+        assert sorted(s.execute("SELECT id, v FROM t").rows) == \
+            [(1, "a"), (2, "b"), (3, "c")]
+        assert s.execute("SHOW RECYCLEBIN").rows == []
+
+    def test_flashback_rename_and_name_conflict(self, sess):
+        inst, s = sess
+        s.execute("DROP TABLE t")
+        s.execute("CREATE TABLE t (id BIGINT)")  # original name reused
+        with pytest.raises(errors.TddlError, match="already exists"):
+            s.execute("FLASHBACK TABLE t TO BEFORE DROP")
+        s.execute("FLASHBACK TABLE t TO BEFORE DROP RENAME TO t_old")
+        assert sorted(s.execute("SELECT v FROM t_old").rows) == \
+            [("a",), ("b",), ("c",)]
+
+    def test_purge(self, sess):
+        inst, s = sess
+        s.execute("DROP TABLE t")
+        name = s.execute("SHOW RECYCLEBIN").rows[0][0]
+        assert s.execute(f"PURGE TABLE {name}").affected == 1
+        assert s.execute("SHOW RECYCLEBIN").rows == []
+        with pytest.raises(errors.TddlError):
+            s.execute("FLASHBACK TABLE t TO BEFORE DROP")
+        # purge everything form
+        s.execute("CREATE TABLE p2 (id BIGINT)")
+        s.execute("DROP TABLE p2")
+        assert s.execute("PURGE RECYCLEBIN").affected == 1
+
+    def test_gsi_tables_drop_directly(self, sess):
+        inst, s = sess
+        s.execute("CREATE TABLE g (id BIGINT PRIMARY KEY, k INT) "
+                  "PARTITION BY HASH(id) PARTITIONS 2")
+        s.execute("CREATE GLOBAL INDEX gk ON g (k)")
+        s.execute("DROP TABLE g")
+        # not recyclable (backing table lifecycle), like the reference
+        assert all(r[1] != "g" for r in s.execute("SHOW RECYCLEBIN").rows)
+
+    def test_disabled_by_config(self, sess):
+        inst, s = sess
+        s.execute("SET ENABLE_RECYCLEBIN = false")
+        s.execute("DROP TABLE t")
+        assert s.execute("SHOW RECYCLEBIN").rows == []
+
+
+class TestCheckTable:
+    def test_ok_and_gsi_divergence(self, sess):
+        inst, s = sess
+        s.execute("CREATE GLOBAL INDEX gn ON t (n)")
+        r = s.execute("CHECK TABLE t")
+        assert any(row[3] == "OK" for row in r.rows), r.rows
+        # corrupt the GSI store directly -> divergence reported
+        gstore = inst.store("mt", "t$gn")
+        part = next(p for p in gstore.partitions if p.num_rows)
+        part.delete_rows(np.array([0]), inst.tso.next_timestamp())
+        r = s.execute("CHECK TABLE t")
+        assert any("diverges" in str(row[3]) for row in r.rows), r.rows
+
+
+class TestAdviseIndex:
+    def test_suggests_gsi_for_unserved_eq(self, sess):
+        inst, s = sess
+        r = s.execute("ADVISE INDEX SELECT v FROM t WHERE n = 20")
+        assert len(r.rows) == 1
+        tname, col, why, sugg = r.rows[0]
+        assert (tname, col) == ("t", "n")
+        assert sugg.startswith("CREATE GLOBAL INDEX g_n ON t (n)")
+        assert "COVERING" in sugg and "v" in sugg
+        # the suggestion is executable and then routes the query
+        s.execute(sugg)
+        plan = "\n".join(x[0] for x in
+                         s.execute("EXPLAIN SELECT v FROM t WHERE n = 20").rows)
+        assert "t$g_n" in plan, plan
+
+    def test_no_suggestion_when_served(self, sess):
+        inst, s = sess
+        r = s.execute("ADVISE INDEX SELECT v FROM t WHERE id = 1")
+        assert r.rows == []  # PK lead already serves it
+
+
+class TestTsoBatch:
+    def test_batch_is_monotone_and_disjoint(self):
+        from galaxysql_tpu.meta.tso import TimestampOracle
+        tso = TimestampOracle()
+        a = tso.next_timestamp()
+        batch = tso.next_timestamps(1000)
+        b = tso.next_timestamp()
+        assert len(set(batch)) == 1000
+        assert batch == sorted(batch)
+        assert a < batch[0] and batch[-1] < b
