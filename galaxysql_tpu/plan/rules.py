@@ -286,86 +286,150 @@ def build_join_tree(node: L.RelNode, spm=None) -> L.RelNode:
         if f is not None and sorted(f) == sorted(labels):
             forced_seq = list(f)
 
-    def greedy_label_order() -> Tuple[str, ...]:
-        """What the cost model would pick today (estimates only, no tree) —
-        compared against a followed baseline to detect cost-model drift."""
-        rem = set(range(len(relinfos)))
-        members = set()
-        out = []
-        cur = min(rem, key=lambda i: relinfos[i].est_rows)
-        members.add(cur)
-        rem.discard(cur)
-        out.append(labels[cur])
-        while rem:
-            cands = [i for i in rem if any(
-                (a in members and b == i) or (b in members and a == i)
-                for a, b, _, _ in edges)]
-            nxt = min(cands or rem, key=lambda i: relinfos[i].est_rows)
-            members.add(nxt)
-            rem.discard(nxt)
-            out.append(labels[nxt])
-        return tuple(out)
     by_label: Dict[str, List[int]] = {}
     for i, lab in enumerate(labels):
         by_label.setdefault(lab, []).append(i)
 
-    remaining = set(range(len(relinfos)))
+    # field id -> (TableMeta, column) for NDV-backed join cardinalities
+    resolver: Dict[str, Tuple] = {}
+    for r in rels:
+        resolver.update(_stats_resolver(r))
 
-    def take(lab: str) -> int:
-        for i in by_label[lab]:
-            if i in remaining:
-                return i
-        raise KeyError(lab)
+    def _ndv_of(e: ir.Expr, side_est: float) -> float:
+        if isinstance(e, ir.ColRef):
+            tmcol = resolver.get(e.name)
+            if tmcol is not None:
+                ndv = tmcol[0].stats.ndv.get(tmcol[1]) or \
+                    tmcol[0].stats.ndv.get(tmcol[0].column(tmcol[1]).name, 0)
+                if ndv:
+                    return float(ndv)
+        return side_est  # no stats: V(R, a) ~ |R| (FK assumption)
 
-    if forced_seq is not None:
-        start = take(forced_seq[0])
-    else:
-        start = min(remaining, key=lambda i: relinfos[i].est_rows)
-    current = relinfos[start]
-    remaining.discard(start)
-    current_members = {start}
-    chosen = [labels[start]]
+    def join_est(ca: "_Rel", cb: "_Rel", pair_edges) -> float:
+        """System-R cardinality: |A||B| / prod(max(V(A,a), V(B,b))) — the
+        formula that makes a many-to-many low-NDV edge (s_nationkey =
+        c_nationkey: 25 distinct values) cost its real blowup instead of the
+        FK max(l, r) guess (reference: the CBO's mq.getRowCount join logic)."""
+        est = ca.est_rows * cb.est_rows
+        for ea, eb in pair_edges:
+            est /= max(_ndv_of(ea, ca.est_rows), _ndv_of(eb, cb.est_rows), 1.0)
+        return max(est, 1.0)
+
     used_edges: Set[int] = set()
+    chosen: List[str] = []
 
-    def connected(i: int) -> bool:
-        return any((a in current_members and b == i) or (b in current_members and a == i)
-                   for a, b, _, _ in edges)
-
-    while remaining:
-        if forced_seq is not None:
-            nxt = take(forced_seq[len(chosen)])
-        else:
-            candidates = [i for i in remaining if connected(i)]
-            pool = candidates or remaining
-            nxt = min(pool, key=lambda i: relinfos[i].est_rows)
-        chosen.append(labels[nxt])
+    def merge(ca: "_Rel", cb: "_Rel", a_members: Set[int],
+              b_members: Set[int]) -> "_Rel":
         eq_pairs: List[Tuple[ir.Expr, ir.Expr]] = []
         for k, (a, b, ea, eb) in enumerate(edges):
             if k in used_edges:
                 continue
-            if a in current_members and b == nxt:
+            if a in a_members and b in b_members:
                 eq_pairs.append((ea, eb))
                 used_edges.add(k)
-            elif b in current_members and a == nxt:
+            elif b in a_members and a in b_members:
                 eq_pairs.append((eb, ea))
                 used_edges.add(k)
-        rel = relinfos[nxt]
         if not eq_pairs:
-            current = _Rel(L.Join(current.node, rel.node, "cross", []),
-                           current.ids | rel.ids,
-                           current.est_rows * rel.est_rows)
-        else:
-            # probe side = current accumulated tree, build = the joined-in relation if
-            # it is smaller; physical layer finalizes sides, logical Join is
-            # (left=probe-ish)
-            current = _Rel(L.Join(current.node, rel.node, "inner", eq_pairs),
-                           current.ids | rel.ids, max(current.est_rows, rel.est_rows))
-        current_members.add(nxt)
-        remaining.discard(nxt)
+            return _Rel(L.Join(ca.node, cb.node, "cross", []),
+                        ca.ids | cb.ids, ca.est_rows * cb.est_rows)
+        return _Rel(L.Join(ca.node, cb.node, "inner", eq_pairs),
+                    ca.ids | cb.ids, join_est(ca, cb, eq_pairs))
+
+    def goo_plan() -> Tuple[List[Tuple[Set[int], Set[int]]], Tuple[str, ...]]:
+        """Greedy operator ordering (GOO): repeatedly merge the component PAIR
+        with the smallest estimated join output.  Unlike left-deep growth from
+        the smallest relation, this does not trap dimension chains into m:n
+        edges (TPC-H Q5's nation-keyed supplier x customer).
+
+        Pure planning over estimate floats and a SCRATCH edge set — returns
+        the merge steps (as member-set pairs, smaller-est side first) plus the
+        label order.  The tree build replays the steps; drift detection uses
+        just the labels — one selection loop serves both."""
+        sim_used: Set[int] = set()
+        comps = [(relinfos[i].est_rows, {i}, [labels[i]])
+                 for i in range(len(relinfos))]
+        steps: List[Tuple[Set[int], Set[int]]] = []
+        while len(comps) > 1:
+            best = None
+            for x in range(len(comps)):
+                for y in range(x + 1, len(comps)):
+                    pe = []
+                    for k, (a, b, ea, eb) in enumerate(edges):
+                        if k in sim_used:
+                            continue
+                        if (a in comps[x][1] and b in comps[y][1]) or \
+                                (b in comps[x][1] and a in comps[y][1]):
+                            pe.append((ea, eb) if a in comps[x][1]
+                                      else (eb, ea))
+                    if not pe:
+                        continue
+                    est = comps[x][0] * comps[y][0]
+                    for ea, eb in pe:
+                        est /= max(_ndv_of(ea, comps[x][0]),
+                                   _ndv_of(eb, comps[y][0]), 1.0)
+                    if best is None or est < best[0]:
+                        best = (max(est, 1.0), x, y)
+            if best is None:
+                # no joinable pair left: cross the two smallest components
+                order = sorted(range(len(comps)), key=lambda i: comps[i][0])
+                x, y = min(order[0], order[1]), max(order[0], order[1])
+                best = (comps[x][0] * comps[y][0], x, y)
+            est, x, y = best
+            for k, (a, b, _ea, _eb) in enumerate(edges):
+                if k in sim_used:
+                    continue
+                if (a in comps[x][1] and b in comps[y][1]) or \
+                        (b in comps[x][1] and a in comps[y][1]):
+                    sim_used.add(k)
+            if comps[y][0] < comps[x][0]:
+                x, y = y, x  # smaller side leads (label-order convention)
+            _e, ma, la = comps[x]
+            _e2, mb, lb = comps[y]
+            steps.append((set(ma), set(mb)))
+            comps = [c for i, c in enumerate(comps) if i not in (x, y)]
+            comps.append((est, ma | mb, la + lb))
+        return steps, tuple(comps[0][2])
+
+    if forced_seq is not None:
+        # SPM baseline: replay the pinned order verbatim as a left-deep chain
+        # (the accepted plan's identity is its member order)
+        remaining = set(range(len(relinfos)))
+
+        def take(lab: str) -> int:
+            for i in by_label[lab]:
+                if i in remaining:
+                    return i
+            raise KeyError(lab)
+
+        start = take(forced_seq[0])
+        current = relinfos[start]
+        remaining.discard(start)
+        members = {start}
+        chosen.append(labels[start])
+        while remaining:
+            nxt = take(forced_seq[len(chosen)])
+            chosen.append(labels[nxt])
+            current = merge(current, relinfos[nxt], members, {nxt})
+            members.add(nxt)
+            remaining.discard(nxt)
+        cost_pref = goo_plan()[1]
+    else:
+        steps, order = goo_plan()
+        nodes: Dict[frozenset, "_Rel"] = {
+            frozenset({i}): relinfos[i] for i in range(len(relinfos))}
+        for ma, mb in steps:
+            ca = nodes.pop(frozenset(ma))
+            cb = nodes.pop(frozenset(mb))
+            # merge() consumes real used_edges in the same sequence the
+            # planning pass simulated, so edge bookkeeping stays in lockstep
+            nodes[frozenset(ma | mb)] = merge(ca, cb, ma, mb)
+        current = next(iter(nodes.values()))
+        chosen = list(order)
+        cost_pref = order
     if spm_active:
         spm.chosen.append(tuple(chosen))
-        spm.cost_preferred.append(
-            greedy_label_order() if forced_seq is not None else tuple(chosen))
+        spm.cost_preferred.append(cost_pref)
 
     # any edges between already-joined members that were not consumed become filters
     for k, (a, b, ea, eb) in enumerate(edges):

@@ -214,14 +214,20 @@ class Instance:
                 f"backfill=True to rebuild it")
         if backfill is None:
             backfill = self._replica_needs_backfill(client, schema, name)
-        if backfill:
-            self._backfill_replica(client, schema, name)
-        if entry is not None:
-            entry["weight"] = weight
-            entry["stale"] = False
-            return tm
-        tm.replicas.append({"host": host, "port": port, "weight": weight,
-                            "stale": False})
+        # the copy AND the routing registration sit under one EXCLUSIVE MDL:
+        # a write committing between the snapshot read and registration would
+        # otherwise reach only the primary — a replica registered one row
+        # short serves wrong reads forever (writes replicate per-statement to
+        # replicas registered at statement time, session._remote_dml)
+        with self.mdl.exclusive(f"{schema.lower()}.{name.lower()}"):
+            if backfill:
+                self._backfill_replica(client, schema, name)
+            if entry is not None:
+                entry["weight"] = weight
+                entry["stale"] = False
+                return tm
+            tm.replicas.append({"host": host, "port": port, "weight": weight,
+                                "stale": False})
         return tm
 
     def _replica_needs_backfill(self, client, schema: str, name: str) -> bool:
@@ -248,11 +254,11 @@ class Instance:
         client.execute(
             f"CREATE TABLE IF NOT EXISTS {name} ({cols_sql}{pk_sql})", schema)
         cols = tm.column_names()
-        with self.mdl.shared({f"{schema.lower()}.{name.lower()}"}):
-            names, types, data, valid = src.exec_plan(
-                {"schema": schema, "table": name, "columns": cols})
-            self._bulk_insert_remote(client, schema, name, names, types,
-                                     data, valid)
+        # caller (attach_replica) holds the exclusive MDL: no concurrent DML
+        names, types, data, valid = src.exec_plan(
+            {"schema": schema, "table": name, "columns": cols})
+        self._bulk_insert_remote(client, schema, name, names, types,
+                                 data, valid)
 
     @staticmethod
     def _sql_literal(typ: str, v, valid: bool) -> str:

@@ -14,6 +14,7 @@ Reference analogs:
 
 from __future__ import annotations
 
+import itertools
 import json
 import time
 from typing import List, Optional
@@ -21,6 +22,10 @@ from typing import List, Optional
 from galaxysql_tpu.utils import errors
 
 _BIN_PREFIX = "recycle.bin."
+# monotonic disambiguator: two drops of a same-named table in the same
+# millisecond must NOT collide (a collision would overwrite — and lose — the
+# previously parked table)
+_BIN_SEQ = itertools.count(1)
 
 
 class RecycleBin:
@@ -51,7 +56,8 @@ class RecycleBin:
                 any(i.global_index for i in tm.indexes):
             return False
         inst = self.instance
-        bin_name = f"__recycle__{tm.name}_{int(time.time() * 1000)}"
+        bin_name = (f"__recycle__{tm.name}_{int(time.time() * 1000)}"
+                    f"_{next(_BIN_SEQ)}")
         cat = inst.catalog
         s = cat.schema(tm.schema)
         store = inst.store(tm.schema, tm.name)

@@ -118,6 +118,10 @@ class Session:
 
     def execute_all(self, sql: str, params: Optional[list] = None) -> List[ResultSet]:
         """Run every statement, returning each result (the wire protocol sends all)."""
+        if ";" not in sql:
+            # single statement: skip the tokenizing splitter (TP point-query
+            # latency — the split exists only to find ';' outside literals)
+            return [self._execute_one(sql, params)] if sql.strip() else [ok()]
         stmts = split_statements(sql)
         return [self._execute_one(s, params) for s in stmts] if stmts else [ok()]
 
@@ -1147,6 +1151,10 @@ class Session:
         rows = []
         for name in stmt.names:
             tm = self.instance.catalog.table(name.schema or schema, name.table)
+            if getattr(tm, "remote", None) is not None:
+                raise errors.NotSupportedError(
+                    f"CHECK TABLE on worker-resident table '{tm.name}' is not "
+                    "supported from this CN (run it on the worker)")
             store = self.instance.store(tm.schema, tm.name)
             rows.extend(check_table(self.instance, tm, store))
         return ResultSet(["Table", "Op", "Msg_type", "Msg_text"],
@@ -1267,8 +1275,17 @@ class Session:
         plan = self.instance.planner.bind_statement(inner, schema, params or [])
         lines = plan.explain().split("\n")
         if stmt.analyze:
+            cache = None
+            if plan.workload == "AP" and self.instance.config.get(
+                    "ENABLE_TPU_ENGINE", self.vars):
+                from galaxysql_tpu.exec.device_cache import GLOBAL_DEVICE_CACHE
+                cache = GLOBAL_DEVICE_CACHE
+            # same engine configuration as the real execution path — analyze
+            # numbers must describe the plan users actually run (device cache
+            # included), not a cold host-only variant
             ctx = ExecContext(self.instance.stores, self._snapshot_ts(),
-                              params or [], archive=self.instance.archive,
+                              params or [], device_cache=cache,
+                              archive=self.instance.archive,
                               archive_instance=self.instance)
             ctx.collect_stats = True  # per-operator rows/time (RuntimeStatistics)
             op = build_operator(plan.rel, ctx)

@@ -168,18 +168,35 @@ def handle(session, stmt: ast.Show):
                                "Device-resident columnar engine")])
         if kind == "collation":
             # the enumerated handler registry (types/collation.py; reference
-            # *CollationHandler set) — charset = name prefix, MySQL layout
+            # *CollationHandler set) — charset = name prefix, MySQL layout.
+            # Default marks THE default collation of each charset (MySQL 8.0
+            # defaults), not case-insensitivity.
             from galaxysql_tpu.types.collation import COLLATIONS
+            defaults = {"utf8mb4": "utf8mb4_0900_ai_ci",
+                        "utf8": "utf8_general_ci",
+                        "utf8mb3": "utf8mb3_general_ci",
+                        "latin1": "latin1_swedish_ci",
+                        "ascii": "ascii_general_ci",
+                        "gbk": "gbk_chinese_ci",
+                        "big5": "big5_chinese_ci",
+                        "gb18030": "gb18030_chinese_ci",
+                        "utf16": "utf16_general_ci",
+                        "utf32": "utf32_general_ci",
+                        "ucs2": "ucs2_general_ci",
+                        "binary": "binary"}
             rows = []
             names = _like_filter(sorted(COLLATIONS), stmt.like)
-            for name in names:
+            for i, name in enumerate(sorted(COLLATIONS), 1):
+                if name not in names:
+                    continue
                 charset = name.split("_")[0] if "_" in name else name
-                rows.append((name, charset, "", "Yes" if name.endswith("_ci")
-                             else "", "Yes", 1))
+                rows.append((name, charset, i,
+                             "Yes" if defaults.get(charset) == name else "",
+                             "Yes", 1))
             return ResultSet(
                 ["Collation", "Charset", "Id", "Default", "Compiled",
                  "Sortlen"],
-                [dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR,
+                [dt.VARCHAR, dt.VARCHAR, dt.BIGINT, dt.VARCHAR, dt.VARCHAR,
                  dt.BIGINT], rows)
         return ResultSet(["Variable_name", "Value"], [dt.VARCHAR, dt.VARCHAR], [])
     raise errors.NotSupportedError(f"SHOW {kind}")
