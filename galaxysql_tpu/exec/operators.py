@@ -1083,34 +1083,53 @@ class HashJoinOp(Operator):
                         ver &= bd[b_of] == pd[p_of]
                     if not ver.all():
                         b_of, p_of = b_of[ver], p_of[ver]
-            fast_semi = res_np is None and self.join_type in ("semi", "anti")
-            if not fast_semi:
-                n = b_of.shape[0]
-                cols: Dict[str, Column] = {}
+            n = b_of.shape[0]
+            keep = None
+            if res_np is not None and n:
+                # residual evaluated over PLAIN n-sized gathers (the padded
+                # output lanes are only built for inner/left below)
+                env = {}
                 for name, c in build_batch.columns.items():
-                    cols[name] = Column(
-                        c.np_data()[b_of],
-                        c.np_valid()[b_of] if c.valid is not None else None,
-                        c.dtype, c.dictionary)
+                    env[name] = (c.np_data()[b_of],
+                                 c.np_valid()[b_of] if c.valid is not None
+                                 else None)
                 for name, c in pb.columns.items():
-                    cols[name] = Column(
-                        c.np_data()[p_of],
-                        c.np_valid()[p_of] if c.valid is not None else None,
-                        c.dtype, c.dictionary)
-                keep = None
-                if res_np is not None and n:
-                    env = {nm: (cc.data, cc.valid) for nm, cc in cols.items()}
-                    keep = np.broadcast_to(np.asarray(res_np(env)), (n,))
+                    env[name] = (c.np_data()[p_of],
+                                 c.np_valid()[p_of] if c.valid is not None
+                                 else None)
+                keep = np.broadcast_to(np.asarray(res_np(env)), (n,))
             if self.join_type in ("semi", "anti"):
                 matched = np.zeros(pb.capacity, dtype=np.bool_)
-                sel = p_of if res_np is None else p_of[keep]
+                sel = p_of if keep is None else p_of[keep]
                 matched[sel] = True
                 live = p_live_mask & (matched if self.join_type == "semi"
                                       else ~matched)
                 yield ColumnBatch(pb.columns, live)
                 continue
-            out = ColumnBatch(cols, keep)
-            yield out.pad_to(bucket_capacity(max(n, 1)))
+            cap = bucket_capacity(max(n, 1))
+
+            def gather_padded(c: Column, idx) -> Column:
+                # gather STRAIGHT into the bucket-padded buffer: a plain
+                # fancy-index + pad_to would copy every lane twice
+                src = c.np_data()
+                data = np.zeros(cap, dtype=src.dtype)
+                if n:
+                    np.take(src, idx, out=data[:n])
+                valid = None
+                if c.valid is not None:
+                    valid = np.zeros(cap, dtype=np.bool_)
+                    if n:
+                        np.take(c.np_valid(), idx, out=valid[:n])
+                return Column(data, valid, c.dtype, c.dictionary)
+
+            cols: Dict[str, Column] = {}
+            for name, c in build_batch.columns.items():
+                cols[name] = gather_padded(c, b_of)
+            for name, c in pb.columns.items():
+                cols[name] = gather_padded(c, p_of)
+            live_out = np.zeros(cap, dtype=np.bool_)
+            live_out[:n] = True if keep is None else keep
+            yield ColumnBatch(cols, live_out)
             if self.join_type == "left":
                 matched = np.zeros(pb.capacity, dtype=np.bool_)
                 matched[p_of if keep is None else p_of[keep]] = True

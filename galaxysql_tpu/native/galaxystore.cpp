@@ -134,16 +134,31 @@ size_t gx_join_probe_k1(const int64_t* keys, const uint8_t* live, size_t npr,
                         const int64_t* build_keys,
                         const int32_t* heads, size_t M, const int32_t* next,
                         int32_t* out_b, int32_t* out_p, size_t cap) {
+    // blocked probe: slots for a block are computed (and their head entries
+    // prefetched) before any chain walk — the walk's random L2 misses then
+    // overlap instead of serializing on the mix64+load dependency chain
+    enum { B = 64 };
     const uint64_t mask = (uint64_t)M - 1;
+    uint32_t slot[B];
     size_t o = 0;
-    for (size_t i = 0; i < npr; i++) {
-        if (!live[i]) continue;
-        const int64_t k = keys[i];
-        for (int32_t j = heads[(size_t)(mix64((uint64_t)k) & mask)]; j >= 0;
-             j = next[j]) {
-            if (build_keys[j] == k) {
-                if (o < cap) { out_b[o] = j; out_p[o] = (int32_t)i; }
-                o++;
+    for (size_t base = 0; base < npr; base += B) {
+        const size_t hi = (base + B < npr) ? base + B : npr;
+        for (size_t i = base; i < hi; i++) {
+            // slot computed unconditionally (a dead-row SENTINEL would
+            // collide with a real slot at M == 2^32); deadness re-checks
+            // live[] in the walk loop
+            uint32_t s = (uint32_t)(mix64((uint64_t)keys[i]) & mask);
+            slot[i - base] = s;
+            if (live[i]) __builtin_prefetch(&heads[s], 0, 1);
+        }
+        for (size_t i = base; i < hi; i++) {
+            if (!live[i]) continue;
+            const int64_t k = keys[i];
+            for (int32_t j = heads[slot[i - base]]; j >= 0; j = next[j]) {
+                if (build_keys[j] == k) {
+                    if (o < cap) { out_b[o] = j; out_p[o] = (int32_t)i; }
+                    o++;
+                }
             }
         }
     }
@@ -158,16 +173,25 @@ size_t gx_join_probe_k1_idx(const int64_t* keys, const int32_t* ids,
                             const int32_t* heads, size_t M,
                             const int32_t* next,
                             int32_t* out_b, int32_t* out_p, size_t cap) {
+    enum { B = 64 };
     const uint64_t mask = (uint64_t)M - 1;
+    uint32_t slot[B];
     size_t o = 0;
-    for (size_t t = 0; t < n_ids; t++) {
-        const int32_t i = ids[t];
-        const int64_t k = keys[i];
-        for (int32_t j = heads[(size_t)(mix64((uint64_t)k) & mask)]; j >= 0;
-             j = next[j]) {
-            if (build_keys[j] == k) {
-                if (o < cap) { out_b[o] = j; out_p[o] = i; }
-                o++;
+    for (size_t base = 0; base < n_ids; base += B) {
+        const size_t hi = (base + B < n_ids) ? base + B : n_ids;
+        for (size_t t = base; t < hi; t++) {
+            uint32_t s = (uint32_t)(mix64((uint64_t)keys[ids[t]]) & mask);
+            slot[t - base] = s;
+            __builtin_prefetch(&heads[s], 0, 1);
+        }
+        for (size_t t = base; t < hi; t++) {
+            const int32_t i = ids[t];
+            const int64_t k = keys[i];
+            for (int32_t j = heads[slot[t - base]]; j >= 0; j = next[j]) {
+                if (build_keys[j] == k) {
+                    if (o < cap) { out_b[o] = j; out_p[o] = i; }
+                    o++;
+                }
             }
         }
     }
