@@ -188,11 +188,13 @@ class FilterOp(Operator):
         def build():
             pred = ExprCompiler(jnp, lift=lift).compile_predicate(self.predicate)
 
-            def run(batch: ColumnBatch, lits) -> ColumnBatch:
+            def run(batch: ColumnBatch, lits):
                 env = batch_env(batch)
                 env["$lits"] = lits
-                mask = pred(env)
-                return ColumnBatch(batch.columns, batch.live_mask() & mask)
+                # return the MASK only: passing columns through the jit would
+                # make them XLA outputs, copying every lane (50MB/column at
+                # SF1) — the caller reattaches the ORIGINAL column buffers
+                return batch.live_mask() & pred(env)
             return jax.jit(run)
         key = ("filter", tkeys if tkeys is not None
                else expr_cache_key(self.predicate))
@@ -231,7 +233,7 @@ class FilterOp(Operator):
                 continue
             if f is None:
                 f, lits = self._compiled()
-            yield f(b, lits)
+            yield ColumnBatch(b.columns, f(b, lits))
 
 
 class ProjectOp(Operator):
