@@ -1,0 +1,19 @@
+# Convenience targets; CI drives the same commands.
+
+PY ?= python
+
+# full tier-1 gate (ROADMAP.md)
+tier1:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+# fast fusion smoke: TPC-H Q1/Q3 (+ SSB/TPC-DS fixtures) through BOTH the
+# fused and unfused execution paths, asserting identical results — guards the
+# pipeline segment fuser without paying for the whole suite
+fusion-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m fusion -p no:cacheprovider
+
+bench:
+	$(PY) bench.py
+
+.PHONY: tier1 fusion-smoke bench

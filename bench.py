@@ -244,14 +244,96 @@ def kernel_microbench(data, platform: str, runs: int):
     }
 
 
+def dispatch_microbench(runs: int):
+    """Per-batch dispatch overhead: a filter→project chain over B device
+    batches, stacked per-operator programs vs ONE fused segment program.
+
+    Reports fused ms/batch; vs_baseline = unfused/fused wall ratio; plus the
+    measured streaming-program dispatch counts per batch for both shapes (the
+    number the fusion PR moves: 2 dispatches/batch -> 1)."""
+    import jax.numpy as jnp
+    from galaxysql_tpu.chunk.batch import Column, ColumnBatch
+    from galaxysql_tpu.exec import operators as ops
+    from galaxysql_tpu.exec.fusion import FusedPipelineOp, FusedSegment
+    from galaxysql_tpu.exec.operators import FilterOp, ProjectOp, SourceOp
+    from galaxysql_tpu.expr import ir
+    from galaxysql_tpu.types import datatype as dt
+
+    B, n = 32, 1 << 17  # device path (capacity > TP_HOST_ROWS)
+    rng = np.random.default_rng(7)
+    batches = []
+    for _ in range(B):
+        a = jnp.asarray(rng.integers(0, 1 << 20, n))
+        b = jnp.asarray(rng.random(n))
+        batches.append(ColumnBatch({"a": Column(a, None, dt.BIGINT, None),
+                                    "b": Column(b, None, dt.DOUBLE, None)}, None))
+    ca = ir.ColRef("a", dt.BIGINT, None)
+    cb = ir.ColRef("b", dt.DOUBLE, None)
+    pred = ir.call("lt", ca, ir.lit(1 << 19))
+    projs = [("c", ir.call("mul", cb, ir.lit(2.0))), ("a", ca)]
+
+    def drain(op):
+        last = None
+        for out in op.batches():
+            last = out.live_mask()
+        jax.block_until_ready(last)
+
+    def timed(make):
+        drain(make())  # warmup: compile
+        ops.reset_dispatch_stats()
+        drain(make())
+        d_per_batch = ops.DISPATCH_STATS["dispatches"] / B
+        best = None
+        for _ in range(max(runs, 3)):
+            t0 = time.perf_counter()
+            drain(make())
+            el = time.perf_counter() - t0
+            best = el if best is None or el < best else best
+        return best / B, d_per_batch
+
+    # both shapes construct their operators inside the timed drain, so each
+    # side pays its own per-execution setup (expression walks, cache-key
+    # resolution) and the ratio isolates the per-batch dispatch difference
+    unfused_ms, unfused_d = timed(
+        lambda: ProjectOp(FilterOp(SourceOp(batches), pred), projs))
+    fused_ms, fused_d = timed(lambda: FusedPipelineOp(
+        SourceOp(batches),
+        FusedSegment([("filter", pred), ("project", list(projs))])))
+    return {
+        "metric": "pipeline_fused_dispatch_ms_per_batch",
+        "value": round(fused_ms * 1000, 4), "unit": "ms/batch",
+        "vs_baseline": round(unfused_ms / fused_ms, 3),
+        "fused_dispatches_per_batch": fused_d,
+        "unfused_dispatches_per_batch": unfused_d,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def _bench_query(s, q, runs):
+    best, _d = _bench_query_d(s, q, runs)
+    return best
+
+
+def _bench_query_d(s, q, runs):
+    """(best wall seconds, steady-state streaming dispatches per execution).
+
+    The dispatch count is the number the fusion pass moves (deterministic,
+    unlike wall time on a shared host): one streaming-program invocation per
+    batch per segment — an XLA dispatch on the device path, a host-np program
+    call on the TP path."""
+    from galaxysql_tpu.exec import operators as _ops
     s.execute(q)  # warmup: compile + populate device cache
     times = []
-    for _ in range(runs):
+    _ops.reset_dispatch_stats()
+    t0 = time.perf_counter()
+    s.execute(q)
+    times.append(time.perf_counter() - t0)
+    dispatches = _ops.DISPATCH_STATS["dispatches"]
+    for _ in range(runs - 1):
         t0 = time.perf_counter()
         s.execute(q)
         times.append(time.perf_counter() - t0)
-    return min(times)
+    return min(times), dispatches
 
 
 def main():
@@ -281,28 +363,34 @@ def main():
         base_lats.append(time.perf_counter() - t0)
     lat = sorted(lats)[len(lats) // 2]
     base_lat = sorted(base_lats)[len(base_lats) // 2]
+    from galaxysql_tpu.exec import operators as _ops
+    _ops.reset_dispatch_stats()
+    s.execute(point % probe_keys[0])
     results.append({
         "metric": f"tp_point_select_p50_latency_sf{sf:g}",
         "value": round(lat * 1000, 3), "unit": "ms",
         "vs_baseline": round(base_lat / lat, 3), "platform": platform,
+        "dispatches_per_exec": _ops.DISPATCH_STATS["dispatches"],
     })
 
     # -- TPC-H Q3: 3-way join + high-NDV agg + top-n ---------------------------
-    q3_best = _bench_query(s, QUERIES[3], runs)
+    q3_best, q3_d = _bench_query_d(s, QUERIES[3], runs)
     q3_base = min(pandas_q3(data)[0] for _ in range(runs))
     results.append({
         "metric": f"tpch_q3_sf{sf:g}_rows_per_sec_per_chip",
         "value": round(n_rows / q3_best, 1), "unit": "rows/s",
         "vs_baseline": round(q3_base / q3_best, 3), "platform": platform,
+        "dispatches_per_exec": q3_d,
     })
 
     # -- TPC-H Q5: 6-way shuffle join (config 3) -------------------------------
-    q5_best = _bench_query(s, QUERIES[5], runs)
+    q5_best, q5_d = _bench_query_d(s, QUERIES[5], runs)
     q5_base = min(pandas_q5(data)[0] for _ in range(runs))
     results.append({
         "metric": f"tpch_q5_sf{sf:g}_rows_per_sec_per_chip",
         "value": round(n_rows / q5_best, 1), "unit": "rows/s",
         "vs_baseline": round(q5_base / q5_best, 3), "platform": platform,
+        "dispatches_per_exec": q5_d,
     })
 
     # -- TPC-DS q7: 5-way star join + 4 avgs (config 5) ------------------------
@@ -316,13 +404,14 @@ def main():
             inst.store("tpcds", t).insert_pylists(ddata[t],
                                                   inst.tso.next_timestamp())
         s.execute("ANALYZE TABLE " + ", ".join(tpcds.TABLE_ORDER))
-        ds_best = _bench_query(s, tpcds.QUERIES["q7"], runs)
+        ds_best, ds_d = _bench_query_d(s, tpcds.QUERIES["q7"], runs)
         ds_base = min(pandas_ds_q7(ddata)[0] for _ in range(runs))
         n_ss = len(ddata["store_sales"]["ss_item_sk"])
         results.append({
             "metric": f"tpcds_q7_sf{sf / 2:g}_rows_per_sec_per_chip",
             "value": round(n_ss / ds_best, 1), "unit": "rows/s",
             "vs_baseline": round(ds_base / ds_best, 3), "platform": platform,
+            "dispatches_per_exec": ds_d,
         })
         s.execute("USE tpch")
 
@@ -337,7 +426,7 @@ def main():
             inst.store("ssb", t).insert_arrays(sdata[t],
                                                inst.tso.next_timestamp())
         s.execute("ANALYZE TABLE " + ", ".join(ssb.TABLE_ORDER))
-        ssb_best = _bench_query(s, ssb.QUERIES["1.1"], runs)
+        ssb_best, ssb_d = _bench_query_d(s, ssb.QUERIES["1.1"], runs)
 
         def pandas_ssb(d):
             lo, da = d["lineorder"], d["dates"]
@@ -358,6 +447,7 @@ def main():
             "metric": f"ssb_q1.1_sf{sf / 2:g}_rows_per_sec_per_chip",
             "value": round(n_lo / ssb_best, 1), "unit": "rows/s",
             "vs_baseline": round(ssb_base / ssb_best, 3), "platform": platform,
+            "dispatches_per_exec": ssb_d,
         })
         s.execute("USE tpch")
 
@@ -377,7 +467,7 @@ def main():
         })
 
     # -- TPC-H Q1 (headline; LAST so a single-line parse of the tail sees it) --
-    q1_best = _bench_query(s, QUERIES[1], runs)
+    q1_best, q1_d = _bench_query_d(s, QUERIES[1], runs)
     q1_base = min(pandas_q1(data)[0] for _ in range(runs))
     results.append({
         "metric": f"tpch_q1_sf{(big_sf if big_sf > 0 else sf):g}"
@@ -385,12 +475,17 @@ def main():
         "value": round((len(data['lineitem']['l_orderkey'])) / q1_best, 1),
         "unit": "rows/s",
         "vs_baseline": round(q1_base / q1_best, 3), "platform": platform,
+        "dispatches_per_exec": q1_d,
     })
 
     try:
         results.insert(0, kernel_microbench(data, platform, runs))
     except Exception:
         pass  # roofline datapoint is best-effort; end-to-end lines still print
+    try:
+        results.insert(1, dispatch_microbench(runs))
+    except Exception:
+        pass  # dispatch datapoint is best-effort too
 
     for out in results:
         print(json.dumps(out))

@@ -1,0 +1,333 @@
+"""Pipeline segment fusion: chains of streaming operators as ONE XLA program.
+
+The chunk engine mirrors the reference's per-operator `nextChunk` pipeline
+(SURVEY.md §2.6), but on XLA that shape is expensive: every streaming operator
+(`FilterOp`, `ProjectOp`, the input side of `HashAggOp`) is its own jitted
+program, so each batch pays a jax dispatch (~0.5ms) per operator and
+materializes an intermediate ColumnBatch between stages.  A *segment* is the
+maximal chain of streaming operators between pipeline breakers (HashAgg build,
+HashJoin build, Sort, Exchange); fusing a segment into one compiled
+`(columns, live) -> (computed columns, live')` program pays one dispatch per
+batch and never materializes the intermediates (the Tailwind move, PAPERS.md).
+
+Composition reuses the existing `ExprCompiler` stage lowering unchanged: a
+filter stage ANDs its predicate into the live mask, a project stage rebinds the
+environment — exactly what `FilterOp`/`ProjectOp` do, minus the XLA program
+boundary between them.
+
+Zero-copy passthrough (same stance as the filter-mask-only change in
+`FilterOp`): the fused program returns ONLY the lanes it actually computes plus
+the live mask.  Output columns that resolve to a bare input column (possibly
+renamed through intermediate projects) never become XLA outputs — the host
+reattaches the ORIGINAL column buffers, so a 50MB lane that merely rides
+through the segment is never copied.
+
+Cache keys are lifted (value-independent) via `LiftedLiterals`, so a
+plan-cache hit on `WHERE id = ?` never retraces: the key is the stage
+structure + template keys + dictionary signatures, and literal values arrive
+as runtime kernel arguments.  Keys go through the process-wide `global_jit`
+LRU, shared between the single-chip executor and the MPP path — the same
+segment compiled once serves both.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from galaxysql_tpu.chunk.batch import Column, ColumnBatch
+from galaxysql_tpu.exec import operators as ops
+from galaxysql_tpu.expr import ir
+from galaxysql_tpu.expr.compiler import (ExprCompiler, LiftedLiterals,
+                                         _find_dictionary, batch_env)
+
+# kill switch: GALAXYSQL_FUSION=0 runs every streaming operator as its own
+# program (the pre-fusion shape) — the A/B lever for benchmarks and the
+# fused-vs-unfused equivalence suite
+ENABLED = os.environ.get("GALAXYSQL_FUSION", "1") != "0"
+
+# Stage = ("filter", ir.Expr) | ("project", [(name, ir.Expr), ...])
+Stage = Tuple[str, Any]
+
+_SEGMENT_IDS = itertools.count(1)
+
+
+def default_enabled(hints: Optional[dict]) -> bool:
+    """Per-execution fusion decision: module switch + NO_FUSE statement hint."""
+    return ENABLED and not (hints or {}).get("no_fuse", False)
+
+
+def _stage_exprs(stages: Sequence[Stage]) -> List[ir.Expr]:
+    out: List[ir.Expr] = []
+    for kind, payload in stages:
+        if kind == "filter":
+            out.append(payload)
+        else:
+            out.extend(e for _, e in payload)
+    return out
+
+
+class FusedSegment:
+    """A compiled streaming-operator chain: filter/project stages fused into
+    one program per backend, plus the passthrough-column metadata the host
+    needs to reattach un-computed lanes."""
+
+    def __init__(self, stages: Sequence[Stage]):
+        assert stages, "empty segment"
+        self.stages: List[Stage] = list(stages)
+        self.segment_id = next(_SEGMENT_IDS)
+        self.chain = ">".join(kind for kind, _ in self.stages)
+        exprs = _stage_exprs(self.stages)
+        lift = LiftedLiterals(exprs)
+        tkeys = ops.lifted_keys(lift, exprs)
+        if tkeys is None:
+            lift = None  # masking ambiguous: bake values (always correct)
+        self.lift = lift
+        self._tkeys = tkeys
+        # passthrough analysis: map each final output name to the INPUT column
+        # it is a bare rename of, or None when it is computed.  alias=None
+        # means no project stage exists: the output namespace IS the input
+        # namespace and every column passes through untouched.
+        alias: Optional[Dict[str, Optional[str]]] = None
+        out_meta: Optional[List[Tuple[str, ir.Expr]]] = None
+        for kind, payload in self.stages:
+            if kind != "project":
+                continue
+            new_alias: Dict[str, Optional[str]] = {}
+            for name, e in payload:
+                if isinstance(e, ir.ColRef):
+                    src = e.name if alias is None else alias.get(e.name)
+                else:
+                    src = None
+                new_alias[name] = src
+            alias = new_alias
+            out_meta = list(payload)
+        self.alias = alias
+        self.out_meta = out_meta
+        self.computed = [] if alias is None else \
+            [name for name, src in alias.items() if src is None]
+        # per-instance memos: segments are rebuilt per execution, so resolving
+        # the global_jit entry and encoding lifted literals once per segment
+        # (not once per batch) keeps the hot loop off the process-wide cache
+        # lock — the per-batch overhead is exactly what this pass removes
+        self._prog_memo: Dict[bool, Any] = {}
+        self._lits_memo: Optional[Tuple] = None
+
+    # -- cache identity -----------------------------------------------------
+
+    def key(self) -> Tuple:
+        """Value-independent (when liftable) structural key for the chain."""
+        parts: List[Tuple] = []
+        ti = 0
+        for kind, payload in self.stages:
+            if kind == "filter":
+                if self._tkeys is not None:
+                    k = self._tkeys[ti]
+                    ti += 1
+                else:
+                    k = ops.expr_cache_key(payload)
+                parts.append(("filter", k))
+            else:
+                eks = []
+                for name, e in payload:
+                    if self._tkeys is not None:
+                        eks.append((name, self._tkeys[ti]))
+                        ti += 1
+                    else:
+                        eks.append((name, ops.expr_cache_key(e)))
+                parts.append(("project", tuple(eks)))
+        return ("fused_segment", tuple(parts))
+
+    def lits(self) -> Tuple:
+        if self._lits_memo is None:
+            self._lits_memo = self.lift.values() if self.lift is not None else ()
+        return self._lits_memo
+
+    # -- compilation --------------------------------------------------------
+
+    def build_apply(self, xp):
+        """Stage-composition closure `(env, live, lits) -> (env', live')`.
+
+        Build-time only (called inside a global_jit builder, or inlined into a
+        LARGER program such as HashAggOp's partial kernel — fusing scan→filter→
+        project→partial-agg into one dispatch).  Returns the full final
+        environment; output selection happens at the program boundary."""
+        comp = ExprCompiler(xp, lift=self.lift)
+        compiled = []
+        for kind, payload in self.stages:
+            if kind == "filter":
+                compiled.append(("filter", comp.compile_predicate(payload)))
+            else:
+                compiled.append(
+                    ("project", [(name, comp.compile(e)) for name, e in payload]))
+
+        def apply(env, live, lits):
+            env = dict(env)
+            env["$lits"] = lits
+            for kind, fns in compiled:
+                if kind == "filter":
+                    live = live & fns(env)
+                else:
+                    out = {name: f(env) for name, f in fns}
+                    out["$lits"] = lits
+                    env = out
+            return env, live
+        return apply
+
+    def _program(self, jit: bool):
+        """global_jit-cached fused program returning ONLY computed lanes."""
+        f = self._prog_memo.get(jit)
+        if f is not None:
+            return f
+        backend = "jnp" if jit else "np"
+        computed = list(self.computed)
+        seg = self
+
+        def build():
+            apply = seg.build_apply(jnp if jit else np)
+
+            def run(env, live, lits):
+                env, live = apply(env, live, lits)
+                n = live.shape[0]
+                out = {name: ops.broadcast_value(n, *env[name],
+                                                 xp=jnp if jit else np)
+                       for name in computed}
+                return out, live
+            return jax.jit(run) if jit else run
+        key = (backend,) + self.key()
+        f = ops.global_jit(key, build, built_flag=self._built_now)
+        self._prog_memo[jit] = f
+        return f
+
+    # -- execution ----------------------------------------------------------
+
+    def _built_now(self):
+        self._compiled_fresh = True
+
+    def run_env(self, env, live, jit: bool = True):
+        """Apply the segment to a raw (env, live) pair (the MPP path: lanes
+        are distributed jax arrays, live is the shard-local mask)."""
+        self._compiled_fresh = False
+        t0 = time.perf_counter() if _tracer_on() else 0.0
+        f = self._program(jit)
+        out, live2 = f(env, live, self.lits())
+        ops.DISPATCH_STATS["dispatches"] += 1
+        if _tracer_on():
+            self._record_span(live, live2, t0)
+        return out, live2
+
+    def attach_columns(self, src_columns: Dict[str, Column],
+                       out: Dict[str, Any]) -> Dict[str, Column]:
+        """Final output columns: computed lanes from the program, passthrough
+        lanes reattached from the ORIGINAL input buffers (zero-copy)."""
+        if self.alias is None:
+            return dict(src_columns)  # no project stage: identity namespace
+        cols: Dict[str, Column] = {}
+        for name, e in self.out_meta:
+            src = self.alias[name]
+            if src is not None:
+                c0 = src_columns[src]
+                cols[name] = Column(c0.data, c0.valid, c0.dtype, c0.dictionary)
+            else:
+                d, v = out[name]
+                cols[name] = Column(d, v, e.dtype, _find_dictionary(e))
+        return cols
+
+    def run_batch(self, batch: ColumnBatch) -> ColumnBatch:
+        """Apply the segment to one ColumnBatch (single-chip executor path).
+
+        Mirrors FilterOp/ProjectOp backend selection: small all-host batches
+        (TP point queries) run the np expression backend directly — per-call
+        jax dispatch dwarfs the work at point-query sizes."""
+        host = batch.capacity <= ops.TP_HOST_ROWS and ops._is_host_batch(batch)
+        self._compiled_fresh = False
+        t0 = time.perf_counter() if _tracer_on() else 0.0
+        if host:
+            env = {n: (c.data, c.valid) for n, c in batch.columns.items()}
+            live_in = batch.live if batch.live is not None else \
+                np.ones(batch.capacity, np.bool_)
+            f = self._program(False)
+            out, live = f(env, live_in, self.lits())
+            live = np.broadcast_to(np.asarray(live), (batch.capacity,))
+        else:
+            f = self._program(True)
+            out, live = f(batch_env(batch), batch.live_mask(), self.lits())
+        ops.DISPATCH_STATS["dispatches"] += 1
+        if _tracer_on():
+            self._record_span(batch.live_mask(), live, t0)
+        return ColumnBatch(self.attach_columns(batch.columns, out), live)
+
+    def run_live_np(self, batch: ColumnBatch) -> np.ndarray:
+        """Host-np live mask for `batch` with the segment's stages applied —
+        the np twin of the in-kernel mask composition.  Used by the native and
+        grace-spill join paths, where the probe prelude is filter-only and
+        only the mask (not the env) is consumed."""
+        env = {n: (c.np_data(), None if c.valid is None else c.np_valid())
+               for n, c in batch.columns.items()}
+        _out, live = self._program(False)(env, batch.np_live(), self.lits())
+        return np.broadcast_to(np.asarray(live), (batch.capacity,))
+
+    def _record_span(self, live_in, live_out, t0: float):
+        from galaxysql_tpu.utils.tracing import SEGMENT_TRACER, SegmentSpan
+        SEGMENT_TRACER.record(SegmentSpan(
+            segment_id=self.segment_id, chain=self.chain,
+            rows_in=int(np.asarray(live_in).sum()),
+            rows_out=int(np.asarray(live_out).sum()),
+            compiled=self._compiled_fresh,
+            wall_ms=round((time.perf_counter() - t0) * 1000, 3)))
+
+
+def _tracer_on() -> bool:
+    from galaxysql_tpu.utils.tracing import SEGMENT_TRACER
+    return SEGMENT_TRACER.enabled
+
+
+class FusedPipelineOp(ops.Operator):
+    """Streaming operator applying one FusedSegment per batch — replaces a
+    stack of FilterOp/ProjectOp instances with a single program dispatch."""
+
+    def __init__(self, child: ops.Operator, segment: FusedSegment):
+        self.child = child
+        self.segment = segment
+
+    def batches(self):
+        for b in self.child.batches():
+            yield self.segment.run_batch(b)
+
+
+def segment_for(node, min_stages: int = 1, filters_only: bool = False):
+    """Shared collapse-into-segment wiring for the local and MPP engines:
+    (base node, FusedSegment | None).  Returns a segment only when the chain
+    above `node` has at least `min_stages` stages (and, with `filters_only`,
+    no project stage — the join-probe case, where a project would change the
+    column namespace the join gathers from); otherwise (node, None)."""
+    stages, base = collapse_streaming_chain(node)
+    if len(stages) < min_stages:
+        return node, None
+    if filters_only and any(kind != "filter" for kind, _ in stages):
+        return node, None
+    return base, FusedSegment(stages)
+
+
+def collapse_streaming_chain(node) -> Tuple[List[Stage], Any]:
+    """Maximal chain of streaming logical nodes above `node`'s first pipeline
+    breaker: (bottom-up stages, base node).  Streaming = Filter/Project; every
+    other node (Scan, Aggregate build, Join build, Sort, Exchange/shuffle,
+    Window, Limit, Union) is a segment boundary."""
+    from galaxysql_tpu.plan import logical as L
+    rev: List[Stage] = []
+    cur = node
+    while isinstance(cur, (L.Filter, L.Project)):
+        if isinstance(cur, L.Filter):
+            rev.append(("filter", cur.cond))
+        else:
+            rev.append(("project", list(cur.exprs)))
+        cur = cur.child
+    rev.reverse()
+    return rev, cur

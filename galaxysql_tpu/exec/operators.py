@@ -11,6 +11,7 @@ instead of growable hash maps (SURVEY.md §7.3).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
@@ -48,28 +49,52 @@ def bucket_capacity(n: int) -> int:
     return c
 
 
-_JIT_CACHE: Dict[Tuple, Any] = {}
+_JIT_CACHE: "collections.OrderedDict[Tuple, Any]" = collections.OrderedDict()
 _JIT_CACHE_LOCK = __import__("threading").Lock()
 _JIT_CACHE_LIMIT = 4096
 
+# per-batch dispatch accounting (bench.py microbenchmark): every
+# streaming-program invocation on one batch bumps `dispatches` — FilterOp,
+# ProjectOp, a fused segment, the HashAgg partial, and the per-node MPP
+# filter/project/agg programs each count 1 per batch.  A "dispatch" is one
+# program-boundary crossing: an XLA dispatch on the device path, a host-np
+# program call on the TP path (no jax dispatch there, but the same
+# per-operator Python boundary the fuser removes).  Plain int adds: no device
+# sync, no lock (approximate under concurrency, exact in the bench loop).
+DISPATCH_STATS = {"dispatches": 0}
 
-def global_jit(key: Tuple, builder):
-    """Process-wide cache of jitted operator kernels.
+
+def reset_dispatch_stats():
+    DISPATCH_STATS["dispatches"] = 0
+
+
+def global_jit(key: Tuple, builder, built_flag=None):
+    """Process-wide LRU cache of jitted operator kernels.
 
     Operator instances are rebuilt per execution (plans are immutable, contexts are
     not), but the compiled XLA programs must survive across executions — otherwise a
     plan-cache hit still pays a full retrace+recompile.  Keys are semantic: expression
     tree keys plus the identity AND size of every dictionary whose contents are baked
-    into the closure (a grown dictionary invalidates)."""
+    into the closure (a grown dictionary invalidates).
+
+    Eviction is LRU one-at-a-time (move-to-end on hit, evict oldest on
+    overflow) — a full clear at the limit would thundering-herd every hot query
+    into a simultaneous retrace+recompile.  `built_flag`, when given, is called
+    iff the builder actually ran (compile-vs-cached observability for tracing)."""
     with _JIT_CACHE_LOCK:
         f = _JIT_CACHE.get(key)
         if f is not None:
+            _JIT_CACHE.move_to_end(key)
             return f
     f = builder()
+    if built_flag is not None:
+        built_flag()
     with _JIT_CACHE_LOCK:
-        if len(_JIT_CACHE) >= _JIT_CACHE_LIMIT:
-            _JIT_CACHE.clear()
+        if key not in _JIT_CACHE:
+            while len(_JIT_CACHE) >= _JIT_CACHE_LIMIT:
+                _JIT_CACHE.popitem(last=False)
         _JIT_CACHE[key] = f
+        _JIT_CACHE.move_to_end(key)
     return f
 
 
@@ -114,15 +139,17 @@ def _is_host_batch(b: ColumnBatch) -> bool:
 TP_HOST_ROWS = 1 << 16
 
 
-def broadcast_value(n: int, data, valid):
+def broadcast_value(n: int, data, valid, xp=jnp):
     """Materialize a compiled (data, valid) pair to full row length.
 
     Scalars appear when an expression is constant (literals, NULL); data and valid
-    broadcast independently — e.g. `col + NULL` has full-length data but scalar valid."""
+    broadcast independently — e.g. `col + NULL` has full-length data but scalar
+    valid.  `xp` picks the backend: jnp inside jitted programs (default), np for
+    the host expression path (fused segments run both)."""
     if not hasattr(data, "shape") or data.shape == ():
-        data = jnp.broadcast_to(data, (n,))
+        data = xp.broadcast_to(xp.asarray(data), (n,))
     if valid is not None and (not hasattr(valid, "shape") or valid.shape == ()):
-        valid = jnp.broadcast_to(valid, (n,))
+        valid = xp.broadcast_to(xp.asarray(valid), (n,))
     return data, valid
 
 
@@ -226,6 +253,7 @@ class FilterOp(Operator):
     def batches(self) -> Iterator[ColumnBatch]:
         f = lits = fnp = None
         for b in self.child.batches():
+            DISPATCH_STATS["dispatches"] += 1
             if b.capacity <= TP_HOST_ROWS and _is_host_batch(b):
                 if fnp is None:
                     fnp, lits_np = self._compiled_np()
@@ -308,6 +336,7 @@ class ProjectOp(Operator):
     def batches(self) -> Iterator[ColumnBatch]:
         f = lits = fnp = None
         for b in self.child.batches():
+            DISPATCH_STATS["dispatches"] += 1
             if b.capacity <= TP_HOST_ROWS and _is_host_batch(b):
                 if fnp is None:
                     fnp, lits_np = self._compiled_np()
@@ -328,7 +357,7 @@ class HashAggOp(Operator):
 
     def __init__(self, child: Operator, group_exprs: Sequence[Tuple[str, ir.Expr]],
                  aggs: Sequence[AggCall], max_groups: int = 1 << 16,
-                 spill_threshold: int = 256 << 20):
+                 spill_threshold: int = 256 << 20, prelude=None):
         self.child = child
         self.group_exprs = list(group_exprs)
         self.aggs = list(aggs)
@@ -336,6 +365,10 @@ class HashAggOp(Operator):
         # partial-state bytes above this spill to disk (MemoryRevoker analog)
         self.spill_threshold = spill_threshold
         self.spilled_partials = 0
+        # fused streaming chain (exec/fusion.FusedSegment) applied INSIDE the
+        # partial kernel: scan→filter→project→partial-agg is one XLA program,
+        # one dispatch per batch instead of one per operator
+        self.prelude = prelude
 
     # -- kernel plumbing ---------------------------------------------------
 
@@ -406,10 +439,13 @@ class HashAggOp(Operator):
 
     def _partial_fn(self, max_groups: int):
         domains = self._matmul_domains()
+        prelude = self.prelude
         key = ("agg_partial", jax.default_backend(), self._cache_key(), max_groups,
-               tuple(domains) if domains is not None else None)
+               tuple(domains) if domains is not None else None,
+               prelude.key() if prelude is not None else None)
 
         def build():
+            papply = prelude.build_apply(jnp) if prelude is not None else None
             comp = ExprCompiler(jnp)
             gfns = [comp.compile(e) for _, e in self.group_exprs]
             inputs, lanes = self._partial_specs()
@@ -432,14 +468,17 @@ class HashAggOp(Operator):
                 ifns.append(f)
             specs = tuple(s for _, s in lanes)
 
-            def run(batch: ColumnBatch):
+            def run(batch: ColumnBatch, plits):
                 env = batch_env(batch)
+                live = batch.live_mask()
+                if papply is not None:
+                    env, live = papply(env, live, plits)
                 n = batch.capacity
                 keys = [broadcast_value(n, *f(env)) for f in gfns]
                 ins = [broadcast_value(n, *f(env)) for f in ifns]
                 # backend-adaptive: dense-slot (matmul/scatter) when domains are
                 # small and static, hash (CPU) / lexsort (TPU) otherwise
-                return K.groupby(keys, ins, specs, batch.live_mask(), max_groups,
+                return K.groupby(keys, ins, specs, live, max_groups,
                                  domains)
             return jax.jit(run)
         return global_jit(key, build)
@@ -475,9 +514,11 @@ class HashAggOp(Operator):
                 spiller.close()
                 partial_bytes = 0
                 overflowed = False
+                plits = self.prelude.lits() if self.prelude is not None else ()
                 for b in self.child.batches():
                     f = self._partial_fn(mg)
-                    r = f(b)
+                    DISPATCH_STATS["dispatches"] += 1
+                    r = f(b, plits)
                     if bool(r.overflow):
                         overflowed = True
                         break
@@ -702,8 +743,14 @@ class HashJoinOp(Operator):
                  build_schema: Optional[Dict[str, Tuple[dt.DataType,
                                                         Optional[Dictionary]]]] = None,
                  spill_threshold: int = 256 << 20,
-                 enable_bloom: bool = True):
+                 enable_bloom: bool = True, probe_prelude=None):
         assert join_type in ("inner", "left", "semi", "anti")
+        # filter-only fused segment (exec/fusion.FusedSegment) ANDed into the
+        # probe live mask INSIDE the probe kernels: the WHERE above the probe
+        # scan costs no separate program dispatch per batch.  Inner joins only:
+        # left/semi/anti unmatched semantics read the probe mask on the host.
+        assert probe_prelude is None or join_type == "inner"
+        self.probe_prelude = probe_prelude
         self.build, self.probe = build, probe
         self.build_keys, self.probe_keys = list(build_keys), list(probe_keys)
         self.join_type = join_type
@@ -742,20 +789,36 @@ class HashJoinOp(Operator):
             pk.append(pf)
         return bk, pk
 
+    def _plits(self) -> Tuple:
+        return self.probe_prelude.lits() if self.probe_prelude is not None else ()
+
+    def _probe_live_np(self, pb: ColumnBatch) -> np.ndarray:
+        """Host probe live mask with the prelude filter applied (np twin of
+        the in-kernel composition; native/grace paths)."""
+        if self.probe_prelude is None:
+            return pb.np_live()
+        return self.probe_prelude.run_live_np(pb)
+
     def _pairs_fn(self, cap: int):
+        prelude = self.probe_prelude
         key = ("join_pairs", jax.default_backend(), cap,
                tuple(expr_cache_key(e) for e in self.build_keys),
-               tuple(expr_cache_key(e) for e in self.probe_keys))
+               tuple(expr_cache_key(e) for e in self.probe_keys),
+               prelude.key() if prelude is not None else None)
 
         def build_fn():
+            papply = prelude.build_apply(jnp) if prelude is not None else None
             bk, pk = self._key_compilers()
 
-            def run(build: ColumnBatch, probe: ColumnBatch):
+            def run(build: ColumnBatch, probe: ColumnBatch, plits):
                 benv, penv = batch_env(build), batch_env(probe)
+                plive = probe.live_mask()
+                if papply is not None:
+                    _env, plive = papply(penv, plive, plits)
                 bkeys = [f(benv) for f in bk]
                 pkeys = [f(penv) for f in pk]
                 return K.hash_join_pairs(bkeys, pkeys, build.live_mask(),
-                                         probe.live_mask(), cap)
+                                         plive, cap)
             return jax.jit(run)
         return global_jit(key, build_fn)
 
@@ -787,20 +850,26 @@ class HashJoinOp(Operator):
         return (jnp.asarray(perm), jnp.asarray(starts), jnp.asarray(counts), M)
 
     def _probe_csr_fn(self, cap: int, M: int, nb: int):
+        prelude = self.probe_prelude
         key = ("join_probe_csr", jax.default_backend(), cap, M, nb,
                tuple(expr_cache_key(e) for e in self.build_keys),
-               tuple(expr_cache_key(e) for e in self.probe_keys))
+               tuple(expr_cache_key(e) for e in self.probe_keys),
+               prelude.key() if prelude is not None else None)
 
         def build_fn():
+            papply = prelude.build_apply(jnp) if prelude is not None else None
             bk, pk = self._key_compilers()
 
             def run(build: ColumnBatch, probe: ColumnBatch,
-                    perm, slot_starts, slot_counts):
+                    perm, slot_starts, slot_counts, plits):
                 benv, penv = batch_env(build), batch_env(probe)
+                plive = probe.live_mask()
+                if papply is not None:
+                    _env, plive = papply(penv, plive, plits)
                 bkeys = [f(benv) for f in bk]
                 pkeys = [f(penv) for f in pk]
                 return K.hash_join_probe_csr(bkeys, pkeys, build.live_mask(),
-                                             probe.live_mask(), perm,
+                                             plive, perm,
                                              slot_starts, slot_counts, M, cap)
             return jax.jit(run)
         return global_jit(key, build_fn)
@@ -996,6 +1065,8 @@ class HashJoinOp(Operator):
                 self._spill_split(bb, self._np_bucket(bb, bk, P), P, b_spill,
                                   b_schema)
             for pb in self.probe.batches():
+                if self.probe_prelude is not None:
+                    pb = ColumnBatch(pb.columns, self._probe_live_np(pb))
                 self._spill_split(pb, self._np_bucket(pb, pk, P), P, p_spill,
                                   p_schema)
             for p in range(P):
@@ -1061,7 +1132,7 @@ class HashJoinOp(Operator):
 
         for pb in self.probe.batches():
             planes = self._np_key_lanes(pk, pb)
-            p_live_mask = pb.np_live()
+            p_live_mask = self._probe_live_np(pb)
             p_eff = p_live_mask
             for _d, v in planes:
                 if v is not None:
@@ -1211,18 +1282,22 @@ class HashJoinOp(Operator):
             bloom_filter = self._build_bloom(build_batch, pk[0])
 
         csr = self._csr_host(build_batch) if K.prefer_scatter() else None
+        plits = self._plits()
         for pb in self.probe.batches():
             if bloom_filter is not None:
                 pb = bloom_filter(pb)
+            # with a probe prelude the count predates the fused WHERE (counting
+            # the post-filter mask would cost the dispatch the fusion saves):
+            # cap is conservative, overflow-retry semantics unchanged
             n_live = pb.num_live()
             cap = bucket_capacity(max(n_live * 2, MIN_BUCKET))
             while True:
                 if csr is not None:
                     perm, starts, counts, M = csr
                     pairs = self._probe_csr_fn(cap, M, build_batch.capacity)(
-                        build_batch, pb, perm, starts, counts)
+                        build_batch, pb, perm, starts, counts, plits)
                 else:
-                    pairs = self._pairs_fn(cap)(build_batch, pb)
+                    pairs = self._pairs_fn(cap)(build_batch, pb, plits)
                 if not bool(pairs.overflow):
                     break
                 cap *= 2

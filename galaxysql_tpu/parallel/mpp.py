@@ -21,14 +21,22 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.6 jax: shard_map lives in experimental, kw is check_rep
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=bool(check_vma))
 from jax.sharding import Mesh, PartitionSpec as P
 
 from galaxysql_tpu.chunk.batch import (Column, ColumnBatch, Dictionary,
                                        dictionary_translation)
-from galaxysql_tpu.exec.operators import (AggCall, HashAggOp, SortOp, SourceOp,
-                                          broadcast_value, bucket_capacity,
-                                          expr_cache_key, global_jit)
+from galaxysql_tpu.exec.operators import (DISPATCH_STATS, AggCall, HashAggOp,
+                                          SortOp, SourceOp, broadcast_value,
+                                          bucket_capacity, expr_cache_key,
+                                          global_jit)
 from galaxysql_tpu.expr import ir
 from galaxysql_tpu.expr.compiler import ExprCompiler, _find_dictionary
 from galaxysql_tpu.kernels import relational as K
@@ -143,8 +151,12 @@ class MppExecutor:
         if isinstance(node, L.Scan):
             return self._scan(node)
         if isinstance(node, L.Filter):
+            if self._fusing():
+                return self._streaming_chain(node)
             return self._filter(node)
         if isinstance(node, L.Project):
+            if self._fusing():
+                return self._streaming_chain(node)
             return self._project(node)
         if isinstance(node, L.Aggregate):
             return self._aggregate(node)
@@ -221,6 +233,29 @@ class MppExecutor:
 
     # -- stateless row ops ---------------------------------------------------------
 
+    def _fusing(self) -> bool:
+        # direct read: every ExecContext defines it, and a context type that
+        # forgot the field must fail loudly, not silently bypass NO_FUSE
+        return self.ctx.enable_fusion
+
+    def _streaming_chain(self, node) -> DistBatch:
+        """Maximal Filter/Project chain as ONE fused program (exec/fusion.py).
+
+        Elementwise stages need no shard_map of their own: the fused jit runs
+        directly on the distributed lanes, exactly like the per-node _filter/
+        _project programs it replaces — but paying one dispatch for the whole
+        chain, and returning only computed lanes (passthrough column buffers
+        are reattached, never copied through XLA outputs).  The compiled
+        program is shared with the single-chip executor via global_jit."""
+        from galaxysql_tpu.exec.fusion import segment_for
+        base, seg = segment_for(node)
+        child = self.run(base)
+        if len(seg.stages) >= 2:
+            self.ctx.trace.append(f"mpp-fuse-segment {seg.chain}")
+        out, live = seg.run_env(child.env(), child.live)
+        cols = seg.attach_columns(child.columns, out)
+        return DistBatch(cols, live, child.replicated)
+
     def _filter(self, node: L.Filter) -> DistBatch:
         child = self.run(node.child)
         key = ("mpp_filter", expr_cache_key(node.cond))
@@ -228,6 +263,7 @@ class MppExecutor:
         def build():
             pred = ExprCompiler(jnp).compile_predicate(node.cond)
             return jax.jit(lambda env, live: live & pred(env))
+        DISPATCH_STATS["dispatches"] += 1
         live = global_jit(key, build)(child.env(), child.live)
         return DistBatch(child.columns, live, child.replicated)
 
@@ -250,6 +286,7 @@ class MppExecutor:
                     out[name] = (d, v)
                 return out
             return jax.jit(run)
+        DISPATCH_STATS["dispatches"] += 1
         out = global_jit(key, build)(child.env(), child.live)
         cols = {name: Column(out[name][0], out[name][1], e.dtype, _find_dictionary(e))
                 for name, e in node.exprs}
@@ -258,13 +295,23 @@ class MppExecutor:
     # -- aggregate -----------------------------------------------------------------
 
     def _aggregate(self, node: L.Aggregate) -> DistBatch:
-        child = self.run(node.child)
         calls = [AggCall(a.kind, a.arg, a.out_id) for a in node.aggs]
+        child_node, prelude = node.child, None
+        if self._fusing():
+            # hand the feeding Filter/Project chain to the fuser: it compiles
+            # INTO the per-shard partial-agg program (one dispatch per stage
+            # round instead of one per operator), same as the local engine
+            from galaxysql_tpu.exec.fusion import segment_for
+            base, prelude = segment_for(node.child)
+            if prelude is not None:
+                child_node = base
+                self.ctx.trace.append(f"mpp-fuse-agg-prelude {prelude.chain}")
+        child = self.run(child_node)
         return self._aggregate_batch(child, node.groups, calls,
-                                     estimate_rows(node))
+                                     estimate_rows(node), prelude=prelude)
 
     def _aggregate_batch(self, child: DistBatch, groups, calls,
-                         est: float) -> DistBatch:
+                         est: float, prelude=None) -> DistBatch:
         helper = HashAggOp(None, groups, calls)  # spec decomposition + finalize
         inputs, lanes = helper._partial_specs()
         lane_names = tuple(name for name, _ in lanes)
@@ -276,7 +323,7 @@ class MppExecutor:
         G = 1 << max(int(est * 2).bit_length(), 8)
         while True:
             r, overflow = self._agg_round(groups, child, inputs, specs,
-                                          merge_specs, G)
+                                          merge_specs, G, prelude)
             if not overflow:
                 break
             G *= 2
@@ -285,13 +332,16 @@ class MppExecutor:
         batch = helper._finalize(jax.tree.map(jnp.asarray, r), lane_names)
         return DistBatch(batch.columns, batch.live_mask(), True)
 
-    def _agg_round(self, groups, child, inputs, specs, merge_specs, G):
+    def _agg_round(self, groups, child, inputs, specs, merge_specs, G,
+                   prelude=None):
         key = ("mpp_agg", jax.default_backend(),
                tuple((n, expr_cache_key(e)) for n, e in groups),
                tuple(expr_cache_key(e) for e in inputs), specs, G,
-               child.replicated, self.S)
+               child.replicated, self.S,
+               prelude.key() if prelude is not None else None)
 
         def build():
+            papply = prelude.build_apply(jnp) if prelude is not None else None
             comp = ExprCompiler(jnp)
             gfns = [comp.compile(e) for _, e in groups]
             ifns = []
@@ -310,20 +360,22 @@ class MppExecutor:
                     f = ranked
                 ifns.append(f)
 
-            def local_partial(env, live):
+            def local_partial(env, live, plits):
                 n = live.shape[0]
+                if papply is not None:
+                    env, live = papply(env, live, plits)
                 keys = [broadcast_value(n, *f(env)) for f in gfns]
                 ins = [broadcast_value(n, *f(env)) for f in ifns]
                 return K.groupby(keys, ins, specs, live, G)
 
             if child.replicated:
-                def run_rep(env, live):
-                    r = local_partial(env, live)
+                def run_rep(env, live, plits):
+                    r = local_partial(env, live, plits)
                     return r, r.overflow
                 return jax.jit(run_rep)
 
-            def spmd(env, live):
-                r = local_partial(env, live)
+            def spmd(env, live, plits):
+                r = local_partial(env, live, plits)
                 over = r.overflow
 
                 def gather_pairs(pairs):
@@ -343,11 +395,13 @@ class MppExecutor:
                                     "shard").astype(jnp.bool_)
                 return m, over
 
-            fn = shard_map(spmd, mesh=self.mesh, in_specs=(SHARD, SHARD),
+            fn = shard_map(spmd, mesh=self.mesh, in_specs=(SHARD, SHARD, REP),
                            out_specs=(REP, REP), check_vma=False)
             return jax.jit(fn)
 
-        r, overflow = global_jit(key, build)(child.env(), child.live)
+        plits = prelude.lits() if prelude is not None else ()
+        DISPATCH_STATS["dispatches"] += 1
+        r, overflow = global_jit(key, build)(child.env(), child.live, plits)
         return r, bool(overflow)
 
     # -- join ------------------------------------------------------------------------

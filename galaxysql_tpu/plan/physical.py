@@ -16,6 +16,7 @@ from typing import Callable, Dict, Iterator, List, Optional
 import numpy as np
 
 from galaxysql_tpu.chunk.batch import Column, ColumnBatch
+from galaxysql_tpu.exec import fusion
 from galaxysql_tpu.exec import operators as ops
 from galaxysql_tpu.expr import ir
 from galaxysql_tpu.expr.compiler import _find_dictionary
@@ -51,6 +52,8 @@ class ExecContext:
         self.collect_stats = False       # EXPLAIN ANALYZE per-operator stats
         self.op_stats: List[dict] = []   # filled by StatsOp when collecting
         self.trace: List[str] = []
+        # pipeline segment fusion (exec/fusion.py): module switch + NO_FUSE hint
+        self.enable_fusion = fusion.default_enabled(self.hints)
 
 
 # per-(store, version) scan metadata: O(table) host reductions must run once per
@@ -432,22 +435,43 @@ def build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
     return op
 
 
+def _fusing(ctx: ExecContext) -> bool:
+    # EXPLAIN ANALYZE keeps one StatsOp per plan node: fusing would erase the
+    # per-operator rows/time breakdown the user asked for
+    return ctx.enable_fusion and not getattr(ctx, "collect_stats", False)
+
+
 def _build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
     if isinstance(node, L.Scan):
         return ScanSource(node, ctx)
     if isinstance(node, L.Values):
         return ValuesSource(node)
-    if isinstance(node, L.Filter):
-        return ops.FilterOp(build_operator(node.child, ctx), node.cond)
-    if isinstance(node, L.Project):
+    if isinstance(node, (L.Filter, L.Project)):
+        if _fusing(ctx):
+            base, seg = fusion.segment_for(node, min_stages=2)
+            if seg is not None:
+                ctx.trace.append(f"fuse-segment {seg.chain}")
+                return fusion.FusedPipelineOp(build_operator(base, ctx), seg)
+        if isinstance(node, L.Filter):
+            return ops.FilterOp(build_operator(node.child, ctx), node.cond)
         return ops.ProjectOp(build_operator(node.child, ctx), node.exprs)
     if isinstance(node, L.Aggregate):
         est = estimate_rows(node)
         max_groups = 1 << max(int(est * 2).bit_length(), 10)
         max_groups = min(max_groups, 1 << 22)
         calls = [ops.AggCall(a.kind, a.arg, a.out_id) for a in node.aggs]
-        return ops.HashAggOp(build_operator(node.child, ctx),
-                             node.groups, calls, max_groups=max_groups)
+        child_node, prelude = node.child, None
+        if _fusing(ctx):
+            # the agg is itself a pipeline breaker: its feeding chain fuses
+            # INTO the partial kernel (scan→filter→project→partial-agg, one
+            # program), not into a separate segment in front of it
+            base, prelude = fusion.segment_for(node.child)
+            if prelude is not None:
+                child_node = base
+                ctx.trace.append(f"fuse-agg-prelude {prelude.chain}")
+        return ops.HashAggOp(build_operator(child_node, ctx),
+                             node.groups, calls, max_groups=max_groups,
+                             prelude=prelude)
     if isinstance(node, L.Window):
         return ops.WindowOp(build_operator(node.child, ctx), node.partitions,
                             node.orders, node.calls, out_schema=node.fields())
@@ -500,20 +524,36 @@ def _build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
     raise errors.NotSupportedError(f"no physical operator for {type(node).__name__}")
 
 
+def _probe_prelude(ctx: ExecContext, probe_node: L.RelNode):
+    """(base node, filter-only FusedSegment | None) for an inner join's probe
+    side: the WHERE chain above the probe scan fuses INTO the probe kernels
+    (one program per batch instead of filter + probe).  Project stages change
+    the column namespace the join gathers from, so only all-filter chains
+    collapse here; anything else stays a segment in front of the join."""
+    if not _fusing(ctx):
+        return probe_node, None
+    base, seg = fusion.segment_for(probe_node, filters_only=True)
+    if seg is not None:
+        ctx.trace.append(f"fuse-join-probe {seg.chain}")
+    return base, seg
+
+
 def _build_join(node: L.Join, ctx: ExecContext) -> ops.Operator:
-    left = build_operator(node.left, ctx)
-    right = build_operator(node.right, ctx)
     if node.kind == "cross":
+        left = build_operator(node.left, ctx)
+        right = build_operator(node.right, ctx)
         bschema = {fid: (typ, d) for fid, typ, d in node.right.fields()}
         return ops.CrossJoinOp(right, left, scalar=getattr(node, "scalar", False),
                                build_schema=bschema)
     lkeys = [a for a, _ in node.equi]
     rkeys = [b for _, b in node.equi]
     bloom = not ctx.hints.get("no_bloom", False)
-    right_schema = {fid: (typ, d) for fid, typ, d in node.right.fields()}
     if node.kind in ("left", "semi", "anti"):
         # probe side MUST be the preserved/output (left) side
-        return ops.HashJoinOp(right, left, rkeys, lkeys, node.kind,
+        right_schema = {fid: (typ, d) for fid, typ, d in node.right.fields()}
+        return ops.HashJoinOp(build_operator(node.right, ctx),
+                              build_operator(node.left, ctx),
+                              rkeys, lkeys, node.kind,
                               residual=node.residual, build_schema=right_schema,
                               enable_bloom=bloom,
                               spill_threshold=ctx.join_spill_bytes)
@@ -521,12 +561,17 @@ def _build_join(node: L.Join, ctx: ExecContext) -> ops.Operator:
     l_est = estimate_rows(node.left)
     r_est = estimate_rows(node.right)
     if r_est <= l_est:
-        return ops.HashJoinOp(right, left, rkeys, lkeys, "inner",
-                              residual=node.residual, build_schema=right_schema,
-                              enable_bloom=bloom,
-                              spill_threshold=ctx.join_spill_bytes)
-    left_schema = {fid: (typ, d) for fid, typ, d in node.left.fields()}
-    return ops.HashJoinOp(left, right, lkeys, rkeys, "inner",
-                          residual=node.residual, build_schema=left_schema,
+        build_node, probe_node = node.right, node.left
+        build_keys, probe_keys = rkeys, lkeys
+    else:
+        build_node, probe_node = node.left, node.right
+        build_keys, probe_keys = lkeys, rkeys
+    build_schema = {fid: (typ, d) for fid, typ, d in build_node.fields()}
+    probe_node, prelude = _probe_prelude(ctx, probe_node)
+    return ops.HashJoinOp(build_operator(build_node, ctx),
+                          build_operator(probe_node, ctx),
+                          build_keys, probe_keys, "inner",
+                          residual=node.residual, build_schema=build_schema,
                           enable_bloom=bloom,
-                          spill_threshold=ctx.join_spill_bytes)
+                          spill_threshold=ctx.join_spill_bytes,
+                          probe_prelude=prelude)

@@ -10,6 +10,7 @@ behind them:
 - ENGINE(MPP|LOCAL|TP)     force cluster-MPP, local device engine, or the
   TP host path regardless of the workload classifier
 - NO_BLOOM                 disable runtime bloom filters for the statement
+- NO_FUSE                  disable pipeline segment fusion for the statement
 - BASELINE_OFF             bypass SPM for the statement (plan as costed)
 
 Unknown directives are ignored (hints must never break a query), matching the
@@ -45,6 +46,8 @@ def parse_hints(comment: Optional[str]) -> Dict[str, object]:
                 out["engine"] = eng
         elif name == "NO_BLOOM":
             out["no_bloom"] = True
+        elif name == "NO_FUSE":
+            out["no_fuse"] = True
         elif name == "BASELINE_OFF":
             out["baseline_off"] = True
     return out

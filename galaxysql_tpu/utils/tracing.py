@@ -45,6 +45,46 @@ class SlowLog:
 SLOW_LOG = SlowLog()
 
 
+@dataclasses.dataclass
+class SegmentSpan:
+    """One fused-pipeline-segment dispatch (exec/fusion.py)."""
+    segment_id: int   # stable per FusedSegment instance
+    chain: str        # op chain, e.g. "filter>project"
+    rows_in: int      # live rows entering the segment
+    rows_out: int     # live rows surviving it
+    compiled: bool    # True: this dispatch paid a fresh trace+compile
+    wall_ms: float
+
+
+class SegmentTracer:
+    """Bounded ring of per-segment spans — fused pipelines collapse several
+    operators into one program, so EXPLAIN-style per-operator stats can't see
+    inside them; these spans keep them observable.
+
+    Off by default: rows in/out force a device sync per batch, which the hot
+    path must never pay.  Enable around a query, then read `spans()`."""
+
+    def __init__(self, capacity: int = 1024):
+        self._ring: Deque[SegmentSpan] = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self.enabled = False
+
+    def record(self, span: SegmentSpan):
+        with self._lock:
+            self._ring.append(span)
+
+    def spans(self) -> List[SegmentSpan]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+
+
+SEGMENT_TRACER = SegmentTracer()
+
+
 class MatrixStatistics:
     """Instance-level counters (SHOW @@stats analog, §5.5)."""
 
