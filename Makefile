@@ -13,7 +13,14 @@ tier1:
 fusion-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m fusion -p no:cacheprovider
 
+# fast observability smoke: EXPLAIN ANALYZE actual-rows vs result
+# cardinalities, SHOW FULL STATS / information_schema.metrics round-trips,
+# web /metrics + /query/<trace_id>, and the no-profiling hot-path guard
+# (zero extra device dispatches vs the PR-1 fused baseline)
+obs-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m observability -p no:cacheprovider
+
 bench:
 	$(PY) bench.py
 
-.PHONY: tier1 fusion-smoke bench
+.PHONY: tier1 fusion-smoke obs-smoke bench

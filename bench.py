@@ -314,6 +314,35 @@ def _bench_query(s, q, runs):
     return best
 
 
+def _profile_summary(s, q):
+    """One profiled execution -> {operator: rows/ms} summary attached to the
+    BENCH json, so the perf trajectory records WHERE time went (per-operator,
+    per-segment), not just end-to-end totals.  Runs OUTSIDE the timed loops:
+    profiling forces device syncs the benchmark numbers must not contain."""
+    try:
+        s.execute("SET ENABLE_QUERY_PROFILING = 1")
+        s.execute(q)
+        prof = s.instance.profiles.entries()[-1]
+        return {
+            "trace_id": prof.trace_id,
+            "engine": prof.engine,
+            "elapsed_ms": prof.elapsed_ms,
+            "operators": [
+                {"op": st["operator"], "rows": st["rows_out"],
+                 "ms": st["wall_ms"],
+                 **({"fused": st["segment"]} if st.get("fused") else {})}
+                for st in prof.op_stats],
+            "segments": [
+                {"chain": sp.chain, "rows_in": sp.rows_in,
+                 "rows_out": sp.rows_out, "ms": sp.wall_ms}
+                for sp in prof.segments],
+        }
+    except Exception as e:  # profile datapoint is best-effort
+        return {"error": str(e)}
+    finally:
+        s.execute("SET ENABLE_QUERY_PROFILING = 0")
+
+
 def _bench_query_d(s, q, runs):
     """(best wall seconds, steady-state streaming dispatches per execution).
 
@@ -381,6 +410,7 @@ def main():
         "value": round(n_rows / q3_best, 1), "unit": "rows/s",
         "vs_baseline": round(q3_base / q3_best, 3), "platform": platform,
         "dispatches_per_exec": q3_d,
+        "profile": _profile_summary(s, QUERIES[3]),
     })
 
     # -- TPC-H Q5: 6-way shuffle join (config 3) -------------------------------
@@ -391,6 +421,7 @@ def main():
         "value": round(n_rows / q5_best, 1), "unit": "rows/s",
         "vs_baseline": round(q5_base / q5_best, 3), "platform": platform,
         "dispatches_per_exec": q5_d,
+        "profile": _profile_summary(s, QUERIES[5]),
     })
 
     # -- TPC-DS q7: 5-way star join + 4 avgs (config 5) ------------------------
@@ -412,6 +443,7 @@ def main():
             "value": round(n_ss / ds_best, 1), "unit": "rows/s",
             "vs_baseline": round(ds_base / ds_best, 3), "platform": platform,
             "dispatches_per_exec": ds_d,
+            "profile": _profile_summary(s, tpcds.QUERIES["q7"]),
         })
         s.execute("USE tpch")
 
@@ -448,6 +480,7 @@ def main():
             "value": round(n_lo / ssb_best, 1), "unit": "rows/s",
             "vs_baseline": round(ssb_base / ssb_best, 3), "platform": platform,
             "dispatches_per_exec": ssb_d,
+            "profile": _profile_summary(s, ssb.QUERIES["1.1"]),
         })
         s.execute("USE tpch")
 
@@ -476,6 +509,7 @@ def main():
         "unit": "rows/s",
         "vs_baseline": round(q1_base / q1_best, 3), "platform": platform,
         "dispatches_per_exec": q1_d,
+        "profile": _profile_summary(s, QUERIES[1]),
     })
 
     try:

@@ -73,6 +73,10 @@ CCL_WAIT_TIMEOUT = _p("CCL_WAIT_TIMEOUT", 10_000, "ms")
 SQL_SELECT_LIMIT = _p("SQL_SELECT_LIMIT", -1, "-1 = unlimited")
 SLOW_SQL_MS = _p("SLOW_SQL_MS", 1000, "slow query log threshold")
 ENABLE_TRACE = _p("ENABLE_TRACE", False, "SQL TRACE recording")
+ENABLE_QUERY_PROFILING = _p(
+    "ENABLE_QUERY_PROFILING", False,
+    "collect per-operator rows/time + segment spans into QueryProfile "
+    "(forces device syncs; the default hot path pays nothing)")
 FAILPOINT_ENABLE = _p("FAILPOINT_ENABLE", False, "fail-point injection master switch")
 
 

@@ -116,8 +116,13 @@ class FusedSegment:
         # the global_jit entry and encoding lifted literals once per segment
         # (not once per batch) keeps the hot loop off the process-wide cache
         # lock — the per-batch overhead is exactly what this pass removes
-        self._prog_memo: Dict[bool, Any] = {}
+        self._prog_memo: Dict[Tuple[bool, bool], Any] = {}
         self._lits_memo: Optional[Tuple] = None
+        # EXPLAIN ANALYZE / profiling sink: when set (a list), every dispatch
+        # runs the stats program variant and appends (per-stage live counts,
+        # wall ms) — per-operator rows INSIDE the fused chain.  None (default)
+        # keeps the production program: no extra outputs, no device syncs.
+        self.stats_sink: Optional[list] = None
 
     # -- cache identity -----------------------------------------------------
 
@@ -152,12 +157,16 @@ class FusedSegment:
     # -- compilation --------------------------------------------------------
 
     def build_apply(self, xp):
-        """Stage-composition closure `(env, live, lits) -> (env', live')`.
+        """Stage-composition closure `(env, live, lits[, on_stage]) ->
+        (env', live')`.
 
         Build-time only (called inside a global_jit builder, or inlined into a
         LARGER program such as HashAggOp's partial kernel — fusing scan→filter→
         project→partial-agg into one dispatch).  Returns the full final
-        environment; output selection happens at the program boundary."""
+        environment; output selection happens at the program boundary.
+        `on_stage(kind, live)` fires after each stage when given — the stats
+        program variant hooks per-stage live counts there; production callers
+        never pass it."""
         comp = ExprCompiler(xp, lift=self.lift)
         compiled = []
         for kind, payload in self.stages:
@@ -167,7 +176,7 @@ class FusedSegment:
                 compiled.append(
                     ("project", [(name, comp.compile(e)) for name, e in payload]))
 
-        def apply(env, live, lits):
+        def apply(env, live, lits, on_stage=None):
             env = dict(env)
             env["$lits"] = lits
             for kind, fns in compiled:
@@ -177,32 +186,53 @@ class FusedSegment:
                     out = {name: f(env) for name, f in fns}
                     out["$lits"] = lits
                     env = out
+                if on_stage is not None:
+                    on_stage(kind, live)
             return env, live
         return apply
 
-    def _program(self, jit: bool):
-        """global_jit-cached fused program returning ONLY computed lanes."""
-        f = self._prog_memo.get(jit)
+    def _program(self, jit: bool, stats: bool = False):
+        """global_jit-cached fused program returning ONLY computed lanes.
+
+        `stats=True` compiles the profiling variant, which additionally
+        returns the post-stage live row count per stage (one extra int32
+        reduction per stage, inside the same program) — a distinct cache key,
+        so enabling profiling never perturbs the production executable."""
+        f = self._prog_memo.get((jit, stats))
         if f is not None:
             return f
         backend = "jnp" if jit else "np"
         computed = list(self.computed)
         seg = self
+        xp = jnp if jit else np
 
         def build():
-            apply = seg.build_apply(jnp if jit else np)
+            apply = seg.build_apply(xp)
 
             def run(env, live, lits):
                 env, live = apply(env, live, lits)
                 n = live.shape[0]
-                out = {name: ops.broadcast_value(n, *env[name],
-                                                 xp=jnp if jit else np)
+                out = {name: ops.broadcast_value(n, *env[name], xp=xp)
                        for name in computed}
                 return out, live
-            return jax.jit(run) if jit else run
-        key = (backend,) + self.key()
+
+            def run_stats(env, live, lits):
+                n = live.shape[0]
+                counts = []
+
+                def on_stage(_kind, lv):
+                    counts.append(xp.sum(
+                        xp.broadcast_to(lv, (n,)).astype(xp.int32)))
+                env, live = apply(env, live, lits, on_stage)
+                out = {name: ops.broadcast_value(n, *env[name], xp=xp)
+                       for name in computed}
+                return out, live, xp.stack(counts)
+
+            picked = run_stats if stats else run
+            return jax.jit(picked) if jit else picked
+        key = (backend, "stats" if stats else "prod") + self.key()
         f = ops.global_jit(key, build, built_flag=self._built_now)
-        self._prog_memo[jit] = f
+        self._prog_memo[(jit, stats)] = f
         return f
 
     # -- execution ----------------------------------------------------------
@@ -214,9 +244,15 @@ class FusedSegment:
         """Apply the segment to a raw (env, live) pair (the MPP path: lanes
         are distributed jax arrays, live is the shard-local mask)."""
         self._compiled_fresh = False
-        t0 = time.perf_counter() if _tracer_on() else 0.0
-        f = self._program(jit)
-        out, live2 = f(env, live, self.lits())
+        sink = self.stats_sink
+        t0 = time.perf_counter() if (_tracer_on() or sink is not None) else 0.0
+        if sink is not None:
+            out, live2, counts = self._program(jit, stats=True)(
+                env, live, self.lits())
+            sink.append((np.asarray(counts),
+                         round((time.perf_counter() - t0) * 1000, 3)))
+        else:
+            out, live2 = self._program(jit)(env, live, self.lits())
         ops.DISPATCH_STATS["dispatches"] += 1
         if _tracer_on():
             self._record_span(live, live2, t0)
@@ -247,18 +283,30 @@ class FusedSegment:
         jax dispatch dwarfs the work at point-query sizes."""
         host = batch.capacity <= ops.TP_HOST_ROWS and ops._is_host_batch(batch)
         self._compiled_fresh = False
-        t0 = time.perf_counter() if _tracer_on() else 0.0
+        sink = self.stats_sink
+        t0 = time.perf_counter() if (_tracer_on() or sink is not None) else 0.0
+        counts = None
         if host:
             env = {n: (c.data, c.valid) for n, c in batch.columns.items()}
             live_in = batch.live if batch.live is not None else \
                 np.ones(batch.capacity, np.bool_)
-            f = self._program(False)
-            out, live = f(env, live_in, self.lits())
+            f = self._program(False, stats=sink is not None)
+            if sink is not None:
+                out, live, counts = f(env, live_in, self.lits())
+            else:
+                out, live = f(env, live_in, self.lits())
             live = np.broadcast_to(np.asarray(live), (batch.capacity,))
         else:
-            f = self._program(True)
-            out, live = f(batch_env(batch), batch.live_mask(), self.lits())
+            f = self._program(True, stats=sink is not None)
+            if sink is not None:
+                out, live, counts = f(batch_env(batch), batch.live_mask(),
+                                      self.lits())
+            else:
+                out, live = f(batch_env(batch), batch.live_mask(), self.lits())
         ops.DISPATCH_STATS["dispatches"] += 1
+        if sink is not None:
+            sink.append((np.asarray(counts),
+                         round((time.perf_counter() - t0) * 1000, 3)))
         if _tracer_on():
             self._record_span(batch.live_mask(), live, t0)
         return ColumnBatch(self.attach_columns(batch.columns, out), live)
@@ -285,7 +333,8 @@ class FusedSegment:
 
 def _tracer_on() -> bool:
     from galaxysql_tpu.utils.tracing import SEGMENT_TRACER
-    return SEGMENT_TRACER.enabled
+    # a query-scoped sink on this thread OR the legacy module-level ring
+    return SEGMENT_TRACER.active
 
 
 class FusedPipelineOp(ops.Operator):
@@ -313,6 +362,21 @@ def segment_for(node, min_stages: int = 1, filters_only: bool = False):
     if filters_only and any(kind != "filter" for kind, _ in stages):
         return node, None
     return base, FusedSegment(stages)
+
+
+def chain_nodes(node) -> List[Any]:
+    """The logical Filter/Project nodes a segment built from `node` covers, in
+    STAGE ORDER (bottom-up — stage i of the FusedSegment is node i here).
+    Profiling uses this to attribute per-stage live counts back to the plan
+    nodes EXPLAIN ANALYZE renders."""
+    from galaxysql_tpu.plan import logical as L
+    out: List[Any] = []
+    cur = node
+    while isinstance(cur, (L.Filter, L.Project)):
+        out.append(cur)
+        cur = cur.child
+    out.reverse()
+    return out
 
 
 def collapse_streaming_chain(node) -> Tuple[List[Stage], Any]:

@@ -148,6 +148,32 @@ class MppExecutor:
     # -- dispatch ----------------------------------------------------------------
 
     def run(self, node: L.RelNode) -> DistBatch:
+        if not getattr(self.ctx, "collect_stats", False):
+            return self._run_node(node)
+        # profiling: per-stage wall + row counts (the reference's MPP
+        # QueryStats/StageStats/TaskStats, §5.1).  Counting live rows forces a
+        # device sync per stage — exactly why the default path never enters
+        # this branch.
+        import time as _t
+        t0 = _t.perf_counter()
+        out = self._run_node(node)
+        if any(st.get("node_id") == id(node) for st in self.ctx.op_stats):
+            # _streaming_chain already reported this node (fused entry with
+            # per-stage rows) — a second plain entry would double-count it
+            return out
+        live = np.asarray(out.live)
+        st = {"node_id": id(node), "operator": type(node).__name__,
+              "engine": "mpp", "batches": 1, "rows_out": int(live.sum()),
+              "wall_ms": round((_t.perf_counter() - t0) * 1000, 3),
+              "replicated": out.replicated}
+        if not out.replicated and live.size % self.S == 0:
+            # per-shard task stats: shard s owns slice s of the [S*R] layout
+            st["rows_per_shard"] = [int(x) for x in
+                                    live.reshape(self.S, -1).sum(axis=1)]
+        self.ctx.op_stats.append(st)
+        return out
+
+    def _run_node(self, node: L.RelNode) -> DistBatch:
         if isinstance(node, L.Scan):
             return self._scan(node)
         if isinstance(node, L.Filter):
@@ -247,12 +273,25 @@ class MppExecutor:
         chain, and returning only computed lanes (passthrough column buffers
         are reattached, never copied through XLA outputs).  The compiled
         program is shared with the single-chip executor via global_jit."""
-        from galaxysql_tpu.exec.fusion import segment_for
+        from galaxysql_tpu.exec.fusion import chain_nodes, segment_for
         base, seg = segment_for(node)
+        sink = None
+        if getattr(self.ctx, "collect_stats", False):
+            sink = []
+            seg.stats_sink = sink  # per-stage rows inside the fused chain
         child = self.run(base)
         if len(seg.stages) >= 2:
             self.ctx.trace.append(f"mpp-fuse-segment {seg.chain}")
         out, live = seg.run_env(child.env(), child.live)
+        if sink:
+            totals = np.sum([c for c, _ in sink], axis=0)
+            wall = round(sum(w for _, w in sink), 3)
+            for i, nd in enumerate(chain_nodes(node)):
+                self.ctx.op_stats.append(
+                    {"node_id": id(nd), "operator": type(nd).__name__,
+                     "engine": "mpp", "batches": len(sink),
+                     "rows_out": int(totals[i]), "wall_ms": wall,
+                     "fused": True, "segment": seg.chain})
         cols = seg.attach_columns(child.columns, out)
         return DistBatch(cols, live, child.replicated)
 

@@ -49,8 +49,9 @@ class ExecContext:
         self.remote_xids: Dict = {}
         self.sort_spill_bytes = 256 << 20   # SORT_SPILL_BYTES (session override)
         self.join_spill_bytes = 256 << 20   # JOIN_SPILL_BYTES
-        self.collect_stats = False       # EXPLAIN ANALYZE per-operator stats
+        self.collect_stats = False       # EXPLAIN ANALYZE / profiling stats
         self.op_stats: List[dict] = []   # filled by StatsOp when collecting
+        self.profile = None              # owning QueryProfile (utils/tracing)
         self.trace: List[str] = []
         # pipeline segment fusion (exec/fusion.py): module switch + NO_FUSE hint
         self.enable_fusion = fusion.default_enabled(self.hints)
@@ -405,13 +406,14 @@ class ValuesSource(ops.Operator):
 
 
 class StatsOp(ops.Operator):
-    """EXPLAIN ANALYZE instrumentation: per-operator batches/rows/wall time
-    (RuntimeStatistics analog).  Only wrapped when ctx.collect_stats is set —
-    num_live() forces a device sync per batch, so the normal path never pays."""
+    """EXPLAIN ANALYZE / profiling instrumentation: per-operator batches/rows/
+    wall time (RuntimeStatistics analog).  Only wrapped when ctx.collect_stats
+    is set — num_live() forces a device sync per batch, so the normal path
+    never pays."""
 
-    def __init__(self, inner: ops.Operator, label: str, ctx: ExecContext):
+    def __init__(self, inner: ops.Operator, node: L.RelNode, ctx: ExecContext):
         self.inner = inner
-        self.label = label
+        self.node = node
         self.ctx = ctx
 
     def batches(self):
@@ -424,20 +426,55 @@ class StatsOp(ops.Operator):
             rows += b.num_live()
             yield b
         self.ctx.op_stats.append(
-            {"operator": self.label, "batches": nb, "rows_out": rows,
+            {"node_id": id(self.node), "operator": type(self.node).__name__,
+             "batches": nb, "rows_out": rows,
              "wall_ms": round((_t.perf_counter() - t0) * 1000, 3)})
+
+
+class SegmentStatsOp(ops.Operator):
+    """Per-operator stats INSIDE a fused segment: drains the segment's stats
+    sink (per-stage live counts per dispatch, from the stats program variant)
+    and attributes stage i's rows back to chain node i.  Wall time is the
+    whole segment's — stages share one program, so per-stage wall does not
+    exist; each chain row carries the shared value, flagged `fused`."""
+
+    def __init__(self, inner: ops.Operator, segment, nodes: List[L.RelNode],
+                 ctx: ExecContext):
+        self.inner = inner
+        self.segment = segment
+        self.nodes = nodes
+        self.ctx = ctx
+        segment.stats_sink = []
+
+    def batches(self):
+        yield from self.inner.batches()
+        sink = self.segment.stats_sink
+        if not sink:
+            return
+        totals = np.sum([c for c, _ in sink], axis=0)
+        wall = round(sum(w for _, w in sink), 3)
+        for i, n in enumerate(self.nodes):
+            self.ctx.op_stats.append(
+                {"node_id": id(n), "operator": type(n).__name__,
+                 "batches": len(sink), "rows_out": int(totals[i]),
+                 "wall_ms": wall, "fused": True,
+                 "segment": self.segment.chain})
 
 
 def build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
     op = _build_operator(node, ctx)
-    if getattr(ctx, "collect_stats", False):
-        return StatsOp(op, type(node).__name__, ctx)
+    if getattr(ctx, "collect_stats", False) and \
+            not isinstance(op, SegmentStatsOp):
+        return StatsOp(op, node, ctx)
     return op
 
 
 def _fusing(ctx: ExecContext) -> bool:
-    # EXPLAIN ANALYZE keeps one StatsOp per plan node: fusing would erase the
-    # per-operator rows/time breakdown the user asked for
+    # kernel-prelude fusion (chains folded INTO the HashAgg partial / join
+    # probe programs) has no per-stage observation point, so profiling keeps
+    # those chains as standalone operators; standalone SEGMENT fusion stays on
+    # under collect_stats — the stats program variant reports per-stage rows,
+    # so EXPLAIN ANALYZE describes the fused shape users actually run
     return ctx.enable_fusion and not getattr(ctx, "collect_stats", False)
 
 
@@ -447,11 +484,22 @@ def _build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
     if isinstance(node, L.Values):
         return ValuesSource(node)
     if isinstance(node, (L.Filter, L.Project)):
-        if _fusing(ctx):
-            base, seg = fusion.segment_for(node, min_stages=2)
+        if ctx.enable_fusion:
+            # profiling fuses even single-stage chains: in production those
+            # fold INTO the downstream kernel (agg prelude / join probe), so
+            # running them as an instrumented one-stage segment keeps the
+            # ANALYZE shape honest (fused tag + per-stage rows) while the
+            # kernel-prelude path is held off (no observation point there)
+            collecting = getattr(ctx, "collect_stats", False)
+            base, seg = fusion.segment_for(node,
+                                           min_stages=1 if collecting else 2)
             if seg is not None:
                 ctx.trace.append(f"fuse-segment {seg.chain}")
-                return fusion.FusedPipelineOp(build_operator(base, ctx), seg)
+                inner = fusion.FusedPipelineOp(build_operator(base, ctx), seg)
+                if collecting:
+                    return SegmentStatsOp(inner, seg,
+                                          fusion.chain_nodes(node), ctx)
+                return inner
         if isinstance(node, L.Filter):
             return ops.FilterOp(build_operator(node.child, ctx), node.cond)
         return ops.ProjectOp(build_operator(node.child, ctx), node.exprs)
@@ -522,6 +570,35 @@ def _build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
         return ops.DistinctOp(u, [(fid, ir.ColRef(fid, typ, d))
                                   for fid, typ, d in node.fields()])
     raise errors.NotSupportedError(f"no physical operator for {type(node).__name__}")
+
+
+def annotate_explain(rel: L.RelNode, op_stats: List[dict]) -> List[str]:
+    """EXPLAIN ANALYZE tree rendering: the logical plan's explain lines with
+    each node annotated with its measured rows/batches/wall time (matched by
+    node identity).  Operators that executed inside a fused segment carry a
+    `fused(<chain>)` tag — their wall time is the whole segment's program.
+
+    Rendering rides the existing `explain_lines` (plain EXPLAIN and ANALYZE
+    must draw the same tree): `explain_lines` emits one line per node in
+    pre-order, which is exactly `L.walk`'s order, so lines and nodes zip."""
+    by_id: Dict[int, dict] = {}
+    for st in op_stats:
+        nid = st.get("node_id")
+        if nid is None:
+            continue
+        # fused entries win: they mark chain membership the plain StatsOp
+        # wrapper (which covers the same top node) cannot see
+        if nid not in by_id or st.get("fused"):
+            by_id[nid] = st
+    lines: List[str] = []
+    for line, n in zip(rel.explain_lines(), L.walk(rel)):
+        st = by_id.get(id(n))
+        if st is not None:
+            tag = f" fused({st['segment']})" if st.get("fused") else ""
+            line += (f"  (actual rows={st['rows_out']} "
+                     f"batches={st['batches']} wall={st['wall_ms']}ms{tag})")
+        lines.append(line)
+    return lines
 
 
 def _probe_prelude(ctx: ExecContext, probe_node: L.RelNode):

@@ -16,6 +16,7 @@ from galaxysql_tpu.types import datatype as dt
 
 _V = dt.VARCHAR
 _I = dt.BIGINT
+_D = dt.DOUBLE
 
 _DEFS: Dict[str, List] = {
     "schemata": [("catalog_name", _V), ("schema_name", _V),
@@ -43,6 +44,16 @@ _DEFS: Dict[str, List] = {
     "plan_cache": [("schema_name", _V), ("cache_key", _V), ("workload", _V),
                    ("hit_count", _I)],
     "engine_counters": [("counter_name", _V), ("value", _I)],
+    # per-query runtime statistics (QueryProfile ring; RuntimeStatistics /
+    # MPP QueryStats analog, §5.1) — one row per recent query
+    "query_stats": [("trace_id", _I), ("conn_id", _I), ("schema_name", _V),
+                    ("workload", _V), ("engine", _V), ("elapsed_ms", _D),
+                    ("rows_returned", _I), ("operator_count", _I),
+                    ("segment_count", _I), ("profiled", _I),
+                    ("peak_rss_kb", _I), ("sql_text", _V)],
+    # the typed counter/gauge registry (utils/metrics.py)
+    "metrics": [("metric_name", _V), ("metric_kind", _V), ("value", _D),
+                ("help", _V)],
 }
 
 
@@ -137,3 +148,12 @@ def refresh(instance, session=None):
     fill("plan_cache", entries)
     fill("engine_counters", ([k, int(v)] for k, v in
                              sorted(getattr(instance, "counters", {}).items())))
+    profiles = getattr(instance, "profiles", None)
+    fill("query_stats", ([p.trace_id, p.conn_id, p.schema, p.workload,
+                          p.engine, p.elapsed_ms, p.rows, len(p.op_stats),
+                          len(p.segments), 1 if p.profiled else 0,
+                          p.peak_rss_kb, p.sql]
+                         for p in (profiles.entries() if profiles else [])))
+    metrics = getattr(instance, "metrics", None)
+    fill("metrics", ([n, k, float(v), h]
+                     for n, k, v, h in (metrics.rows() if metrics else [])))

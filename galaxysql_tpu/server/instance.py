@@ -62,8 +62,18 @@ class Instance:
         self.sync_bus = SyncBus()
         from galaxysql_tpu.meta.ha import HaManager
         self.ha = HaManager(self)
-        import collections
-        self.counters = collections.Counter()  # engine_counters virtual table
+        from galaxysql_tpu.utils.metrics import MetricsRegistry
+        from galaxysql_tpu.utils.tracing import ProfileRing
+        # typed counter/gauge registry: SQL (information_schema.metrics,
+        # SHOW METRICS), web (/metrics Prometheus text) and the legacy
+        # engine-counter surface all render from here
+        self.metrics = MetricsRegistry()
+        # dict-like view over typed counters (engine_counters virtual table);
+        # `counters["x"] += 1` call sites keep working unchanged
+        self.counters = self.metrics.counter_map("engine")
+        # last-N per-query runtime profiles (information_schema.query_stats,
+        # SHOW FULL STATS, web /query/<trace_id>)
+        self.profiles = ProfileRing()
         # (schema, parameterized-sql) -> PointPlan: binder-free execution of
         # archetypal point SELECTs (DirectShardingKeyTableOperation analog)
         self.point_plans: Dict[tuple, object] = {}
@@ -391,7 +401,7 @@ class Instance:
                                      resp["types"], ddata, dvalid)
             tm.remote = {"host": host, "port": port}
             self.catalog.bump_schema()
-        self.counters["table_moves"] += 1
+        self.counters.inc("table_moves")
         return tm
 
     def read_endpoint(self, tm):

@@ -492,9 +492,56 @@ class Session:
             return self.txn.snapshot_ts
         return self.instance.tso.next_timestamp()
 
+    def _profiling_enabled(self) -> bool:
+        return bool(self.instance.config.get("ENABLE_QUERY_PROFILING",
+                                             self.vars))
+
+    def _finish_query(self, sql: str, elapsed: float, prof, workload: str,
+                      engine: str, rows: int, ctx=None):
+        """Every query's single exit ramp: fill + record the QueryProfile,
+        bump the metrics registry, and apply the slow-SQL gate (the one home
+        for the SLOW_SQL_MS check — point, local, and MPP paths all land
+        here)."""
+        from galaxysql_tpu.utils.tracing import GLOBAL_STATS, SLOW_LOG
+        prof.workload = workload
+        prof.engine = engine
+        prof.rows = rows
+        prof.elapsed_ms = round(elapsed * 1000, 3)
+        if ctx is not None:
+            prof.profiled = bool(getattr(ctx, "collect_stats", False))
+            if prof.profiled:
+                prof.op_stats = list(ctx.op_stats)
+            prof.trace = list(ctx.trace)
+        try:
+            import resource
+            prof.peak_rss_kb = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss
+        except Exception:
+            pass  # non-POSIX host: profile simply lacks the memory datapoint
+        inst = self.instance
+        inst.profiles.record(prof)
+        m = inst.metrics
+        m.counter("queries_total", "queries executed").inc()
+        m.counter(f"queries_{workload.lower()}",
+                  f"{workload} workload queries").inc()
+        m.counter(f"engine_exec_{engine}",
+                  f"queries served by the {engine} engine").inc()
+        GLOBAL_STATS.bump("queries")
+        slow_ms = inst.config.get("SLOW_SQL_MS", self.vars)
+        # 0 logs every query (MySQL long_query_time=0); negative disables
+        if slow_ms is not None and slow_ms >= 0 and elapsed * 1000 >= slow_ms:
+            SLOW_LOG.record(sql or "<stmt>", elapsed, self.conn_id,
+                            trace_id=prof.trace_id, workload=workload)
+            GLOBAL_STATS.bump("slow")
+            m.counter("slow_queries", "queries over SLOW_SQL_MS").inc()
+
     def _run_query(self, stmt, sql: str, params: Optional[list]) -> ResultSet:
         schema = self._require_schema()
         t0 = time.time()
+        from galaxysql_tpu.utils.tracing import QueryProfile, next_trace_id
+        prof = QueryProfile(trace_id=next_trace_id(),
+                            sql=(sql or "<stmt>")[:512], schema=schema,
+                            conn_id=self.conn_id, started_at=t0)
         if "information_schema" in (sql or "").lower() or \
                 schema.lower() == "information_schema":
             from galaxysql_tpu.server import information_schema
@@ -502,14 +549,16 @@ class Session:
         from galaxysql_tpu.utils.ccl import GLOBAL_CCL
         admission = GLOBAL_CCL.admit(self, sql or "")
         try:
-            return self._run_query_admitted(stmt, sql, params, schema, t0)
+            return self._run_query_admitted(stmt, sql, params, schema, t0,
+                                            prof)
         finally:
             admission.release()
 
-    def _run_query_admitted(self, stmt, sql, params, schema, t0) -> ResultSet:
+    def _run_query_admitted(self, stmt, sql, params, schema, t0,
+                            prof) -> ResultSet:
         if sql:
             if self.instance.point_plans:
-                rs = self._try_point_exec(sql, params, schema, t0)
+                rs = self._try_point_exec(sql, params, schema, t0, prof)
                 if rs is not None:
                     return rs
             plan = self.instance.planner.plan_select(sql, schema, params, self)
@@ -535,13 +584,18 @@ class Session:
                                                         self.vars)
         ctx.join_spill_bytes = self.instance.config.get("JOIN_SPILL_BYTES",
                                                         self.vars)
+        # query-scoped runtime statistics: the profile rides the ExecContext so
+        # operators, fused segments, and MPP stages all report into it; stats
+        # collection (device syncs!) only when profiling is asked for
+        ctx.profile = prof
+        ctx.collect_stats = self._profiling_enabled()
         if self.txn is not None and self.txn.remote:
             ctx.remote_xids = dict(self.txn.remote)
         from galaxysql_tpu.plan import logical as L
         mdl_keys = {f"{n.table.schema.lower()}.{n.table.name.lower()}"
                     for n in L.walk(plan.rel) if isinstance(n, L.Scan)}
         with self.instance.mdl.shared(mdl_keys):
-            return self._run_query_locked(plan, ctx, sql, t0)
+            return self._run_query_locked(plan, ctx, sql, t0, prof)
 
     # -- point-plan fast path (DirectShardingKeyTableOperation / XPlan key-Get
     # analog, Planner.java:914): archetypal `SELECT cols FROM t WHERE pk = ?`
@@ -602,7 +656,7 @@ class Session:
             self.instance.point_plans.clear()
         self.instance.point_plans[plan.spm_key] = pp
 
-    def _try_point_exec(self, sql, params, schema, t0):
+    def _try_point_exec(self, sql, params, schema, t0, prof):
         from galaxysql_tpu.sql.parameterize import parameterize, DecimalParam
         p = parameterize(sql)
         pp = self.instance.point_plans.get((schema.lower(), p.cache_key))
@@ -672,47 +726,54 @@ class Session:
                             out_cols.append(c.to_pylist())
                     rows.extend(zip(*out_cols))
         elapsed = time.time() - t0
-        self.last_trace = [f"point-plan {pp['table']}.{key_col}",
+        self.last_trace = [f"trace-id {prof.trace_id}",
+                           f"point-plan {pp['table']}.{key_col}",
                            f"elapsed={elapsed:.3f}s workload=TP"]
-        slow_ms = self.instance.config.get("SLOW_SQL_MS", self.vars)
-        if slow_ms is not None and slow_ms >= 0 and elapsed * 1000 >= slow_ms:
-            from galaxysql_tpu.utils.tracing import SLOW_LOG
-            SLOW_LOG.record(sql or "<stmt>", elapsed, self.conn_id)
-        self.instance.counters["point_plan_queries"] += 1
+        prof.trace = list(self.last_trace)
+        self._finish_query(sql, elapsed, prof, "TP", "point", len(rows))
+        self.instance.counters.inc("point_plan_queries")
         return ResultSet(pp["names"], pp["types"], rows)
 
-    def _run_query_locked(self, plan, ctx, sql, t0) -> ResultSet:
+    def _run_query_locked(self, plan, ctx, sql, t0, prof) -> ResultSet:
+        from galaxysql_tpu.utils.tracing import SEGMENT_TRACER
         batch = None
+        mpp_used = False
         engine_hint = getattr(plan, "hints", {}).get("engine")
         want_mpp = engine_hint == "MPP" or (
             engine_hint is None and plan.workload == "AP" and
             self.instance.config.get("ENABLE_MPP", self.vars) and
             plan.scanned_rows >= self.instance.config.get("MPP_MIN_AP_ROWS",
                                                           self.vars))
-        if want_mpp:
-            # cluster MPP mode: the plan compiles to SPMD stages over the device mesh
-            # (ExecutorHelper.executeCluster analog)
-            mesh = self.instance.mesh()
-            if mesh is not None:
-                from galaxysql_tpu.parallel.mpp import MppExecutor
-                try:
-                    batch = MppExecutor(ctx, mesh).execute(plan.rel)
-                    self.instance.counters["mpp_queries"] += 1
-                except errors.NotSupportedError as e:
-                    # plan shape not yet distributed: local engine — NEVER
-                    # silent (trace tag + information_schema.engine_counters)
-                    batch = None
-                    self.instance.counters["mpp_fallback_local"] += 1
-                    ctx.trace.append(f"mpp-fallback {e}")
-        if batch is None:
-            op = build_operator(plan.rel, ctx)
-            # TP fast path: pin execution to the host CPU backend — point queries must
-            # not pay accelerator dispatch/compile latency (CURSOR-mode bypass,
-            # SURVEY.md §7.3 'latency floor')
-            device_ctx = _cpu_device_ctx() \
-                if (plan.workload == "TP" or engine_hint == "TP") else _NULL_CTX
-            with device_ctx:
-                batch = run_to_batch(op)
+        # segment spans correlate to THIS query's profile (not the global
+        # ring) — bound only when profiling, since spans cost a device sync
+        span_scope = SEGMENT_TRACER.scoped(prof.segments) \
+            if ctx.collect_stats else contextlib.nullcontext()
+        with span_scope:
+            if want_mpp:
+                # cluster MPP mode: the plan compiles to SPMD stages over the
+                # device mesh (ExecutorHelper.executeCluster analog)
+                mesh = self.instance.mesh()
+                if mesh is not None:
+                    from galaxysql_tpu.parallel.mpp import MppExecutor
+                    try:
+                        batch = MppExecutor(ctx, mesh).execute(plan.rel)
+                        mpp_used = True
+                        self.instance.counters.inc("mpp_queries")
+                    except errors.NotSupportedError as e:
+                        # plan shape not yet distributed: local engine — NEVER
+                        # silent (trace tag + information_schema.engine_counters)
+                        batch = None
+                        self.instance.counters.inc("mpp_fallback_local")
+                        ctx.trace.append(f"mpp-fallback {e}")
+            if batch is None:
+                op = build_operator(plan.rel, ctx)
+                # TP fast path: pin execution to the host CPU backend — point
+                # queries must not pay accelerator dispatch/compile latency
+                # (CURSOR-mode bypass, SURVEY.md §7.3 'latency floor')
+                device_ctx = _cpu_device_ctx() \
+                    if (plan.workload == "TP" or engine_hint == "TP") else _NULL_CTX
+                with device_ctx:
+                    batch = run_to_batch(op)
         batch = batch.compact()
         rows = batch.to_pylist()
         fields = plan.fields()
@@ -723,13 +784,10 @@ class Session:
             self.instance.planner.spm.record_execution(
                 plan.spm_key, elapsed * 1000.0,
                 getattr(plan, "bound_params", None))
-        self.last_trace = ctx.trace + [f"elapsed={elapsed:.3f}s "
-                                       f"workload={plan.workload}"]
-        slow_ms = self.instance.config.get("SLOW_SQL_MS", self.vars)
-        # 0 logs every query (MySQL long_query_time=0); negative disables
-        if slow_ms is not None and slow_ms >= 0 and elapsed * 1000 >= slow_ms:
-            from galaxysql_tpu.utils.tracing import SLOW_LOG
-            SLOW_LOG.record(sql or "<stmt>", elapsed, self.conn_id)
+        self.last_trace = [f"trace-id {prof.trace_id}"] + ctx.trace + \
+            [f"elapsed={elapsed:.3f}s workload={plan.workload}"]
+        self._finish_query(sql, elapsed, prof, plan.workload,
+                           "mpp" if mpp_used else "local", len(rows), ctx)
         return ResultSet(plan.display_names, [t for _, t, _ in fields], rows,
                          batch=batch)
 
@@ -1275,6 +1333,9 @@ class Session:
         plan = self.instance.planner.bind_statement(inner, schema, params or [])
         lines = plan.explain().split("\n")
         if stmt.analyze:
+            from galaxysql_tpu.utils.tracing import (QueryProfile,
+                                                     SEGMENT_TRACER,
+                                                     next_trace_id)
             cache = None
             if plan.workload == "AP" and self.instance.config.get(
                     "ENABLE_TPU_ENGINE", self.vars):
@@ -1282,12 +1343,16 @@ class Session:
                 cache = GLOBAL_DEVICE_CACHE
             # same engine configuration as the real execution path — analyze
             # numbers must describe the plan users actually run (device cache
-            # included), not a cold host-only variant
+            # and pipeline fusion included), not a cold host-only variant
             ctx = ExecContext(self.instance.stores, self._snapshot_ts(),
                               params or [], device_cache=cache,
                               archive=self.instance.archive,
                               archive_instance=self.instance)
             ctx.collect_stats = True  # per-operator rows/time (RuntimeStatistics)
+            prof = QueryProfile(trace_id=next_trace_id(),
+                                sql="<explain analyze>", schema=schema,
+                                conn_id=self.conn_id, started_at=time.time())
+            ctx.profile = prof
             op = build_operator(plan.rel, ctx)
             from galaxysql_tpu.plan import logical as L
             mdl_keys = {f"{n.table.schema.lower()}.{n.table.name.lower()}"
@@ -1295,14 +1360,30 @@ class Session:
             t0 = time.time()
             # statement-scope shared MDL: concurrent column DDL must not swap
             # partition lanes mid-execution (same torn-read class as SELECT)
-            with self.instance.mdl.shared(mdl_keys):
+            with self.instance.mdl.shared(mdl_keys), \
+                    SEGMENT_TRACER.scoped(prof.segments):
                 batch = run_to_batch(op)
             elapsed = time.time() - t0
-            lines += [f"-- rows: {batch.num_live()}", f"-- elapsed: {elapsed:.3f}s"] + \
+            rows = batch.num_live()
+            # the operator tree annotated in place with measured rows/time —
+            # operators inside fused segments included (per-stage counts from
+            # the stats program variant, tagged `fused(<chain>)`)
+            from galaxysql_tpu.plan.physical import annotate_explain
+            lines = annotate_explain(plan.rel, ctx.op_stats)
+            lines += [f"-- trace_id: {prof.trace_id}", f"-- rows: {rows}",
+                      f"-- elapsed: {elapsed:.3f}s"] + \
                 [f"-- {t}" for t in ctx.trace]
             for st in ctx.op_stats:
+                tag = f" fused({st['segment']})" if st.get("fused") else ""
                 lines.append(f"-- op {st['operator']}: rows={st['rows_out']} "
-                             f"batches={st['batches']} wall={st['wall_ms']}ms")
+                             f"batches={st['batches']} "
+                             f"wall={st['wall_ms']}ms{tag}")
+            for sp in prof.segments:
+                lines.append(f"-- segment {sp.segment_id} {sp.chain}: "
+                             f"rows_in={sp.rows_in} rows_out={sp.rows_out} "
+                             f"compiled={sp.compiled} wall={sp.wall_ms}ms")
+            self._finish_query(prof.sql, elapsed, prof, plan.workload,
+                               "local", rows, ctx)
         lines.append(f"-- workload: {plan.workload}")
         return ResultSet(["plan"], [dt.VARCHAR], [(l,) for l in lines])
 

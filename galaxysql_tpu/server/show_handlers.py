@@ -21,6 +21,21 @@ def _like_filter(names: List[str], pattern) -> List[str]:
     return [n for n in names if fnmatch.fnmatch(n.lower(), translated.lower())]
 
 
+def _profile_rows(inst):
+    """Last-N QueryProfiles as a result set, newest first (SHOW FULL STATS)."""
+    from galaxysql_tpu.server.session import ResultSet
+    rows = []
+    for p in reversed(inst.profiles.entries()):
+        rows.append((p.trace_id, p.conn_id, p.schema, p.workload, p.engine,
+                     p.elapsed_ms, p.rows, len(p.op_stats), len(p.segments),
+                     1 if p.profiled else 0, p.sql))
+    return ResultSet(
+        ["Trace_id", "Conn", "Schema", "Workload", "Engine", "Elapsed_ms",
+         "Rows", "Operators", "Segments", "Profiled", "SQL"],
+        [dt.BIGINT, dt.BIGINT, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.DOUBLE,
+         dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.VARCHAR], rows)
+
+
 def handle(session, stmt: ast.Show):
     from galaxysql_tpu.server.session import ResultSet
 
@@ -129,10 +144,22 @@ def handle(session, stmt: ast.Show):
                           dt.VARCHAR, dt.VARCHAR], rows)
     if kind == "slow":
         from galaxysql_tpu.utils.tracing import SLOW_LOG
-        rows = [(e.conn_id, round(e.elapsed_s * 1000, 1), e.sql)
+        # Trace_id links a slow row to its profile (SHOW FULL STATS /
+        # information_schema.query_stats / web /query/<trace_id>)
+        rows = [(e.conn_id, round(e.elapsed_s * 1000, 1), e.sql,
+                 e.trace_id, e.workload)
                 for e in SLOW_LOG.entries()]
-        return ResultSet(["Conn", "Elapsed_ms", "SQL"],
-                         [dt.BIGINT, dt.DOUBLE, dt.VARCHAR], rows)
+        return ResultSet(["Conn", "Elapsed_ms", "SQL", "Trace_id", "Workload"],
+                         [dt.BIGINT, dt.DOUBLE, dt.VARCHAR, dt.BIGINT,
+                          dt.VARCHAR], rows)
+    if kind == "metrics":
+        # the typed counter/gauge registry (information_schema.metrics twin)
+        rows = [(n, k, float(v), h) for n, k, v, h in inst.metrics.rows()]
+        return ResultSet(["Name", "Kind", "Value", "Help"],
+                         [dt.VARCHAR, dt.VARCHAR, dt.DOUBLE, dt.VARCHAR],
+                         rows)
+    if kind == "profiles":
+        return _profile_rows(inst)
     if kind == "ccl_rules":
         from galaxysql_tpu.utils.ccl import GLOBAL_CCL
         rows = []
@@ -145,6 +172,10 @@ def handle(session, stmt: ast.Show):
                          [dt.VARCHAR, dt.BIGINT, dt.VARCHAR, dt.VARCHAR, dt.BIGINT,
                           dt.BIGINT, dt.BIGINT, dt.BIGINT], rows)
     if kind == "stats":
+        # SHOW STATS = instance counters (§5.5); SHOW FULL STATS = the last-N
+        # per-query runtime profiles (the reference's SHOW FULL STATS surface)
+        if stmt.full:
+            return _profile_rows(inst)
         from galaxysql_tpu.utils.tracing import GLOBAL_STATS
         return ResultSet(["Name", "Value"], [dt.VARCHAR, dt.BIGINT],
                          GLOBAL_STATS.snapshot())

@@ -3,12 +3,17 @@
 Reference analog: `polardbx-executor/.../mpp/web/*` (query/stage/cluster JSON
 resources served by the MPP coordinator's HTTP server).  Endpoints:
 
-- /status      node identity, uptime, engine counters
-- /queries     per-session state + last trace + the slow-query log
-- /cluster     HA node states, leader, attached workers + fence state
-- /plan-cache  hit/miss/size
-- /baselines   SPM baselines (SHOW BASELINE as JSON)
-- /scheduler   background jobs + recent firings
+- /status            node identity, uptime, engine counters
+- /queries           per-session state + last trace + the slow-query log
+- /cluster           HA node states, leader, attached workers + fence state
+- /plan-cache        hit/miss/size
+- /baselines         SPM baselines (SHOW BASELINE as JSON)
+- /scheduler         background jobs + recent firings
+- /query-stats       last-N QueryProfile summaries (newest first)
+- /query/<trace_id>  one query's full profile: per-operator rows/time,
+                     fused-segment spans, trace tags (QueryStats analog)
+- /metrics           the typed counter/gauge registry in Prometheus text
+                     exposition format (the scrape endpoint)
 
 Read-only by design: mutations go through SQL/DAL, never HTTP.
 """
@@ -50,7 +55,8 @@ class WebConsole:
                     "in_txn": getattr(s, "txn", None) is not None,
                     "last_trace": list(getattr(s, "last_trace", []))[-8:]})
             slow = [{"sql": e.sql, "elapsed_s": e.elapsed_s,
-                     "conn_id": e.conn_id, "at": e.at}
+                     "conn_id": e.conn_id, "at": e.at,
+                     "trace_id": e.trace_id, "workload": e.workload}
                     for e in SLOW_LOG.entries()]
             return {"sessions": sessions, "slow_queries": slow[-50:]}
         if path == "/cluster":
@@ -76,7 +82,45 @@ class WebConsole:
             hist = [{"name": n, "fired_at": at, "status": st, "detail": d}
                     for n, at, st, d in inst.scheduler.history()[-50:]]
             return {"jobs": jobs, "history": hist}
+        if path == "/query-stats":
+            return {"queries": [
+                {"trace_id": p.trace_id, "conn_id": p.conn_id,
+                 "schema": p.schema, "workload": p.workload,
+                 "engine": p.engine, "elapsed_ms": p.elapsed_ms,
+                 "rows": p.rows, "profiled": p.profiled, "sql": p.sql}
+                for p in reversed(inst.profiles.entries())]}
+        if path.startswith("/query/"):
+            try:
+                trace_id = int(path[len("/query/"):])
+            except ValueError:
+                return None
+            p = inst.profiles.get(trace_id)
+            if p is None:
+                return None
+            return p.to_dict()  # segments/op_stats serialized there
         return None
+
+    def metrics_text(self) -> str:
+        """Prometheus text for /metrics: the instance registry plus a few
+        point-in-time gauges stamped at scrape time.  The scrape-time gauges
+        live in a throwaway registry — persisting them in the instance
+        registry would leave stale point-in-time values visible to SHOW
+        METRICS / information_schema.metrics between scrapes."""
+        from galaxysql_tpu.utils.metrics import MetricsRegistry
+        from galaxysql_tpu.utils.tracing import GLOBAL_STATS
+        scrape = MetricsRegistry()
+        scrape.gauge("sessions_active", "open sessions").set(
+            len(self.instance.sessions))
+        scrape.gauge("uptime_seconds", "web console uptime").set(
+            round(time.time() - self.started_at, 1))
+        scrape.gauge("query_profiles_retained",
+                     "profiles in the last-N ring").set(
+            len(self.instance.profiles.entries()))
+        for name, value in GLOBAL_STATS.snapshot():
+            scrape.gauge(f"instance_{name}",
+                         "MatrixStatistics counter").set(value)
+        return self.instance.metrics.prometheus_text() + \
+            scrape.prometheus_text()
 
     # -- http ----------------------------------------------------------------
 
@@ -85,6 +129,22 @@ class WebConsole:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
+                if self.path.rstrip("/") == "/metrics":
+                    # Prometheus scrape endpoint: text exposition, not JSON
+                    try:
+                        data = console.metrics_text().encode()
+                    except Exception as e:
+                        self.send_response(500)
+                        self.end_headers()
+                        self.wfile.write(json.dumps({"error": str(e)}).encode())
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 try:
                     body = console.resource(self.path.rstrip("/") or "/status")
                 except Exception as e:  # a broken resource must not kill the server

@@ -1,0 +1,192 @@
+"""Typed metrics registry: named counters and gauges with one SQL/HTTP surface.
+
+Reference analog: SURVEY.md §5.5 — `MatrixStatistics` instance counters plus the
+MPP coordinator's JSON stats resources.  The reference scatters counters across
+ad-hoc fields; here every metric registers in one typed registry so
+`information_schema.metrics`, `SHOW METRICS`, and the web console's Prometheus
+`/metrics` endpoint all render the same set without per-counter wiring.
+
+All operations are host-side integer/float updates under a registry lock —
+nothing here may touch device state (the metrics layer must be free on the
+query hot path).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, Iterator, List, Tuple
+
+
+class Counter:
+    """Monotonic named counter (Prometheus `counter`)."""
+
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+    def _set(self, v):
+        # CounterMap compatibility (`counters[k] += 1` does get-then-set);
+        # not part of the public counter API — counters stay monotonic there
+        # because += only grows.
+        with self._lock:
+            self._value = v
+
+
+class Gauge:
+    """Settable instantaneous value (Prometheus `gauge`)."""
+
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v):
+        with self._lock:
+            self._value = v
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    def dec(self, n=1):
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self):
+        return self._value
+
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _sanitize(name: str) -> str:
+    return _NAME_RE.sub("_", name)
+
+
+class MetricsRegistry:
+    """get-or-create registry of typed metrics.
+
+    A name registers as exactly one kind; asking for the same name with the
+    other kind raises (a counter silently readable as a gauge would hide a
+    wiring bug forever).
+    """
+
+    def __init__(self, namespace: str = "galaxysql"):
+        self.namespace = _sanitize(namespace)
+        self._metrics: "Dict[str, object]" = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, name: str, cls, help: str):
+        name = _sanitize(name)
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, Counter, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(name, Gauge, help)
+
+    def counter_map(self, prefix: str) -> "CounterMap":
+        return CounterMap(self, prefix)
+
+    def rows(self) -> List[Tuple[str, str, float, str]]:
+        """(name, kind, value, help) per metric, name-sorted — the
+        information_schema.metrics / SHOW METRICS row shape."""
+        with self._lock:
+            ms = sorted(self._metrics.items())
+        return [(n, m.kind, m.value, m.help) for n, m in ms]
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format (one block per metric)."""
+        out = []
+        for name, kind, value, help in self.rows():
+            full = f"{self.namespace}_{name}"
+            if help:
+                out.append(f"# HELP {full} {help}")
+            out.append(f"# TYPE {full} {kind}")
+            if isinstance(value, float) and not value.is_integer():
+                out.append(f"{full} {value}")
+            else:
+                out.append(f"{full} {int(value)}")
+        return "\n".join(out) + "\n"
+
+
+class CounterMap:
+    """dict-like adapter over registry counters (the `instance.counters`
+    surface: `counters["mpp_queries"] += 1`, `dict(counters)`, `.items()`).
+    Every entry is a real typed Counter named `<prefix>_<key>`, so ad-hoc
+    engine counters surface through /metrics and information_schema.metrics
+    with zero extra wiring."""
+
+    def __init__(self, registry: MetricsRegistry, prefix: str):
+        self._registry = registry
+        self._prefix = _sanitize(prefix)
+
+    def _counter(self, key: str) -> Counter:
+        return self._registry.counter(f"{self._prefix}_{_sanitize(key)}")
+
+    def __getitem__(self, key: str) -> int:
+        return self._counter(key).value
+
+    def __setitem__(self, key: str, value: int):
+        # NOTE: `counters[k] += 1` decomposes into get-then-set and can lose
+        # concurrent increments; hot counter bumps use inc() (atomic).
+        self._counter(key)._set(value)
+
+    def inc(self, key: str, n: int = 1):
+        """Atomic increment (the locked Counter.inc) — use this on paths that
+        can race, not `counters[k] += 1`."""
+        self._counter(key).inc(n)
+
+    def get(self, key: str, default: int = 0) -> int:
+        name = f"{self._prefix}_{_sanitize(key)}"
+        with self._registry._lock:
+            m = self._registry._metrics.get(name)
+        return m.value if m is not None else default
+
+    def keys(self) -> List[str]:
+        pre = self._prefix + "_"
+        with self._registry._lock:
+            names = list(self._registry._metrics)
+        return [n[len(pre):] for n in sorted(names) if n.startswith(pre)]
+
+    def items(self) -> List[Tuple[str, int]]:
+        return [(k, self[k]) for k in self.keys()]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.keys())
+
+    def __len__(self) -> int:
+        return len(self.keys())
+
+    def __contains__(self, key: str) -> bool:
+        return key in self.keys()

@@ -8,10 +8,23 @@ surfaced via EXPLAIN ANALYZE and SHOW FULL STATS.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
+import itertools
 import threading
 import time
-from typing import Deque, List, Tuple
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+# -- monotonic trace ids -------------------------------------------------------
+
+_TRACE_IDS = itertools.count(1)
+_TRACE_ID_LOCK = threading.Lock()
+
+
+def next_trace_id() -> int:
+    """Process-monotonic query trace id (the reference's traceId, §5.1)."""
+    with _TRACE_ID_LOCK:
+        return next(_TRACE_IDS)
 
 
 @dataclasses.dataclass
@@ -20,6 +33,8 @@ class SlowEntry:
     elapsed_s: float
     conn_id: int
     at: float
+    trace_id: int = 0     # links SHOW SLOW rows to information_schema.query_stats
+    workload: str = ""    # TP | AP
 
 
 class SlowLog:
@@ -29,9 +44,11 @@ class SlowLog:
         self._ring: Deque[SlowEntry] = collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
 
-    def record(self, sql: str, elapsed_s: float, conn_id: int):
+    def record(self, sql: str, elapsed_s: float, conn_id: int,
+               trace_id: int = 0, workload: str = ""):
         with self._lock:
-            self._ring.append(SlowEntry(sql[:512], elapsed_s, conn_id, time.time()))
+            self._ring.append(SlowEntry(sql[:512], elapsed_s, conn_id,
+                                        time.time(), trace_id, workload))
 
     def entries(self) -> List[SlowEntry]:
         with self._lock:
@@ -57,19 +74,53 @@ class SegmentSpan:
 
 
 class SegmentTracer:
-    """Bounded ring of per-segment spans — fused pipelines collapse several
-    operators into one program, so EXPLAIN-style per-operator stats can't see
-    inside them; these spans keep them observable.
+    """Per-segment span recorder — fused pipelines collapse several operators
+    into one program, so EXPLAIN-style per-operator stats can't see inside
+    them; these spans keep them observable.
 
     Off by default: rows in/out force a device sync per batch, which the hot
-    path must never pay.  Enable around a query, then read `spans()`."""
+    path must never pay.  Two ways to enable:
+
+    - `scoped(sink)` (preferred): a context manager binding a per-query sink on
+      the calling thread, so spans from concurrent sessions land in their own
+      QueryProfile instead of interleaving in one shared ring.
+    - `enabled = True`: the legacy module-level ring fallback (spans from every
+      thread without an active scope share `_ring`)."""
 
     def __init__(self, capacity: int = 1024):
         self._ring: Deque[SegmentSpan] = collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
+        self._local = threading.local()
         self.enabled = False
 
+    def _sink(self) -> Optional[list]:
+        return getattr(self._local, "sink", None)
+
+    @property
+    def active(self) -> bool:
+        """True when spans should be recorded on this thread (a scoped sink is
+        bound, or the global ring is enabled)."""
+        return self.enabled or self._sink() is not None
+
+    @contextlib.contextmanager
+    def scoped(self, sink: Optional[list] = None):
+        """Route this thread's spans into `sink` (a plain list) for the
+        duration — the query-scoped collector.  Nests: the previous sink is
+        restored on exit."""
+        if sink is None:
+            sink = []
+        prev = self._sink()
+        self._local.sink = sink
+        try:
+            yield sink
+        finally:
+            self._local.sink = prev
+
     def record(self, span: SegmentSpan):
+        sink = self._sink()
+        if sink is not None:
+            sink.append(span)
+            return
         with self._lock:
             self._ring.append(span)
 
@@ -83,6 +134,67 @@ class SegmentTracer:
 
 
 SEGMENT_TRACER = SegmentTracer()
+
+
+# -- per-query runtime statistics ---------------------------------------------
+
+
+@dataclasses.dataclass
+class QueryProfile:
+    """One query's runtime statistics (RuntimeStatistics / MPP QueryStats
+    analog, §5.1): identity + totals always (host-side, zero device syncs),
+    per-operator rows/time and segment spans only when profiling was enabled
+    for the execution (`profiled`)."""
+
+    trace_id: int
+    sql: str
+    schema: str
+    conn_id: int
+    started_at: float = 0.0
+    workload: str = ""            # TP | AP
+    engine: str = "local"         # local | mpp | point
+    elapsed_ms: float = 0.0
+    rows: int = 0                 # result cardinality (free: host rows exist)
+    peak_rss_kb: int = 0          # process high-water host memory at finish
+    profiled: bool = False        # per-operator stats were collected
+    op_stats: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    segments: List[SegmentSpan] = dataclasses.field(default_factory=list)
+    trace: List[str] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        # op_stats node ids are process addresses — meaningless outside
+        for st in d["op_stats"]:
+            st.pop("node_id", None)
+        return d
+
+
+class ProfileRing:
+    """Bounded ring of the last-N QueryProfiles (per engine instance), indexed
+    by trace id for the web console's /query/<trace_id> resource."""
+
+    def __init__(self, capacity: int = 256):
+        self._ring: Deque[QueryProfile] = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, profile: QueryProfile):
+        with self._lock:
+            self._ring.append(profile)
+
+    def entries(self) -> List[QueryProfile]:
+        with self._lock:
+            return list(self._ring)
+
+    def get(self, trace_id: int) -> Optional[QueryProfile]:
+        with self._lock:
+            for p in self._ring:
+                if p.trace_id == trace_id:
+                    return p
+        return None
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
 
 
 class MatrixStatistics:
