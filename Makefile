@@ -20,7 +20,14 @@ fusion-smoke:
 obs-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m observability -p no:cacheprovider
 
+# fast runtime-filter smoke: filter value semantics (empty build, NULL keys,
+# bloom FP tolerance), planner annotation + hint gating, and result
+# equivalence with RUNTIME_FILTER(OFF) on TPC-H Q3/Q5/Q9/Q18 + SSB Q2.1 on
+# both the local engine and the 8-device mesh
+rf-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m runtime_filter -p no:cacheprovider
+
 bench:
 	$(PY) bench.py
 
-.PHONY: tier1 fusion-smoke obs-smoke bench
+.PHONY: tier1 fusion-smoke obs-smoke rf-smoke bench

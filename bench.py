@@ -157,6 +157,55 @@ def pandas_q5(data):
     return time.perf_counter() - t0, g
 
 
+def pandas_q9(data):
+    """Host baseline: pandas Q9 (product-type profit: 6-table join over
+    high-NDV part/supplier keys — the runtime-filter probe-pruning shape)."""
+    import pandas as pd
+    part = pd.DataFrame({"pk": data["part"]["p_partkey"],
+                         "pn": data["part"]["p_name"]})
+    sup = pd.DataFrame({"sk": data["supplier"]["s_suppkey"],
+                        "nk": data["supplier"]["s_nationkey"]})
+    li = pd.DataFrame({"ok": data["lineitem"]["l_orderkey"],
+                       "pk": data["lineitem"]["l_partkey"],
+                       "sk": data["lineitem"]["l_suppkey"],
+                       "qty": data["lineitem"]["l_quantity"],
+                       "price": data["lineitem"]["l_extendedprice"],
+                       "disc": data["lineitem"]["l_discount"]})
+    ps = pd.DataFrame({"pk": data["partsupp"]["ps_partkey"],
+                       "sk": data["partsupp"]["ps_suppkey"],
+                       "cost": data["partsupp"]["ps_supplycost"]})
+    orders = pd.DataFrame({"ok": data["orders"]["o_orderkey"],
+                           "od": data["orders"]["o_orderdate"]})
+    nation = pd.DataFrame({"nk": data["nation"]["n_nationkey"],
+                           "nn": data["nation"]["n_name"]})
+    t0 = time.perf_counter()
+    pf = part[part.pn.str.contains("green")][["pk"]]
+    j = li.merge(pf, on="pk").merge(sup, on="sk") \
+          .merge(ps, on=["pk", "sk"]).merge(orders, on="ok") \
+          .merge(nation, on="nk")
+    amount = j.price * (1 - j.disc) - j.cost * j.qty
+    year = pd.to_datetime(j.od, unit="D", origin="unix").dt.year
+    g = j.assign(a=amount, y=year).groupby(["nn", "y"], sort=False).a.sum()
+    g = g.reset_index().sort_values(["nn", "y"], ascending=[True, False])
+    return time.perf_counter() - t0, g
+
+
+def rf_probe_rows_delta(s, q):
+    """Probe rows reaching join probe stages, runtime filters ON vs OFF —
+    the pruning win the planned-filter pass buys, measured outside the timed
+    loops (the counter adds a pre-bloom device sync per probe batch)."""
+    from galaxysql_tpu.exec import runtime_filter as rfmod
+    rfmod.reset_rf_stats(enabled=True)
+    s.execute(q)
+    on_rows = rfmod.RF_STATS["probe_rows"]
+    built = rfmod.RF_STATS["filters_built"]
+    rfmod.reset_rf_stats(enabled=True)
+    s.execute("/*+TDDL:RUNTIME_FILTER(OFF)*/ " + q)
+    off_rows = rfmod.RF_STATS["probe_rows"]
+    rfmod.reset_rf_stats(enabled=False)
+    return on_rows, off_rows, built
+
+
 def pandas_ds_q7(d):
     """Host baseline: pandas TPC-DS q7 (5-way join + 4 avgs, config 5)."""
     import pandas as pd
@@ -422,6 +471,28 @@ def main():
         "vs_baseline": round(q5_base / q5_best, 3), "platform": platform,
         "dispatches_per_exec": q5_d,
         "profile": _profile_summary(s, QUERIES[5]),
+    })
+
+    # -- runtime-filter pruning win: probe rows scanned, filters on vs off ----
+    on_rows, off_rows, built = rf_probe_rows_delta(s, QUERIES[5])
+    results.append({
+        "metric": f"tpch_q5_sf{sf:g}_rf_probe_rows_delta",
+        "value": round(off_rows / max(on_rows, 1), 3), "unit": "x",
+        "vs_baseline": round(off_rows / max(on_rows, 1), 3),
+        "probe_rows_filters_on": on_rows,
+        "probe_rows_filters_off": off_rows,
+        "filters_built": built, "platform": platform,
+    })
+
+    # -- TPC-H Q9: 6-table product-profit join (runtime-filter headline) -------
+    q9_best, q9_d = _bench_query_d(s, QUERIES[9], runs)
+    q9_base = min(pandas_q9(data)[0] for _ in range(runs))
+    results.append({
+        "metric": f"tpch_q9_sf{sf:g}_rows_per_sec_per_chip",
+        "value": round(n_rows / q9_best, 1), "unit": "rows/s",
+        "vs_baseline": round(q9_base / q9_best, 3), "platform": platform,
+        "dispatches_per_exec": q9_d,
+        "profile": _profile_summary(s, QUERIES[9]),
     })
 
     # -- TPC-DS q7: 5-way star join + 4 avgs (config 5) ------------------------

@@ -53,6 +53,9 @@ from galaxysql_tpu.expr.compiler import (ExprCompiler, LiftedLiterals,
 ENABLED = os.environ.get("GALAXYSQL_FUSION", "1") != "0"
 
 # Stage = ("filter", ir.Expr) | ("project", [(name, ir.Expr), ...])
+#       | ("rf", runtime_filter.RfStageRef)   — a planned runtime-filter
+#         prelude masking a scan column against a join build side; its static
+#         shape keys the program, the filter words/range are runtime args
 Stage = Tuple[str, Any]
 
 _SEGMENT_IDS = itertools.count(1)
@@ -68,7 +71,7 @@ def _stage_exprs(stages: Sequence[Stage]) -> List[ir.Expr]:
     for kind, payload in stages:
         if kind == "filter":
             out.append(payload)
-        else:
+        elif kind == "project":
             out.extend(e for _, e in payload)
     return out
 
@@ -90,6 +93,9 @@ class FusedSegment:
             lift = None  # masking ambiguous: bake values (always correct)
         self.lift = lift
         self._tkeys = tkeys
+        # runtime-filter prelude stages, in stage order (injected as a prefix)
+        self.rf_refs = [p for k, p in self.stages if k == "rf"]
+        self.rf_stage_count = len(self.rf_refs)
         # passthrough analysis: map each final output name to the INPUT column
         # it is a bare rename of, or None when it is computed.  alias=None
         # means no project stage exists: the output namespace IS the input
@@ -131,7 +137,9 @@ class FusedSegment:
         parts: List[Tuple] = []
         ti = 0
         for kind, payload in self.stages:
-            if kind == "filter":
+            if kind == "rf":
+                parts.append(payload.static_key())
+            elif kind == "filter":
                 if self._tkeys is not None:
                     k = self._tkeys[ti]
                     ti += 1
@@ -149,9 +157,24 @@ class FusedSegment:
                 parts.append(("project", tuple(eks)))
         return ("fused_segment", tuple(parts))
 
+    def inert(self) -> bool:
+        """True when every stage is an UNPUBLISHED runtime filter: the segment
+        provably computes identity (no mask to apply, no columns computed).
+        Callers use this to skip the per-batch program dispatch entirely —
+        valid only after the producing join's build side has had its chance
+        to publish (i.e. from the first probe batch onward)."""
+        return all(k == "rf" for k, _ in self.stages) and \
+            all(r.static_key()[-1] == ("off",) for r in self.rf_refs)
+
     def lits(self) -> Tuple:
+        """(lifted literal values, per-rf-stage runtime args) — one opaque
+        pytree every caller threads into the compiled program unchanged.
+        Memoized per segment instance: rf args resolve at first dispatch,
+        which the pull model guarantees is after the build side published."""
         if self._lits_memo is None:
-            self._lits_memo = self.lift.values() if self.lift is not None else ()
+            lift_vals = self.lift.values() if self.lift is not None else ()
+            rf_vals = tuple(r.runtime_args() for r in self.rf_refs)
+            self._lits_memo = (lift_vals, rf_vals)
         return self._lits_memo
 
     # -- compilation --------------------------------------------------------
@@ -170,21 +193,28 @@ class FusedSegment:
         comp = ExprCompiler(xp, lift=self.lift)
         compiled = []
         for kind, payload in self.stages:
-            if kind == "filter":
+            if kind == "rf":
+                compiled.append(("rf", payload.make_fn(xp)))
+            elif kind == "filter":
                 compiled.append(("filter", comp.compile_predicate(payload)))
             else:
                 compiled.append(
                     ("project", [(name, comp.compile(e)) for name, e in payload]))
 
         def apply(env, live, lits, on_stage=None):
+            lift_vals, rf_vals = lits
             env = dict(env)
-            env["$lits"] = lits
+            env["$lits"] = lift_vals
+            ri = 0
             for kind, fns in compiled:
-                if kind == "filter":
+                if kind == "rf":
+                    live = fns(env, live, rf_vals[ri])
+                    ri += 1
+                elif kind == "filter":
                     live = live & fns(env)
                 else:
                     out = {name: f(env) for name, f in fns}
-                    out["$lits"] = lits
+                    out["$lits"] = lift_vals
                     env = out
                 if on_stage is not None:
                     on_stage(kind, live)
@@ -218,7 +248,9 @@ class FusedSegment:
 
             def run_stats(env, live, lits):
                 n = live.shape[0]
-                counts = []
+                # counts[0] is the INPUT live count; counts[1+i] is stage i's —
+                # the leading entry lets rf-stage consumers compute pruned rows
+                counts = [xp.sum(xp.broadcast_to(live, (n,)).astype(xp.int32))]
 
                 def on_stage(_kind, lv):
                     counts.append(xp.sum(
@@ -346,22 +378,47 @@ class FusedPipelineOp(ops.Operator):
         self.segment = segment
 
     def batches(self):
-        for b in self.child.batches():
+        it = self.child.batches()
+        first = next(it, None)
+        if first is None:
+            return
+        if self.segment.inert():
+            # rf-only segment whose filters never published (grace-spilled or
+            # oversized build, deactivated edge): pure passthrough — don't
+            # pay a per-batch identity-program dispatch
+            yield first
+            yield from it
+            return
+        yield self.segment.run_batch(first)
+        for b in it:
             yield self.segment.run_batch(b)
 
 
-def segment_for(node, min_stages: int = 1, filters_only: bool = False):
+def segment_for(node, min_stages: int = 1, filters_only: bool = False,
+                rf=None):
     """Shared collapse-into-segment wiring for the local and MPP engines:
     (base node, FusedSegment | None).  Returns a segment only when the chain
     above `node` has at least `min_stages` stages (and, with `filters_only`,
     no project stage — the join-probe case, where a project would change the
-    column namespace the join gathers from); otherwise (node, None)."""
+    column namespace the join gathers from); otherwise (node, None).
+
+    `rf` (a runtime_filter.RuntimeFilterManager) injects the base scan's
+    planned runtime filters as ("rf", …) prelude stages INSIDE the segment —
+    one program applies filter-pushdown + the streaming chain in a single
+    dispatch — and marks the scan consumed so the scan-level fallback
+    (plan/physical._wrap_scan_rf, parallel/mpp._scan) skips it."""
     stages, base = collapse_streaming_chain(node)
-    if len(stages) < min_stages:
+    rf_stages = rf.stages_for(base) if rf is not None else []
+    if rf_stages and rf.consumed(base):
+        rf_stages = []
+    all_stages = rf_stages + stages
+    if len(all_stages) < min_stages:
         return node, None
-    if filters_only and any(kind != "filter" for kind, _ in stages):
+    if filters_only and any(kind == "project" for kind, _ in all_stages):
         return node, None
-    return base, FusedSegment(stages)
+    if rf_stages:
+        rf.mark_consumed(base)
+    return base, FusedSegment(all_stages)
 
 
 def chain_nodes(node) -> List[Any]:

@@ -24,6 +24,7 @@ from galaxysql_tpu.chunk.batch import (Column, ColumnBatch, Dictionary, concat_b
 from galaxysql_tpu.expr import ir
 from galaxysql_tpu.expr.compiler import ExprCompiler, batch_env, _find_dictionary, \
     _signed_div_round, _pow10
+from galaxysql_tpu.exec.runtime_filter import RF_STATS
 from galaxysql_tpu.kernels import relational as K
 from galaxysql_tpu.types import datatype as dt
 
@@ -743,7 +744,8 @@ class HashJoinOp(Operator):
                  build_schema: Optional[Dict[str, Tuple[dt.DataType,
                                                         Optional[Dictionary]]]] = None,
                  spill_threshold: int = 256 << 20,
-                 enable_bloom: bool = True, probe_prelude=None):
+                 enable_bloom: bool = True, probe_prelude=None,
+                 rf_publish=None, rf_manager=None):
         assert join_type in ("inner", "left", "semi", "anti")
         # filter-only fused segment (exec/fusion.FusedSegment) ANDed into the
         # probe live mask INSIDE the probe kernels: the WHERE above the probe
@@ -763,6 +765,10 @@ class HashJoinOp(Operator):
         self.spill_threshold = spill_threshold
         self.grace_partitions = 0  # observable spill counter (tests)
         self.enable_bloom = enable_bloom  # NO_BLOOM hint disables runtime filters
+        # planned runtime filters (exec/runtime_filter): once the build side
+        # materializes, publish bloom/min-max filters for probe-side scans
+        self.rf_publish = list(rf_publish or [])
+        self.rf_manager = rf_manager
 
     def _key_compilers(self):
         """Compile key pairs into a common lane domain.
@@ -918,11 +924,15 @@ class HashJoinOp(Operator):
     BLOOM_DEVICE_MAX_BITS = 1 << 24
 
     def _build_bloom_device(self, build_batch: ColumnBatch, pf):
-        if build_batch.capacity == 0 or \
-                build_batch.capacity > self.BLOOM_MAX_BUILD:
+        # gate on LIVE rows, same as the host path: a small build padded to a
+        # large capacity bucket (or gathered out of an upstream join, mostly
+        # dead rows) must not silently skip the bloom.  Sizing also follows
+        # the live count — the padding rows never set a bit.
+        n_build = build_batch.num_live() if build_batch.capacity else 0
+        if n_build == 0 or n_build > self.BLOOM_MAX_BUILD:
             return None
         be = self.build_keys[0]
-        nbits = 1 << max(12, int(build_batch.capacity * 16 - 1).bit_length())
+        nbits = 1 << max(12, int(n_build * 16 - 1).bit_length())
         nbits = min(nbits, self.BLOOM_DEVICE_MAX_BITS)
         key = ("bloom_dev", nbits, expr_cache_key(be),
                expr_cache_key(self.probe_keys[0]))
@@ -1131,6 +1141,11 @@ class HashJoinOp(Operator):
             if self.residual is not None else None
 
         for pb in self.probe.batches():
+            if RF_STATS["enabled"]:
+                # RAW batch live, BEFORE the probe prelude — the same point
+                # the device path counts at, so the bench delta metric is
+                # comparable across backends
+                RF_STATS["probe_rows"] += int(pb.np_live().sum())
             planes = self._np_key_lanes(pk, pb)
             p_live_mask = self._probe_live_np(pb)
             p_eff = p_live_mask
@@ -1236,9 +1251,18 @@ class HashJoinOp(Operator):
             build_parts.append(b)
             build_bytes += _batch_bytes(b)
             if build_bytes > self.spill_threshold:
+                # grace spill: the build never materializes in one piece, so
+                # no filter is published — absent filters pass everything
                 yield from self._grace_batches(build_parts, build_iter)
                 return
         build_batch = concat_batches(build_parts)
+        # planned runtime filters publish HERE — before any probe pull, so
+        # probe-side scans (lazy generators) see the filter on first batch.
+        # An empty build publishes pass-NOTHING filters, never pass-all.
+        if self.rf_publish:
+            from galaxysql_tpu.exec import runtime_filter as _rf
+            _rf.publish_from_batch(self.rf_manager, self.rf_publish,
+                                   build_batch)
         if K.prefer_scatter() and build_batch.capacity:
             # CPU: every downstream build-side cost (CSR bincount domain, slot
             # table size M, verify gathers) scales with CAPACITY, and a build
@@ -1284,6 +1308,11 @@ class HashJoinOp(Operator):
         csr = self._csr_host(build_batch) if K.prefer_scatter() else None
         plits = self._plits()
         for pb in self.probe.batches():
+            if RF_STATS["enabled"]:
+                # probe rows REACHING the join (post scan-side runtime-filter
+                # pruning, pre join-local bloom) — the bench delta metric;
+                # gated so the default path pays no extra device sync
+                RF_STATS["probe_rows"] += int(pb.num_live())
             if bloom_filter is not None:
                 pb = bloom_filter(pb)
             # with a probe prelude the count predates the fused WHERE (counting

@@ -367,6 +367,13 @@ class Worker:
                         else:
                             vis = vis & p.valid[col] & \
                                 opf(lane.astype(np.float64), float(val))
+                    for col, vals in (f.get("rf_in") or []):
+                        # runtime-filter IN-list (small join build sides):
+                        # exact membership prune before rows cross the seam
+                        lane = p.lanes[col]
+                        arr = np.asarray(vals)
+                        vis = vis & p.valid[col] & \
+                            np.isin(lane, arr.astype(lane.dtype, copy=False))
                     ids = np.nonzero(vis)[0]
                 if del_of is not None:
                     dmask = (p.end_ts >= 0) & (p.end_ts > int(since or 0)) & \

@@ -217,9 +217,34 @@ class MppExecutor:
         self.ctx.trace.append(f"mpp-scan {t.name} shards={self.S}")
         hot = DistBatch(cols, st.live, False)
         am = getattr(self.ctx, "archive", None)
-        if am is None or not am.files_for(key, self.ctx.snapshot_ts):
-            return hot
-        return self._concat_shards([hot, self._archive_scan(node, am, key)])
+        if am is not None and am.files_for(key, self.ctx.snapshot_ts):
+            hot = self._concat_shards([hot, self._archive_scan(node, am, key)])
+        return self._apply_scan_rf(node, hot)
+
+    def _apply_scan_rf(self, node: L.Scan, batch: DistBatch) -> DistBatch:
+        """Planned runtime filters on an MPP probe-side scan: the build side's
+        published filter (built once on the host by _join) masks the shard's
+        live rows before any probe-stage dispatch.  The rf-only FusedSegment
+        runs directly over the distributed lanes — the flags/range are
+        replicated runtime args, same program shape as the local engine."""
+        rf = getattr(self.ctx, "rf", None)
+        seg = rf.segment_for_scan(node) if rf is not None else None
+        if seg is None:
+            return batch
+        if seg.inert():
+            return batch  # filters never published: skip the identity program
+        sink = None
+        if getattr(self.ctx, "collect_stats", False):
+            sink = []
+            seg.stats_sink = sink
+        _out, live = seg.run_env(batch.env(), batch.live)
+        self.ctx.trace.append(
+            f"mpp-rf-scan {node.table.name} filters={len(seg.stages)}")
+        if sink:
+            from galaxysql_tpu.plan.physical import record_rf_stats
+            record_rf_stats(self.ctx, seg, node,
+                            np.sum([c for c, _ in sink], axis=0))
+        return DistBatch(batch.columns, live, batch.replicated)
 
     def _archive_scan(self, node: L.Scan, am, key: str) -> DistBatch:
         """Cold parquet rows row-sharded over the mesh: host-read, padded to a
@@ -274,7 +299,7 @@ class MppExecutor:
         are reattached, never copied through XLA outputs).  The compiled
         program is shared with the single-chip executor via global_jit."""
         from galaxysql_tpu.exec.fusion import chain_nodes, segment_for
-        base, seg = segment_for(node)
+        base, seg = segment_for(node, rf=getattr(self.ctx, "rf", None))
         sink = None
         if getattr(self.ctx, "collect_stats", False):
             sink = []
@@ -286,11 +311,15 @@ class MppExecutor:
         if sink:
             totals = np.sum([c for c, _ in sink], axis=0)
             wall = round(sum(w for _, w in sink), 3)
+            from galaxysql_tpu.plan.physical import record_rf_stats
+            record_rf_stats(self.ctx, seg,
+                            base if isinstance(base, L.Scan) else None, totals)
+            off = 1 + seg.rf_stage_count  # input count + rf prelude stages
             for i, nd in enumerate(chain_nodes(node)):
                 self.ctx.op_stats.append(
                     {"node_id": id(nd), "operator": type(nd).__name__,
                      "engine": "mpp", "batches": len(sink),
-                     "rows_out": int(totals[i]), "wall_ms": wall,
+                     "rows_out": int(totals[off + i]), "wall_ms": wall,
                      "fused": True, "segment": seg.chain})
         cols = seg.attach_columns(child.columns, out)
         return DistBatch(cols, live, child.replicated)
@@ -339,9 +368,11 @@ class MppExecutor:
         if self._fusing():
             # hand the feeding Filter/Project chain to the fuser: it compiles
             # INTO the per-shard partial-agg program (one dispatch per stage
-            # round instead of one per operator), same as the local engine
+            # round instead of one per operator), same as the local engine;
+            # the base scan's runtime filters ride along as rf prelude stages
             from galaxysql_tpu.exec.fusion import segment_for
-            base, prelude = segment_for(node.child)
+            base, prelude = segment_for(node.child,
+                                        rf=getattr(self.ctx, "rf", None))
             if prelude is not None:
                 child_node = base
                 self.ctx.trace.append(f"mpp-fuse-agg-prelude {prelude.chain}")
@@ -470,6 +501,10 @@ class MppExecutor:
             build_keys, probe_keys = probe_keys, build_keys
 
         build = self.run(build_node)
+        # publish planned runtime filters BEFORE the probe subtree runs: the
+        # filter is built once on the host from the (gathered) build lanes and
+        # reused by every shard's probe-side scan program
+        self._publish_rf(node, build, build_node is node.left)
         probe = self.run(probe_node)
         if probe.replicated:
             probe = build_replicated_to_dist_error(node)
@@ -483,6 +518,16 @@ class MppExecutor:
             out = self._shuffle_join(node, build, probe, build_keys, probe_keys,
                                      build_ids, probe_ids)
         return self._join_result(node, out, build_ids, probe_ids)
+
+    def _publish_rf(self, node: L.Join, build: DistBatch, build_is_left: bool):
+        from galaxysql_tpu.exec import runtime_filter as rfmod
+        rf = getattr(self.ctx, "rf", None)
+        probe_side = "right" if build_is_left else "left"
+        specs = rfmod.specs_for(node, probe_side, rf)
+        if not specs:
+            return
+        rfmod.publish_from_dist(rf, specs, build.columns, build.live)
+        self.ctx.trace.append(f"mpp-rf-publish filters={len(specs)}")
 
     def _join_key_fns(self, build_keys, probe_keys):
         comp = ExprCompiler(jnp)

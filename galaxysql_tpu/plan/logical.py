@@ -87,6 +87,10 @@ class Scan(RelNode):
         # indexed column — the physical scan reads index candidates instead of
         # full lanes.  Advisory like sargs: the Filter above re-verifies.
         self.point_eq: Optional[Tuple[str, Any]] = None
+        # runtime-filter consumer edges (exec/runtime_filter.RuntimeFilterTarget)
+        # planted by plan_runtime_filters: probe-side join filters applied at
+        # the scan (the join above re-verifies, so these prune, never decide)
+        self.rf_targets: List[Any] = []
 
     def fields(self) -> List[Field]:
         out = []
@@ -174,6 +178,9 @@ class Join(RelNode):
         self.residual = residual
         # scalar cross join (uncorrelated scalar subquery): exactly-one-row build
         self.scalar = False
+        # runtime-filter producer edges (exec/runtime_filter.RuntimeFilterPlan):
+        # equi pairs whose build side publishes a bloom/min-max filter
+        self.rf_plans: List[Any] = []
 
     @property
     def left(self) -> RelNode:

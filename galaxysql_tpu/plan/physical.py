@@ -55,6 +55,13 @@ class ExecContext:
         self.trace: List[str] = []
         # pipeline segment fusion (exec/fusion.py): module switch + NO_FUSE hint
         self.enable_fusion = fusion.default_enabled(self.hints)
+        # per-execution runtime-filter hub (exec/runtime_filter.py): joins
+        # publish build-side filters here, probe-side scans consume them;
+        # NO_BLOOM / RUNTIME_FILTER(OFF) hints turn it off
+        from galaxysql_tpu.exec.runtime_filter import RuntimeFilterManager
+        self.rf = RuntimeFilterManager(
+            hints=self.hints,
+            metrics=getattr(archive_instance, "metrics", None))
 
 
 # per-(store, version) scan metadata: O(table) host reductions must run once per
@@ -263,9 +270,16 @@ class ScanSource(ops.Operator):
         # XPlanTemplate.java:86,132 fallback ladder.
         def lane_safe(v):
             return int(v) if float(v).is_integer() else float(v)
+        # planned runtime filters ride the fragment: the build side's min/max
+        # range as extra SARGs, small builds additionally as an IN-list — the
+        # DN-side scan prunes before rows cross the process seam (the
+        # reference's runtime-filter-into-DN-scan pushdown, SURVEY.md §5.1)
+        rf_sargs, rf_in = self._rf_pushdown()
         frag = {"schema": t.schema, "table": t.name, "columns": storage_cols,
                 "sargs": [[c, op, lane_safe(v)] for c, op, v in
-                          getattr(self.node, "sargs", [])]}
+                          list(getattr(self.node, "sargs", [])) + rf_sargs]}
+        if rf_in:
+            frag["rf_in"] = [[c, vals] for c, vals in rf_in]
         xid = self.ctx.remote_xids.get(addr)
         if xid is not None:
             frag["xid"] = xid  # read through the session's open worker branch
@@ -312,6 +326,15 @@ class ScanSource(ops.Operator):
                         jnp.zeros(0, dtype=jnp.bool_))
         yield b.pad_to(bucket_capacity(max(n, 1)))
 
+    def _rf_pushdown(self):
+        """(min/max sargs, in-lists) from published runtime filters — the
+        lane-domain pushdown shared by remote fragments and archive SARGs."""
+        rf = getattr(self.ctx, "rf", None)
+        if rf is None or not getattr(self.node, "rf_targets", None):
+            return [], []
+        sargs, inlists = rf.scan_pushdown(self.node)
+        return [[c, op, v] for c, op, v in sargs], inlists
+
     def _archive_batches(self, t, storage_cols, rename, snap=None):
         """Cold rows from parquet archives (OSSTableScanExec analog)."""
         am = self.ctx.archive
@@ -322,9 +345,16 @@ class ScanSource(ops.Operator):
         inst_key = f"{t.schema.lower()}.{t.name.lower()}"
         if not am.files_for(inst_key, snap):
             return
+        # runtime-filter min/max ranges feed the same parquet SARG refutation
+        # as WHERE-derived sargs, skipping whole files the build side refutes
+        rf_sargs, _ = self._rf_pushdown()
+        rf = getattr(self.ctx, "rf", None)
+        cb = rf.note_file_pruned if rf is not None else None
         for b in am.scan_archive(self.ctx.archive_instance, t.schema, t.name,
                                  storage_cols, snap,
-                                 sargs=getattr(self.node, "sargs", None)):
+                                 sargs=getattr(self.node, "sargs", None),
+                                 rf_sargs=[tuple(s) for s in rf_sargs],
+                                 rf_pruned_cb=cb):
             self.ctx.trace.append(f"scan-archive {t.name} rows={b.capacity}")
             yield b.pad_to(bucket_capacity(max(b.capacity, 1))).rename(rename)
 
@@ -436,14 +466,21 @@ class SegmentStatsOp(ops.Operator):
     sink (per-stage live counts per dispatch, from the stats program variant)
     and attributes stage i's rows back to chain node i.  Wall time is the
     whole segment's — stages share one program, so per-stage wall does not
-    exist; each chain row carries the shared value, flagged `fused`."""
+    exist; each chain row carries the shared value, flagged `fused`.
+
+    The sink's leading count is the segment INPUT; runtime-filter prelude
+    stages (`rf_node` = the scan they mask) report rows pruned per filter to
+    the execution's RuntimeFilterManager — the EXPLAIN ANALYZE
+    `RuntimeFilter(col, kinds, pruned=…)` lines and the `rf_rows_pruned`
+    counter."""
 
     def __init__(self, inner: ops.Operator, segment, nodes: List[L.RelNode],
-                 ctx: ExecContext):
+                 ctx: ExecContext, rf_node: Optional[L.RelNode] = None):
         self.inner = inner
         self.segment = segment
         self.nodes = nodes
         self.ctx = ctx
+        self.rf_node = rf_node
         segment.stats_sink = []
 
     def batches(self):
@@ -453,12 +490,29 @@ class SegmentStatsOp(ops.Operator):
             return
         totals = np.sum([c for c, _ in sink], axis=0)
         wall = round(sum(w for _, w in sink), 3)
+        record_rf_stats(self.ctx, self.segment, self.rf_node, totals)
+        off = 1 + self.segment.rf_stage_count  # input count + rf preludes
         for i, n in enumerate(self.nodes):
             self.ctx.op_stats.append(
                 {"node_id": id(n), "operator": type(n).__name__,
-                 "batches": len(sink), "rows_out": int(totals[i]),
+                 "batches": len(sink), "rows_out": int(totals[off + i]),
                  "wall_ms": wall, "fused": True,
                  "segment": self.segment.chain})
+
+
+def record_rf_stats(ctx, segment, rf_node, totals):
+    """Attribute per-rf-stage pruned rows (stats-sink deltas) to the manager.
+    totals[0] is the segment input count; rf stages are a prefix."""
+    refs = getattr(segment, "rf_refs", None)
+    if not refs:
+        return
+    mgr = getattr(ctx, "rf", None)
+    if mgr is None:
+        return
+    for j, ref in enumerate(refs):
+        pruned = int(totals[j]) - int(totals[j + 1])
+        mgr.note_pruned(ref.target, pruned,
+                        node_id=id(rf_node) if rf_node is not None else None)
 
 
 def build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
@@ -478,9 +532,29 @@ def _fusing(ctx: ExecContext) -> bool:
     return ctx.enable_fusion and not getattr(ctx, "collect_stats", False)
 
 
+def _wrap_scan_rf(src: ops.Operator, node: L.Scan,
+                  ctx: ExecContext) -> ops.Operator:
+    """Scan-level runtime-filter fallback: when no downstream fused segment
+    consumed the scan's planned filters (bare join-probe scans, fusion off,
+    profiling), apply them here as an rf-only FusedSegment — still one
+    on-device program per batch, value-independent cache keys."""
+    rf = getattr(ctx, "rf", None)
+    seg = rf.segment_for_scan(node) if rf is not None else None
+    if seg is None:
+        return src
+    ctx.trace.append(f"rf-scan {node.table.name} filters={len(seg.stages)}")
+    if getattr(ctx, "collect_stats", False):
+        # inner StatsOp keeps the scan's own (pre-filter) actual rows; the
+        # SegmentStatsOp wrapper reports per-filter pruned counts
+        return SegmentStatsOp(
+            fusion.FusedPipelineOp(StatsOp(src, node, ctx), seg), seg, [],
+            ctx, rf_node=node)
+    return fusion.FusedPipelineOp(src, seg)
+
+
 def _build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
     if isinstance(node, L.Scan):
-        return ScanSource(node, ctx)
+        return _wrap_scan_rf(ScanSource(node, ctx), node, ctx)
     if isinstance(node, L.Values):
         return ValuesSource(node)
     if isinstance(node, (L.Filter, L.Project)):
@@ -492,13 +566,15 @@ def _build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
             # kernel-prelude path is held off (no observation point there)
             collecting = getattr(ctx, "collect_stats", False)
             base, seg = fusion.segment_for(node,
-                                           min_stages=1 if collecting else 2)
+                                           min_stages=1 if collecting else 2,
+                                           rf=getattr(ctx, "rf", None))
             if seg is not None:
                 ctx.trace.append(f"fuse-segment {seg.chain}")
                 inner = fusion.FusedPipelineOp(build_operator(base, ctx), seg)
                 if collecting:
-                    return SegmentStatsOp(inner, seg,
-                                          fusion.chain_nodes(node), ctx)
+                    return SegmentStatsOp(
+                        inner, seg, fusion.chain_nodes(node), ctx,
+                        rf_node=base if isinstance(base, L.Scan) else None)
                 return inner
         if isinstance(node, L.Filter):
             return ops.FilterOp(build_operator(node.child, ctx), node.cond)
@@ -512,8 +588,10 @@ def _build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
         if _fusing(ctx):
             # the agg is itself a pipeline breaker: its feeding chain fuses
             # INTO the partial kernel (scan→filter→project→partial-agg, one
-            # program), not into a separate segment in front of it
-            base, prelude = fusion.segment_for(node.child)
+            # program), not into a separate segment in front of it — the
+            # base scan's runtime filters ride along as rf prelude stages
+            base, prelude = fusion.segment_for(node.child,
+                                               rf=getattr(ctx, "rf", None))
             if prelude is not None:
                 child_node = base
                 ctx.trace.append(f"fuse-agg-prelude {prelude.chain}")
@@ -572,11 +650,16 @@ def _build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
     raise errors.NotSupportedError(f"no physical operator for {type(node).__name__}")
 
 
-def annotate_explain(rel: L.RelNode, op_stats: List[dict]) -> List[str]:
+def annotate_explain(rel: L.RelNode, op_stats: List[dict],
+                     rf=None) -> List[str]:
     """EXPLAIN ANALYZE tree rendering: the logical plan's explain lines with
     each node annotated with its measured rows/batches/wall time (matched by
     node identity).  Operators that executed inside a fused segment carry a
     `fused(<chain>)` tag — their wall time is the whole segment's program.
+
+    `rf` (the execution's RuntimeFilterManager) adds one indented
+    `RuntimeFilter(column, kinds, pruned=…)` line under each scan a planned
+    runtime filter masked.
 
     Rendering rides the existing `explain_lines` (plain EXPLAIN and ANALYZE
     must draw the same tree): `explain_lines` emits one line per node in
@@ -590,6 +673,10 @@ def annotate_explain(rel: L.RelNode, op_stats: List[dict]) -> List[str]:
         # wrapper (which covers the same top node) cannot see
         if nid not in by_id or st.get("fused"):
             by_id[nid] = st
+    rf_by_node: Dict[int, List[dict]] = {}
+    if rf is not None:
+        for st in rf.stats.values():
+            rf_by_node.setdefault(st.get("node_id"), []).append(st)
     lines: List[str] = []
     for line, n in zip(rel.explain_lines(), L.walk(rel)):
         st = by_id.get(id(n))
@@ -598,6 +685,10 @@ def annotate_explain(rel: L.RelNode, op_stats: List[dict]) -> List[str]:
             line += (f"  (actual rows={st['rows_out']} "
                      f"batches={st['batches']} wall={st['wall_ms']}ms{tag})")
         lines.append(line)
+        for rst in rf_by_node.get(id(n), []):
+            indent = " " * (len(line) - len(line.lstrip()) + 2)
+            lines.append(f"{indent}RuntimeFilter({rst['column']}, "
+                         f"{rst['kinds']}, pruned={rst['pruned']})")
     return lines
 
 
@@ -615,6 +706,18 @@ def _probe_prelude(ctx: ExecContext, probe_node: L.RelNode):
     return base, seg
 
 
+def _rf_publish_specs(node: L.Join, ctx: ExecContext, probe_side: str):
+    """Planned runtime-filter producer specs ACTIVE for this execution
+    (side-flip/deactivation logic shared with MPP: runtime_filter.specs_for)."""
+    from galaxysql_tpu.exec.runtime_filter import specs_for
+    rf = getattr(ctx, "rf", None)
+    specs = specs_for(node, probe_side, rf)
+    if not specs:
+        return None, []
+    ctx.trace.append(f"rf-publish join filters={len(specs)}")
+    return rf, specs
+
+
 def _build_join(node: L.Join, ctx: ExecContext) -> ops.Operator:
     if node.kind == "cross":
         left = build_operator(node.left, ctx)
@@ -627,22 +730,28 @@ def _build_join(node: L.Join, ctx: ExecContext) -> ops.Operator:
     bloom = not ctx.hints.get("no_bloom", False)
     if node.kind in ("left", "semi", "anti"):
         # probe side MUST be the preserved/output (left) side
+        rf_mgr, rf_specs = _rf_publish_specs(node, ctx, "left") \
+            if node.kind == "semi" else (None, [])
         right_schema = {fid: (typ, d) for fid, typ, d in node.right.fields()}
         return ops.HashJoinOp(build_operator(node.right, ctx),
                               build_operator(node.left, ctx),
                               rkeys, lkeys, node.kind,
                               residual=node.residual, build_schema=right_schema,
                               enable_bloom=bloom,
-                              spill_threshold=ctx.join_spill_bytes)
+                              spill_threshold=ctx.join_spill_bytes,
+                              rf_publish=rf_specs, rf_manager=rf_mgr)
     # inner: build the smaller estimated side
     l_est = estimate_rows(node.left)
     r_est = estimate_rows(node.right)
     if r_est <= l_est:
         build_node, probe_node = node.right, node.left
         build_keys, probe_keys = rkeys, lkeys
+        probe_side = "left"
     else:
         build_node, probe_node = node.left, node.right
         build_keys, probe_keys = lkeys, rkeys
+        probe_side = "right"
+    rf_mgr, rf_specs = _rf_publish_specs(node, ctx, probe_side)
     build_schema = {fid: (typ, d) for fid, typ, d in build_node.fields()}
     probe_node, prelude = _probe_prelude(ctx, probe_node)
     return ops.HashJoinOp(build_operator(build_node, ctx),
@@ -651,4 +760,5 @@ def _build_join(node: L.Join, ctx: ExecContext) -> ops.Operator:
                           residual=node.residual, build_schema=build_schema,
                           enable_bloom=bloom,
                           spill_threshold=ctx.join_spill_bytes,
-                          probe_prelude=prelude)
+                          probe_prelude=prelude,
+                          rf_publish=rf_specs, rf_manager=rf_mgr)

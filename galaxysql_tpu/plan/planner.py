@@ -175,7 +175,7 @@ class Planner:
         if forced is None and spm_key is not None and not hinted:
             forced = self.spm.choose(spm_key, self.catalog.schema_version)
         spm_ctx = SpmContext(forced)
-        rel = optimize(rel, spm_ctx, catalog=self.catalog)
+        rel = optimize(rel, spm_ctx, catalog=self.catalog, hints=hints)
         if forced_orders is None and not hinted and spm_key is not None and \
                 spm_ctx.chosen:
             self.spm.capture(spm_key, spm_ctx.chosen, self.catalog.schema_version,

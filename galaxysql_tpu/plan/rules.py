@@ -566,6 +566,149 @@ def prune_columns(node: L.RelNode, required: Optional[Set[str]] = None) -> L.Rel
 
 
 # ---------------------------------------------------------------------------
+# runtime-filter planning
+# ---------------------------------------------------------------------------
+
+def plan_runtime_filters(node: L.RelNode, hints=None) -> L.RelNode:
+    """Annotate inner/semi hash joins with runtime-filter edges.
+
+    Reference analog: `rule/mpp/runtimefilter` (`JoinToRuntimeFilterJoinRule`,
+    `PushBloomFilterRule`, SURVEY.md §2.5): for each equi pair whose probe key
+    is a bare column traceable — through projections/renames, filters, group
+    keys, and row-preserving join sides — to a base-table scan column, the
+    join gains a producer edge (`L.Join.rf_plans`) and the scan a consumer
+    edge (`L.Scan.rf_targets`).  Filtering a scan to rows whose key can match
+    the build side is sound anywhere on that path: a filtered-out row could
+    only ever produce join rows the upper inner/semi join discards anyway.
+
+    Cost-gated on stats: no filter when the probe is already cheap
+    (broadcast-small shapes) or when build-key NDV says the filter would pass
+    nearly everything.  `NO_BLOOM` / `RUNTIME_FILTER(OFF)` hints disable the
+    pass; `RUNTIME_FILTER(BLOOM|MINMAX)` restricts the filter kinds."""
+    import itertools
+    h = hints or {}
+    mode = str(h.get("runtime_filter") or "").lower()
+    if h.get("no_bloom") or mode == "off":
+        return node
+    _rf_walk(node, itertools.count(1), mode)
+    return node
+
+
+def _rf_resolve_scan(node: L.RelNode, col_id: str):
+    """(scan, out_id) the plan column `col_id` is a bare rename-chain of, or
+    None.  Descends only row-preserving edges (see plan_runtime_filters)."""
+    if isinstance(node, L.Scan):
+        for oid, _c in node.columns:
+            if oid == col_id:
+                return node, oid
+        return None
+    if isinstance(node, L.Filter):
+        return _rf_resolve_scan(node.child, col_id)
+    if isinstance(node, L.Project):
+        for name, e in node.exprs:
+            if name == col_id:
+                return _rf_resolve_scan(node.child, e.name) \
+                    if isinstance(e, ir.ColRef) else None
+        return None
+    if isinstance(node, L.Aggregate):
+        # sound only through GROUP KEYS: pruning rows of a group whose key the
+        # filter refutes removes exactly the groups the upper join discards
+        for name, e in node.groups:
+            if name == col_id:
+                return _rf_resolve_scan(node.child, e.name) \
+                    if isinstance(e, ir.ColRef) else None
+        return None
+    if isinstance(node, L.Join):
+        if node.kind == "cross":
+            return None
+        sides = [node.left] if node.kind in ("semi", "anti", "left") \
+            else [node.left, node.right]
+        for s in sides:
+            if col_id in set(s.field_ids()):
+                return _rf_resolve_scan(s, col_id)
+        return None
+    return None
+
+
+def _rf_walk(node: L.RelNode, ctr, mode: str):
+    for c in node.children:
+        _rf_walk(c, ctr, mode)
+    if not isinstance(node, L.Join) or node.kind not in ("inner", "semi") or \
+            not node.equi:
+        return
+    l_est = estimate_rows(node.left)
+    r_est = estimate_rows(node.right)
+    # Plant edges for EVERY probe direction that passes the cost gates, not
+    # just the build side the local engine would pick: engines differ (MPP
+    # flips the build only below a 4x estimate ratio), and the executor
+    # activates only the direction matching its actual probe side — an edge
+    # for the other direction simply never publishes.  Semi joins fix the
+    # probe to the preserved left side.
+    if node.kind == "semi":
+        directions = [("left", node.left, node.right, r_est, l_est)]
+    else:
+        directions = [("left", node.left, node.right, r_est, l_est),
+                      ("right", node.right, node.left, l_est, r_est)]
+    for direction in directions:
+        _rf_plan_direction(node, direction, ctr, mode)
+
+
+def _rf_plan_direction(node: L.Join, direction, ctr, mode: str):
+    from galaxysql_tpu.exec.runtime_filter import (
+        RF_BLOOM_MAX_BUILD, RF_MAX_SELECTIVITY, RF_MIN_PROBE_ROWS,
+        RuntimeFilterPlan, RuntimeFilterTarget)
+    target_side, probe_node, build_node, build_est, probe_est = direction
+    if probe_est < RF_MIN_PROBE_ROWS:
+        return  # broadcast-small shape: the probe is already cheap
+    build_resolver = _stats_resolver(build_node)
+    for i, (le, re_) in enumerate(node.equi):
+        pk = le if target_side == "left" else re_
+        bk = re_ if target_side == "left" else le
+        if not isinstance(pk, ir.ColRef):
+            continue
+        if pk.dtype.is_string != bk.dtype.is_string:
+            continue
+        got = _rf_resolve_scan(probe_node, pk.name)
+        if got is None:
+            continue
+        scan, out_id = got
+        colname = dict(scan.columns).get(out_id)
+        if colname is None:
+            continue
+        # selectivity gate: distinct build keys vs distinct probe values
+        tm = scan.table
+        ndv_p = tm.stats.ndv.get(colname) or \
+            tm.stats.ndv.get(tm.column(colname).name, 0)
+        b_card = build_est
+        if isinstance(bk, ir.ColRef):
+            tmcol = build_resolver.get(bk.name)
+            if tmcol is not None:
+                bndv = tmcol[0].stats.ndv.get(tmcol[1]) or \
+                    tmcol[0].stats.ndv.get(tmcol[0].column(tmcol[1]).name, 0)
+                if bndv:
+                    b_card = min(b_card, float(bndv))
+        sel = b_card / ndv_p if ndv_p else build_est / max(probe_est, 1.0)
+        if sel > RF_MAX_SELECTIVITY:
+            continue
+        kinds = set()
+        if not pk.dtype.is_string:
+            kinds.add("minmax")  # codes are assignment-ordered: numeric only
+        if build_est <= RF_BLOOM_MAX_BUILD:
+            kinds.add("bloom")
+        if mode == "bloom":
+            kinds &= {"bloom"}
+        elif mode == "minmax":
+            kinds &= {"minmax"}
+        if not kinds:
+            continue
+        fid = next(ctr)
+        scan.rf_targets.append(
+            RuntimeFilterTarget(fid, out_id, colname, frozenset(kinds)))
+        node.rf_plans.append(
+            RuntimeFilterPlan(fid, i, target_side, frozenset(kinds)))
+
+
+# ---------------------------------------------------------------------------
 # partition pruning
 # ---------------------------------------------------------------------------
 
@@ -767,7 +910,7 @@ def _col_lit(a: ir.Expr, b: ir.Expr, id_to_col):
     return None, None
 
 
-def optimize(node: L.RelNode, spm=None, catalog=None) -> L.RelNode:
+def optimize(node: L.RelNode, spm=None, catalog=None, hints=None) -> L.RelNode:
     """The full RBO pipeline.
 
     push_filters runs BEFORE join-tree construction: subquery unnesting wraps the
@@ -775,7 +918,8 @@ def optimize(node: L.RelNode, spm=None, catalog=None) -> L.RelNode:
     the forest first or the forest would be ordered without its predicates.
 
     `spm` (SpmContext) pins/reports join orders — see build_join_tree.
-    `catalog` (when given) enables GSI access-path routing."""
+    `catalog` (when given) enables GSI access-path routing.
+    `hints` gate the runtime-filter pass (NO_BLOOM / RUNTIME_FILTER)."""
     node = push_filters(node)
     node = build_join_tree(node, spm)
     node = push_filters(node)
@@ -785,4 +929,6 @@ def optimize(node: L.RelNode, spm=None, catalog=None) -> L.RelNode:
         # referenced, not the table's full column list
         node = route_covering_gsi(node, catalog)
     node = prune_partitions(node)
+    # LAST: filter edges bind scan identities, which GSI routing just settled
+    node = plan_runtime_filters(node, hints)
     return node

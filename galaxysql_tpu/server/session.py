@@ -765,6 +765,12 @@ class Session:
                         batch = None
                         self.instance.counters.inc("mpp_fallback_local")
                         ctx.trace.append(f"mpp-fallback {e}")
+                        # fresh runtime-filter hub: the aborted MPP walk may
+                        # have consumed scan edges the local run must re-wire
+                        from galaxysql_tpu.exec.runtime_filter import \
+                            RuntimeFilterManager
+                        ctx.rf = RuntimeFilterManager(
+                            hints=ctx.hints, metrics=self.instance.metrics)
             if batch is None:
                 op = build_operator(plan.rel, ctx)
                 # TP fast path: pin execution to the host CPU backend — point
@@ -1369,7 +1375,8 @@ class Session:
             # operators inside fused segments included (per-stage counts from
             # the stats program variant, tagged `fused(<chain>)`)
             from galaxysql_tpu.plan.physical import annotate_explain
-            lines = annotate_explain(plan.rel, ctx.op_stats)
+            lines = annotate_explain(plan.rel, ctx.op_stats,
+                                     rf=getattr(ctx, "rf", None))
             lines += [f"-- trace_id: {prof.trace_id}", f"-- rows: {rows}",
                       f"-- elapsed: {elapsed:.3f}s"] + \
                 [f"-- {t}" for t in ctx.trace]

@@ -9,7 +9,12 @@ behind them:
   accepted plans; names resolve against the default schema)
 - ENGINE(MPP|LOCAL|TP)     force cluster-MPP, local device engine, or the
   TP host path regardless of the workload classifier
-- NO_BLOOM                 disable runtime bloom filters for the statement
+- NO_BLOOM                 disable ALL runtime filters for the statement —
+  the join-local bloom AND the planned scan-pushdown filters
+- RUNTIME_FILTER(OFF|BLOOM|MINMAX|ON)   per-statement control of planned
+  runtime-filter pushdown (exec/runtime_filter.py): OFF disables the
+  planning pass, BLOOM/MINMAX restrict the filter kinds.  `=` syntax is
+  accepted too (RUNTIME_FILTER=OFF).
 - NO_FUSE                  disable pipeline segment fusion for the statement
 - BASELINE_OFF             bypass SPM for the statement (plan as costed)
 
@@ -23,7 +28,7 @@ import re
 from typing import Dict, List, Optional
 
 _HINT_RE = re.compile(r"/\*\+\s*TDDL:\s*(.*?)\s*\*/", re.S | re.I)
-_DIRECTIVE_RE = re.compile(r"([A-Z_]+)\s*(?:\(([^)]*)\))?", re.I)
+_DIRECTIVE_RE = re.compile(r"([A-Z_]+)\s*(?:\(([^)]*)\)|=\s*([A-Z_]+))?", re.I)
 
 
 def parse_hints(comment: Optional[str]) -> Dict[str, object]:
@@ -34,8 +39,9 @@ def parse_hints(comment: Optional[str]) -> Dict[str, object]:
     m = _HINT_RE.search(comment)
     if not m:
         return out
-    for name, args in _DIRECTIVE_RE.findall(m.group(1)):
+    for name, pargs, eargs in _DIRECTIVE_RE.findall(m.group(1)):
         name = name.upper()
+        args = pargs or eargs
         arglist = [a.strip().strip("`").lower()
                    for a in (args or "").split(",") if a.strip()]
         if name == "JOIN_ORDER" and arglist:
@@ -46,6 +52,10 @@ def parse_hints(comment: Optional[str]) -> Dict[str, object]:
                 out["engine"] = eng
         elif name == "NO_BLOOM":
             out["no_bloom"] = True
+        elif name == "RUNTIME_FILTER" and arglist:
+            mode = arglist[0].lower()
+            if mode in ("off", "bloom", "minmax", "on"):
+                out["runtime_filter"] = mode
         elif name == "NO_FUSE":
             out["no_fuse"] = True
         elif name == "BASELINE_OFF":

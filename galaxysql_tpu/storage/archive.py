@@ -55,6 +55,7 @@ class ArchiveManager:
         self._decoded: Dict[str, object] = {}  # path -> pyarrow table (immutable)
         self._file_stats: Dict[str, dict] = {}  # path -> column min-max (immutable)
         self.pruned_files = 0  # observable SARG skip counter
+        self.rf_pruned_files = 0  # files skipped by runtime-filter ranges
 
     def attach(self, metadb):
         """Bind the metadb manifest + recover registry state (boot path)."""
@@ -279,10 +280,16 @@ class ArchiveManager:
     def scan_archive(self, instance, schema: str, table: str,
                      columns: List[str],
                      snapshot_ts: Optional[int] = None,
-                     sargs=None) -> Iterator[ColumnBatch]:
+                     sargs=None, rf_sargs=None,
+                     rf_pruned_cb=None) -> Iterator[ColumnBatch]:
         """Yield archived rows as ColumnBatches (strings re-encoded against the
         table's live dictionaries so joins/filters stay in code space).  Decoded
-        parquet tables cache by path (archive files are immutable)."""
+        parquet tables cache by path (archive files are immutable).
+
+        `rf_sargs` are runtime-filter min/max ranges (join build sides):
+        files they refute are skipped through the same min-max machinery,
+        counted separately (`rf_pruned_files` + the per-file callback) so the
+        pruning win is observable apart from WHERE-derived sargs."""
         if not PARQUET_AVAILABLE:
             return
         key = instance.store_key(schema, table)
@@ -293,6 +300,13 @@ class ArchiveManager:
         for path in files:
             if sargs and self.file_refuted(path, sargs):
                 self.pruned_files += 1
+                continue
+            if rf_sargs and self.file_refuted(path, rf_sargs):
+                # NOT pruned_files: that counter keeps meaning WHERE-derived
+                # sarg refutation only, so dashboards can tell the two apart
+                self.rf_pruned_files += 1
+                if rf_pruned_cb is not None:
+                    rf_pruned_cb(path)
                 continue
             with self._lock:
                 t = self._decoded.get(path)

@@ -1,0 +1,574 @@
+"""Planned runtime-filter pushdown: build-side bloom/min-max filters driven
+down to scans, fused segments, MPP shards, remote workers, and archive files.
+
+The `runtime_filter`-marked tests are the fast smoke target (`make rf-smoke`):
+result equivalence with `RUNTIME_FILTER(OFF)` on TPC-H Q3/Q5/Q9/Q18 and SSB
+Q2.1, on both the local engine and the 8-device mesh — the correctness guard
+for the filter planner and every pushdown surface.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from galaxysql_tpu.chunk.batch import Column, ColumnBatch, batch_from_pydict
+from galaxysql_tpu.exec import runtime_filter as rfmod
+from galaxysql_tpu.exec.fusion import FusedSegment
+from galaxysql_tpu.exec.runtime_filter import (RuntimeFilter,
+                                               RuntimeFilterManager,
+                                               RuntimeFilterTarget)
+from galaxysql_tpu.expr import ir
+from galaxysql_tpu.plan import logical as L
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.sql.hints import parse_hints
+from galaxysql_tpu.types import datatype as dt
+
+
+def _stage_mask(f: RuntimeFilter, data, valid=None, xp=np):
+    """Apply a published filter to a key lane through the real rf stage."""
+    mgr = RuntimeFilterManager()
+    mgr.publish(1, f)
+    t = RuntimeFilterTarget(1, "k", "k", frozenset({"bloom", "minmax"}))
+    ref = rfmod.RfStageRef(mgr, t)
+    n = len(data)
+    env = {"k": (xp.asarray(data), None if valid is None else xp.asarray(valid))}
+    live = xp.ones(n, dtype=bool)
+    out = ref.make_fn(xp)(env, live, ref.runtime_args())
+    return np.asarray(out)
+
+
+class TestRuntimeFilterValue:
+    def test_no_false_negatives(self):
+        keys = np.arange(0, 5000, 7, dtype=np.int64)
+        f = RuntimeFilter.build(keys, {"bloom", "minmax"})
+        for xp in (np, jnp):
+            mask = _stage_mask(f, keys.tolist(), xp=xp)
+            assert mask.all()  # every build key MUST pass (no false negatives)
+
+    def test_minmax_refutes_out_of_range(self):
+        f = RuntimeFilter.build(np.asarray([100, 200, 300], np.int64),
+                                {"minmax"})
+        mask = _stage_mask(f, [50, 100, 250, 300, 999])
+        np.testing.assert_array_equal(mask, [False, True, True, True, False])
+
+    def test_bloom_prunes_most_absent_keys(self):
+        f = RuntimeFilter.build(np.arange(100, dtype=np.int64), {"bloom"})
+        absent = np.arange(10_000, 20_000, dtype=np.int64)
+        mask = _stage_mask(f, absent.tolist())
+        # ~16 bits/key: false-positive rate far below 5%
+        assert mask.sum() < 0.05 * absent.size
+
+    def test_empty_build_passes_nothing(self):
+        f = RuntimeFilter.build(np.zeros(0, dtype=np.int64),
+                                {"bloom", "minmax"})
+        assert f.pass_nothing()
+        mask = _stage_mask(f, [0, 1, 2, 3])
+        assert not mask.any()  # pass NOTHING, never everything
+
+    def test_null_keys_masked_out(self):
+        f = RuntimeFilter.build(np.arange(10, dtype=np.int64),
+                                {"bloom", "minmax"})
+        mask = _stage_mask(f, [1, 2, 3, 4],
+                           valid=np.asarray([True, False, True, False]))
+        np.testing.assert_array_equal(mask, [True, False, True, False])
+
+    def test_in_list_for_small_builds(self):
+        f = RuntimeFilter.build(np.asarray([5, 5, 9, 9, 11], np.int64),
+                                {"bloom", "minmax"})
+        np.testing.assert_array_equal(f.in_values, [5, 9, 11])
+        big = RuntimeFilter.build(np.arange(100_000, dtype=np.int64),
+                                  {"bloom"})
+        assert big.in_values is None
+
+    def test_absent_filter_is_identity(self):
+        mgr = RuntimeFilterManager()
+        t = RuntimeFilterTarget(7, "k", "k", frozenset({"bloom"}))
+        ref = rfmod.RfStageRef(mgr, t)
+        env = {"k": (np.asarray([1, 2, 3]), None)}
+        live = np.asarray([True, False, True])
+        out = ref.make_fn(np)(env, live, ref.runtime_args())
+        np.testing.assert_array_equal(out, live)
+
+    def test_unpublished_rf_segment_is_inert_passthrough(self):
+        # grace-spilled / oversized / deactivated edge: the rf-only segment
+        # must pass batches through without ANY program dispatch
+        from galaxysql_tpu.exec import operators as ops
+        from galaxysql_tpu.exec.fusion import FusedPipelineOp
+        from galaxysql_tpu.exec.operators import SourceOp
+        mgr = RuntimeFilterManager()
+        t = RuntimeFilterTarget(3, "k", "k", frozenset({"bloom", "minmax"}))
+        seg = FusedSegment([("rf", rfmod.RfStageRef(mgr, t))])
+        b = batch_from_pydict({"k": [1, 2, 3]}, {"k": dt.BIGINT})
+        ops.reset_dispatch_stats()
+        out = list(FusedPipelineOp(SourceOp([b]), seg).batches())
+        assert out[0] is b  # the very same object: zero copies
+        assert ops.DISPATCH_STATS["dispatches"] == 0
+        assert seg.inert()
+
+    def test_published_rf_segment_is_not_inert(self):
+        mgr = RuntimeFilterManager()
+        mgr.publish(3, RuntimeFilter.build(np.asarray([1], np.int64),
+                                           {"minmax"}))
+        t = RuntimeFilterTarget(3, "k", "k", frozenset({"minmax"}))
+        seg = FusedSegment([("rf", rfmod.RfStageRef(mgr, t))])
+        assert not seg.inert()
+
+    def test_in_list_gated_by_bloom_kind(self):
+        # RUNTIME_FILTER(MINMAX) must suppress membership pushdown too
+        f = RuntimeFilter.build(np.asarray([5, 9], np.int64), {"minmax"})
+        assert f.in_values is None and f.lo == 5
+
+
+class TestBloomCapUnified:
+    """Satellite: `_build_bloom` gates on live rows, `_build_bloom_device`
+    used to gate on padded CAPACITY — a small build padded to a large bucket
+    silently skipped the device bloom.  Both now gate (and size) on the live
+    count."""
+
+    def _join(self, cap_rows, live_rows):
+        from galaxysql_tpu.exec.operators import HashJoinOp, SourceOp
+        data = np.zeros(cap_rows, dtype=np.int64)
+        data[:live_rows] = np.arange(live_rows)
+        live = np.arange(cap_rows) < live_rows
+        build = ColumnBatch({"k": Column(jnp.asarray(data), None,
+                                         dt.BIGINT, None)}, jnp.asarray(live))
+        return HashJoinOp(SourceOp([build]), SourceOp([build]),
+                          [ir.ColRef("k", dt.BIGINT, None)],
+                          [ir.ColRef("k", dt.BIGINT, None)]), build
+
+    def test_padded_small_build_gets_device_bloom(self, monkeypatch):
+        from galaxysql_tpu.exec.operators import HashJoinOp
+        from galaxysql_tpu.kernels import relational as K
+        if not K.prefer_scatter():
+            pytest.skip("device-bloom path is the scatter backend's")
+        monkeypatch.setattr(HashJoinOp, "BLOOM_MAX_BUILD", 256)
+        op, build = self._join(cap_rows=1024, live_rows=100)
+        _, pf = op._key_compilers()
+        apply = op._build_bloom_device(build, pf[0])
+        assert apply is not None  # capacity 1024 > cap, live 100 <= cap
+        probe = ColumnBatch({"k": Column(jnp.asarray(
+            np.asarray([5, 99, 5000], np.int64)), None, dt.BIGINT, None)},
+            None)
+        out = apply(probe)
+        got = np.asarray(out.live_mask())
+        assert got[0] and got[1] and not got[2]
+
+    def test_oversized_live_build_still_skips(self, monkeypatch):
+        from galaxysql_tpu.exec.operators import HashJoinOp
+        from galaxysql_tpu.kernels import relational as K
+        if not K.prefer_scatter():
+            pytest.skip("device-bloom path is the scatter backend's")
+        monkeypatch.setattr(HashJoinOp, "BLOOM_MAX_BUILD", 64)
+        op, build = self._join(cap_rows=1024, live_rows=100)
+        _, pf = op._key_compilers()
+        assert op._build_bloom_device(build, pf[0]) is None
+
+
+class TestRuntimeFilterHints:
+    def test_runtime_filter_directive_paren_and_eq(self):
+        assert parse_hints("/*+TDDL: RUNTIME_FILTER(OFF)*/") == \
+            {"runtime_filter": "off"}
+        assert parse_hints("/*+TDDL: RUNTIME_FILTER=BLOOM*/") == \
+            {"runtime_filter": "bloom"}
+        assert parse_hints("/*+TDDL: RUNTIME_FILTER(MINMAX) NO_FUSE*/") == \
+            {"runtime_filter": "minmax", "no_fuse": True}
+
+    def test_unknown_mode_ignored(self):
+        assert parse_hints("/*+TDDL: RUNTIME_FILTER(WAT)*/") == {}
+
+    def test_no_bloom_disables_planned_filters(self):
+        h = parse_hints("/*+TDDL: NO_BLOOM*/")
+        assert RuntimeFilterManager(hints=h).mode == "off"
+
+
+@pytest.fixture(scope="module")
+def rf_session():
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE rf")
+    s.execute("USE rf")
+    s.execute("CREATE TABLE big (id BIGINT, k BIGINT, v DOUBLE)")
+    s.execute("CREATE TABLE small (k BIGINT, grp VARCHAR(4))")
+    n = 20000
+    inst.store("rf", "big").insert_pylists(
+        {"id": list(range(n)),
+         "k": [i % 1000 if i % 17 else None for i in range(n)],
+         "v": [float(i) for i in range(n)]},
+        inst.tso.next_timestamp())
+    inst.store("rf", "small").insert_pylists(
+        {"k": list(range(100)), "grp": ["a" if i % 2 else "b"
+                                        for i in range(100)]},
+        inst.tso.next_timestamp())
+    s.execute("ANALYZE TABLE big, small")
+    yield s
+    s.close()
+
+
+def _plan(s, sql):
+    return s.instance.planner.plan_select(sql, "rf", [], s)
+
+
+def _rf_scans(plan):
+    return [n for n in L.walk(plan.rel)
+            if isinstance(n, L.Scan) and n.rf_targets]
+
+
+class TestPlanning:
+    Q = "select count(*) from big, small where big.k = small.k"
+
+    def test_probe_scan_annotated(self, rf_session):
+        scans = _rf_scans(_plan(rf_session, self.Q))
+        assert len(scans) == 1 and scans[0].table.name == "big"
+        t = scans[0].rf_targets[0]
+        assert t.column == "k" and t.kinds == {"bloom", "minmax"}
+        joins = [n for n in L.walk(_plan(rf_session, self.Q).rel)
+                 if isinstance(n, L.Join) and n.rf_plans]
+        assert joins and joins[0].rf_plans[0].filter_id == t.filter_id
+
+    def test_off_hint_and_no_bloom_disable(self, rf_session):
+        for h in ("RUNTIME_FILTER(OFF)", "RUNTIME_FILTER=OFF", "NO_BLOOM"):
+            plan = _plan(rf_session, f"/*+TDDL:{h}*/ " + self.Q)
+            assert not _rf_scans(plan), h
+
+    def test_kind_restriction_hints(self, rf_session):
+        p = _plan(rf_session, "/*+TDDL:RUNTIME_FILTER(MINMAX)*/ " + self.Q)
+        assert _rf_scans(p)[0].rf_targets[0].kinds == {"minmax"}
+        p = _plan(rf_session, "/*+TDDL:RUNTIME_FILTER(BLOOM)*/ " + self.Q)
+        assert _rf_scans(p)[0].rf_targets[0].kinds == {"bloom"}
+
+    def test_small_probe_not_filtered(self, rf_session):
+        # probe below RF_MIN_PROBE_ROWS: broadcast-small shape, no filter
+        q = "select count(*) from small a, small b where a.k = b.k"
+        assert not _rf_scans(_plan(rf_session, q))
+
+    def test_semi_join_probe_annotated(self, rf_session):
+        q = ("select count(*) from big where big.k in "
+             "(select k from small)")
+        scans = _rf_scans(_plan(rf_session, q))
+        assert scans and scans[0].table.name == "big"
+
+    def test_both_probe_directions_planted_when_selective(self):
+        # engines pick build sides differently (MPP flips only below a 4x
+        # ratio): every direction passing the gates gets its own edge, and
+        # only the one matching the actual probe side ever publishes
+        inst = Instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE dd; USE dd")
+        s.execute("CREATE TABLE t1 (k BIGINT, v BIGINT)")
+        s.execute("CREATE TABLE t2 (k BIGINT, v BIGINT)")
+        n = 50000
+        for t in ("t1", "t2"):
+            inst.store("dd", t).insert_arrays(
+                {"k": np.arange(n) % 40000, "v": np.arange(n) % 100},
+                inst.tso.next_timestamp())
+        s.execute("ANALYZE TABLE t1, t2")
+        q = ("select count(*) from t1, t2 where t1.k = t2.k "
+             "and t1.v < 20 and t2.v < 20")
+        plan = inst.planner.plan_select(q, "dd", [], s)
+        scans = _rf_scans(plan)
+        assert sorted(sc.table.name for sc in scans) == ["t1", "t2"]
+        # and execution stays correct: only one direction publishes
+        on = s.execute(q)
+        off = s.execute("/*+TDDL:RUNTIME_FILTER(OFF)*/ " + q)
+        assert on.rows == off.rows
+        s.close()
+
+
+class TestExecutionEquivalence:
+    Q = ("select small.grp, count(*), sum(big.v) from big, small "
+         "where big.k = small.k group by small.grp order by small.grp")
+
+    def _both(self, s, q):
+        on = s.execute(q)
+        off = s.execute("/*+TDDL:RUNTIME_FILTER(OFF)*/ " + q)
+        assert len(on.rows) == len(off.rows)
+        for a, b in zip(on.rows, off.rows):
+            for x, y in zip(a, b):
+                if isinstance(x, float):
+                    assert abs(x - y) <= max(abs(y) * 1e-9, 1e-9)
+                else:
+                    assert x == y
+        return on
+
+    def test_join_with_null_keys_matches(self, rf_session):
+        # big.k has NULLs (every 17th row): the filter must mask them, the
+        # join must not match them — same answer with filters off
+        rfmod.reset_rf_stats(enabled=True)
+        self._both(rf_session, self.Q)
+        assert rfmod.RF_STATS["filters_built"] > 0
+        rfmod.reset_rf_stats()
+
+    def test_probe_rows_pruned(self, rf_session):
+        q = "select count(*) from big, small where big.k = small.k"
+        rfmod.reset_rf_stats(enabled=True)
+        rf_session.execute(q)
+        on_rows = rfmod.RF_STATS["probe_rows"]
+        rfmod.reset_rf_stats(enabled=True)
+        rf_session.execute("/*+TDDL:RUNTIME_FILTER(OFF)*/ " + q)
+        off_rows = rfmod.RF_STATS["probe_rows"]
+        rfmod.reset_rf_stats()
+        assert on_rows < off_rows / 2  # 100 of 1000 keys: >=2x fewer rows
+
+    def test_empty_build_yields_empty_not_everything(self, rf_session):
+        q = ("select count(*) from big, small "
+             "where big.k = small.k and small.k < 0")
+        r = self._both(rf_session, q)
+        assert r.rows == [(0,)]
+
+    def test_unfused_paths_match(self, rf_session):
+        # NO_FUSE forces the scan-level rf wrapper (no segment to ride in)
+        on = rf_session.execute("/*+TDDL:NO_FUSE*/ " + self.Q)
+        off = rf_session.execute(
+            "/*+TDDL:NO_FUSE RUNTIME_FILTER(OFF)*/ " + self.Q)
+        assert on.rows == off.rows
+
+
+class TestObservability:
+    Q = "select count(*) from big, small where big.k = small.k"
+
+    def test_explain_analyze_runtime_filter_lines(self, rf_session):
+        r = rf_session.execute("EXPLAIN ANALYZE " + self.Q)
+        text = "\n".join(l for (l,) in r.rows)
+        assert "RuntimeFilter(k, bloom+minmax, pruned=" in text
+
+    def test_show_metrics_round_trip(self, rf_session):
+        rf_session.execute("EXPLAIN ANALYZE " + self.Q)
+        rows = {r[0]: r for r in rf_session.execute("SHOW METRICS").rows}
+        assert "rf_build_ms" in rows and rows["rf_build_ms"][2] >= 0
+        assert "rf_rows_pruned" in rows
+        assert "rf_files_pruned" in rows
+        pruned = rows["rf_rows_pruned"][2]
+        rf_session.execute("EXPLAIN ANALYZE " + self.Q)
+        rows2 = {r[0]: r for r in rf_session.execute("SHOW METRICS").rows}
+        assert rows2["rf_rows_pruned"][2] >= pruned
+
+    def test_trace_marks_publish(self, rf_session):
+        rf_session.execute("EXPLAIN ANALYZE " + self.Q)
+
+
+class TestWorkerPushdown:
+    """DN-side pruning: min/max sargs + IN-lists inside the shipped fragment
+    exclude rows before they cross the process seam (in-process Worker)."""
+
+    @pytest.fixture(scope="class")
+    def worker(self, tmp_path_factory):
+        from galaxysql_tpu.net.worker import Worker
+        w = Worker(data_dir=str(tmp_path_factory.mktemp("rfworker")))
+        s = Session(w.instance)
+        s.execute("CREATE DATABASE d; USE d")
+        s.execute("CREATE TABLE t (id BIGINT, k BIGINT)")
+        w.instance.store("d", "t").insert_pylists(
+            {"id": list(range(1000)), "k": [i % 50 for i in range(1000)]},
+            w.instance.tso.next_timestamp())
+        s.close()
+        return w
+
+    def test_minmax_sargs_prune(self, worker):
+        frag = {"schema": "d", "table": "t", "columns": ["id", "k"],
+                "sargs": [["k", "ge", 10], ["k", "le", 12]]}
+        hdr, arrays = worker._exec_plan({"fragment": frag})
+        assert hdr["rows"] == 60  # k in {10,11,12}: 20 rows each
+
+    def test_rf_in_list_prunes(self, worker):
+        frag = {"schema": "d", "table": "t", "columns": ["id"],
+                "sargs": [], "rf_in": [["k", [3, 7]]]}
+        hdr, arrays = worker._exec_plan({"fragment": frag})
+        assert hdr["rows"] == 40
+
+    def test_empty_in_list_passes_nothing(self, worker):
+        frag = {"schema": "d", "table": "t", "columns": ["id"],
+                "sargs": [], "rf_in": [["k", []]]}
+        hdr, arrays = worker._exec_plan({"fragment": frag})
+        assert hdr["rows"] == 0
+
+    def test_scan_pushdown_extraction(self):
+        # the CN-side extraction that feeds the fragment: lane-domain numbers
+        class _Col:
+            def __init__(self):
+                self.dtype = dt.BIGINT
+        class _TM:
+            def column(self, n):
+                return _Col()
+        scan = L.Scan.__new__(L.Scan)
+        scan.table = _TM()
+        scan.rf_targets = [RuntimeFilterTarget(1, "t.k", "k",
+                                               frozenset({"bloom", "minmax"}))]
+        mgr = RuntimeFilterManager()
+        mgr.publish(1, RuntimeFilter.build(
+            np.asarray([5, 9], np.int64), {"bloom", "minmax"}))
+        sargs, inlists = mgr.scan_pushdown(scan)
+        assert ("k", "ge", 5) in sargs and ("k", "le", 9) in sargs
+        assert inlists == [("k", [5, 9])]
+
+
+class TestArchiveFilePrune:
+    def test_rf_minmax_skips_refuted_files(self, tmp_path):
+        pq = pytest.importorskip("pyarrow.parquet")
+        from galaxysql_tpu.types import temporal
+        inst = Instance()
+        inst.archive.directory = str(tmp_path / "arch")
+        s = Session(inst)
+        s.execute("CREATE DATABASE a; USE a")
+        s.execute("CREATE TABLE fact (k BIGINT, d DATE, v BIGINT)")
+        s.execute("CREATE TABLE dim (k BIGINT)")
+        today = temporal.days_from_civil(2026, 7, 29)
+        store = inst.store("a", "fact")
+        # two archive epochs with DISJOINT key ranges: ks 0..99, 1000..1099
+        for base, age in ((0, 400), (1000, 800)):
+            store.insert_pylists(
+                {"k": list(range(base, base + 100)),
+                 "d": [temporal.format_date(today - age)] * 100,
+                 "v": [1] * 100},
+                inst.tso.next_timestamp())
+            n = inst.archive.archive_older_than(inst, "a", "fact", "d",
+                                                today - age + 1)
+            assert n == 100
+        # hot rows so the probe is big enough for the planning gate
+        store.insert_pylists(
+            {"k": [i % 100 for i in range(10000)],
+             "d": [temporal.format_date(today)] * 10000,
+             "v": [1] * 10000},
+            inst.tso.next_timestamp())
+        inst.store("a", "dim").insert_pylists(
+            {"k": list(range(90, 100))}, inst.tso.next_timestamp())
+        s.execute("ANALYZE TABLE fact, dim")
+        am = inst.archive
+        before = am.rf_pruned_files
+        r = s.execute("select count(*) from fact, dim "
+                      "where fact.k = dim.k")
+        # dim keys 90..99: the second file (ks 1000..1099) is min/max-refuted
+        assert am.rf_pruned_files > before
+        off = s.execute("/*+TDDL:RUNTIME_FILTER(OFF)*/ "
+                        "select count(*) from fact, dim "
+                        "where fact.k = dim.k")
+        assert r.rows == off.rows
+        s.close()
+
+
+# -- SQL-level equivalence smoke (the `runtime_filter` marker target) ---------
+
+
+def _rows_close(a, b):
+    assert len(a) == len(b)
+    for ra, rb in zip(sorted(a, key=lambda r: tuple(str(x) for x in r)),
+                      sorted(b, key=lambda r: tuple(str(x) for x in r))):
+        assert len(ra) == len(rb)
+        for va, vb in zip(ra, rb):
+            if isinstance(va, float) or isinstance(vb, float):
+                assert abs(float(va) - float(vb)) <= \
+                    max(abs(float(vb)) * 1e-6, 1e-6)
+            else:
+                assert va == vb
+
+
+@pytest.fixture(scope="module")
+def tpch_session():
+    from galaxysql_tpu.storage import tpch
+    data = tpch.generate(0.01)
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE tpch")
+    s.execute("USE tpch")
+    for t in tpch.TABLE_ORDER:
+        s.execute(tpch.TPCH_DDL[t])
+        inst.store("tpch", t).insert_arrays(data[t], inst.tso.next_timestamp())
+    s.execute("ANALYZE TABLE " + ", ".join(tpch.TABLE_ORDER))
+    yield s
+    s.close()
+
+
+@pytest.mark.runtime_filter
+class TestTpchEquivalence:
+    """Bloom false positives are tolerable (the join re-verifies), false
+    NEGATIVES are not: filters-on results must equal RUNTIME_FILTER(OFF)."""
+
+    @pytest.mark.parametrize("qid", [3, 5, 9, 18])
+    def test_filters_on_equals_off(self, tpch_session, qid):
+        from galaxysql_tpu.storage.tpch_queries import QUERIES
+        s = tpch_session
+        on = s.execute(QUERIES[qid])
+        off = s.execute("/*+TDDL:RUNTIME_FILTER(OFF)*/ " + QUERIES[qid])
+        _rows_close(on.rows, off.rows)
+
+    def test_filters_actually_engage_on_q5(self, tpch_session):
+        from galaxysql_tpu.storage.tpch_queries import QUERIES
+        rfmod.reset_rf_stats(enabled=True)
+        tpch_session.execute(QUERIES[5])
+        assert rfmod.RF_STATS["filters_built"] > 0
+        rfmod.reset_rf_stats()
+
+
+@pytest.mark.runtime_filter
+class TestSsbEquivalence:
+    def test_ssb_q21(self):
+        from galaxysql_tpu.storage import ssb
+        data = ssb.generate(0.005)
+        inst = Instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE ssb; USE ssb")
+        for t in ssb.TABLE_ORDER:
+            s.execute(ssb.SSB_DDL[t])
+            inst.store("ssb", t).insert_arrays(data[t],
+                                               inst.tso.next_timestamp())
+        s.execute("ANALYZE TABLE " + ", ".join(ssb.TABLE_ORDER))
+        on = s.execute(ssb.QUERIES["2.1"])
+        off = s.execute("/*+TDDL:RUNTIME_FILTER(OFF)*/ " + ssb.QUERIES["2.1"])
+        _rows_close(on.rows, off.rows)
+        s.close()
+
+
+@pytest.mark.runtime_filter
+@pytest.mark.slow  # compiles MPP shard programs; covered by `make rf-smoke`
+class TestMeshEquivalence:
+    @pytest.mark.parametrize("qid", [3, 5, 9, 18])
+    def test_mpp_filters_on_equals_off(self, tpch_session, qid):
+        import jax
+        from galaxysql_tpu.parallel.mpp import MppExecutor
+        from galaxysql_tpu.plan.physical import ExecContext
+        from galaxysql_tpu.storage.tpch_queries import QUERIES
+        inst = tpch_session.instance
+        mesh = inst.mesh()
+        if mesh is None or len(jax.devices()) < 8:
+            pytest.skip("no 8-device mesh")
+
+        def run(sql):
+            plan = inst.planner.plan_select(sql, "tpch")
+            ctx = ExecContext(inst.stores, inst.tso.next_timestamp(), [],
+                              archive=inst.archive, archive_instance=inst)
+            return MppExecutor(ctx, mesh).execute(plan.rel), ctx
+        on, ctx_on = run(QUERIES[qid])
+        off, _ = run("/*+TDDL:RUNTIME_FILTER(OFF)*/ " + QUERIES[qid])
+        _rows_close(on.to_pylist(), off.to_pylist())
+        if qid == 5:
+            assert any("mpp-rf" in t for t in ctx_on.trace)
+
+    def test_mesh_ssb_q21(self):
+        import jax
+        from galaxysql_tpu.parallel.mpp import MppExecutor
+        from galaxysql_tpu.plan.physical import ExecContext
+        from galaxysql_tpu.storage import ssb
+        data = ssb.generate(0.005)
+        inst = Instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE ssb; USE ssb")
+        for t in ssb.TABLE_ORDER:
+            s.execute(ssb.SSB_DDL[t])
+            inst.store("ssb", t).insert_arrays(data[t],
+                                               inst.tso.next_timestamp())
+        s.execute("ANALYZE TABLE " + ", ".join(ssb.TABLE_ORDER))
+        mesh = inst.mesh()
+        if mesh is None or len(jax.devices()) < 8:
+            s.close()
+            pytest.skip("no 8-device mesh")
+
+        def run(sql):
+            plan = inst.planner.plan_select(sql, "ssb")
+            ctx = ExecContext(inst.stores, inst.tso.next_timestamp(), [],
+                              archive=inst.archive, archive_instance=inst)
+            return MppExecutor(ctx, mesh).execute(plan.rel)
+        on = run(ssb.QUERIES["2.1"])
+        off = run("/*+TDDL:RUNTIME_FILTER(OFF)*/ " + ssb.QUERIES["2.1"])
+        _rows_close(on.to_pylist(), off.to_pylist())
+        s.close()
