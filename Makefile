@@ -27,7 +27,14 @@ obs-smoke:
 rf-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m runtime_filter -p no:cacheprovider
 
+# fast fragment-cache smoke: cache-on (warm, second execution) vs
+# FRAGMENT_CACHE(OFF) equivalence on TPC-H Q3/Q5/Q9 + SSB Q2.1 on both the
+# local engine and the 8-device mesh, plus the invalidation edges (DML/DDL
+# version bumps, txn-local writes, flashback, cross-coordinator SyncBus)
+cache-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m fragment_cache -p no:cacheprovider
+
 bench:
 	$(PY) bench.py
 
-.PHONY: tier1 fusion-smoke obs-smoke rf-smoke bench
+.PHONY: tier1 fusion-smoke obs-smoke rf-smoke cache-smoke bench

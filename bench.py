@@ -195,10 +195,14 @@ def rf_probe_rows_delta(s, q):
     the pruning win the planned-filter pass buys, measured outside the timed
     loops (the counter adds a pre-bloom device sync per probe batch)."""
     from galaxysql_tpu.exec import runtime_filter as rfmod
+    # fragment-cache cleared: a cached agg/build replay skips the probe
+    # stages this delta exists to measure
+    s.instance.frag_cache.clear()
     rfmod.reset_rf_stats(enabled=True)
     s.execute(q)
     on_rows = rfmod.RF_STATS["probe_rows"]
     built = rfmod.RF_STATS["filters_built"]
+    s.instance.frag_cache.clear()
     rfmod.reset_rf_stats(enabled=True)
     s.execute("/*+TDDL:RUNTIME_FILTER(OFF)*/ " + q)
     off_rows = rfmod.RF_STATS["probe_rows"]
@@ -400,14 +404,24 @@ def _bench_query_d(s, q, runs):
     batch per segment — an XLA dispatch on the device path, a host-np program
     call on the TP path."""
     from galaxysql_tpu.exec import operators as _ops
+
+    def _frag_clear():
+        # these metrics track ENGINE throughput across PRs: clear the
+        # fragment cache per run so a cached replay doesn't masquerade as a
+        # faster pipeline (the *_warm_* metrics measure the cached state)
+        fcache = getattr(s.instance, "frag_cache", None)
+        if fcache is not None:
+            fcache.clear()
     s.execute(q)  # warmup: compile + populate device cache
     times = []
+    _frag_clear()
     _ops.reset_dispatch_stats()
     t0 = time.perf_counter()
     s.execute(q)
     times.append(time.perf_counter() - t0)
     dispatches = _ops.DISPATCH_STATS["dispatches"]
     for _ in range(runs - 1):
+        _frag_clear()
         t0 = time.perf_counter()
         s.execute(q)
         times.append(time.perf_counter() - t0)
@@ -494,6 +508,43 @@ def main():
         "dispatches_per_exec": q9_d,
         "profile": _profile_summary(s, QUERIES[9]),
     })
+
+    # -- fragment cache: warm (second-execution) steady state ------------------
+    # cold = fragment cache cleared before each run (kernels compiled, device
+    # cache warm — isolates the build-side work the cache removes); warm =
+    # repeated executions hitting the cached build artifacts + filters.  The
+    # steady-state number a CN serving parameterized traffic actually sees.
+    fcache = inst.frag_cache
+    for qid in (5, 9):
+        q = QUERIES[qid]
+        s.execute(q)  # compile + device-cache warmup (cache cleared below)
+        cold_times = []
+        for _ in range(runs):
+            fcache.clear()
+            t0 = time.perf_counter()
+            s.execute(q)
+            cold_times.append(time.perf_counter() - t0)
+        cold = min(cold_times)
+        s.execute(q)  # populate the fragment cache
+        h0, m0 = fcache.hits, fcache.misses
+        warm_times = []
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            s.execute(q)
+            warm_times.append(time.perf_counter() - t0)
+        warm = min(warm_times)
+        hits = fcache.hits - h0
+        lookups = hits + (fcache.misses - m0)
+        results.append({
+            "metric": f"tpch_q{qid}_sf{sf:g}_warm_rows_per_sec_per_chip",
+            "value": round(n_rows / warm, 1), "unit": "rows/s",
+            # vs_baseline here = warm speedup over the cold (cache-cleared)
+            # run of the SAME engine: the build + filter reuse win
+            "vs_baseline": round(cold / warm, 3),
+            "cold_rows_per_sec": round(n_rows / cold, 1),
+            "cache_hit_rate": round(hits / max(lookups, 1), 3),
+            "cache_bytes": fcache.bytes, "platform": platform,
+        })
 
     # -- TPC-DS q7: 5-way star join + 4 avgs (config 5) ------------------------
     if os.environ.get("BENCH_TPCDS", "1") != "0":

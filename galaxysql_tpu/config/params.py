@@ -41,6 +41,10 @@ SORT_SPILL_BYTES = _p("SORT_SPILL_BYTES", 256 << 20,
 JOIN_SPILL_BYTES = _p("JOIN_SPILL_BYTES", 256 << 20,
                       "join build bytes above which the grace hash spill engages")
 PARALLELISM = _p("PARALLELISM", 0, "local parallel drivers (0 = auto)")
+ENABLE_FRAGMENT_CACHE = _p("ENABLE_FRAGMENT_CACHE", True,
+                           "cross-query fragment cache: hash-join build "
+                           "reuse, deterministic subplan results, cached "
+                           "runtime filters")
 
 # --- plan cache / optimizer --------------------------------------------------
 PLAN_CACHE = _p("PLAN_CACHE", True, "enable parameterized plan cache")

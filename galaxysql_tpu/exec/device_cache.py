@@ -5,15 +5,21 @@ over JDBC per query (`TableScanClient`, SURVEY.md §2.6), whole column lanes liv
 device memory keyed by (table, partition, column, table-version).  A version bump (DML,
 DDL) invalidates; eviction is LRU by byte budget.  Scans hit HBM, so steady-state AP
 queries read at HBM bandwidth instead of PCIe/host bandwidth.
+
+Concurrent misses on one key are single-flighted: the first thread runs the
+(possibly O(table)) builder + device transfer, the rest wait on a per-key event
+and adopt its entry — two threads must never both pay the host materialization
+or double-count `_bytes`.  Hits/misses/bytes surface through the typed metrics
+registry (`bind_metrics`) as `device_cache_*` gauges, next to `frag_cache_*`.
 """
 
 from __future__ import annotations
 
 import collections
 import threading
-from typing import Any, Dict, Optional, Tuple
+import weakref
+from typing import Any, Dict, Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -26,55 +32,100 @@ class DeviceCache:
         self._map: "collections.OrderedDict[Key, Any]" = collections.OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()
+        self._building: Dict[Key, threading.Event] = {}
+        # weakly-held registries: the cache is process-global while registries
+        # are per-Instance — every live Instance's SHOW METRICS must see the
+        # shared cache, and a dead Instance's registry must not be pinned
+        self._metrics_refs: list = []
         self.hits = 0
         self.misses = 0
+
+    def bind_metrics(self, registry):
+        """Surface hits/misses/bytes through a typed MetricsRegistry
+        (utils/metrics.py): SHOW METRICS, information_schema.metrics and the
+        web /metrics endpoint all list the device_cache_* family."""
+        if not any(r() is registry for r in self._metrics_refs):
+            self._metrics_refs.append(weakref.ref(registry))
+        self._push_metrics()
+
+    def _push_metrics(self):
+        if not self._metrics_refs:
+            return
+        live = []
+        for r in self._metrics_refs:
+            m = r()
+            if m is None:
+                continue
+            live.append(r)
+            m.gauge("device_cache_hits",
+                    "device lane cache hits").set(self.hits)
+            m.gauge("device_cache_misses",
+                    "device lane cache misses").set(self.misses)
+            m.gauge("device_cache_bytes",
+                    "device lane cache resident bytes").set(self._bytes)
+            m.gauge("device_cache_entries",
+                    "device lane cache entries").set(len(self._map))
+        self._metrics_refs = live
+
+    def _lookup_or_claim(self, key: Key):
+        """(value, None) on hit, (None, event) when this thread owns the
+        build.  Waiters block on the owner's event and re-check: either the
+        entry landed (hit) or the owner failed (the waiter claims the build)."""
+        while True:
+            with self._lock:
+                got = self._map.get(key)
+                if got is not None:
+                    self._map.move_to_end(key)
+                    self.hits += 1
+                    return got, None
+                ev = self._building.get(key)
+                if ev is None:
+                    ev = threading.Event()
+                    self._building[key] = ev
+                    return None, ev
+            ev.wait()
 
     def get_lane_built(self, store, pid: int, column: str, version: int,
                        length: int, builder) -> Any:
         """Like get_lane, but the host array is built lazily: cache hits skip the
-        (possibly O(table)) host-side materialization entirely."""
+        (possibly O(table)) host-side materialization entirely, and concurrent
+        misses on one key run the builder exactly once."""
         key = (store.uid, pid, column, version, length)
-        with self._lock:
-            got = self._map.get(key)
-            if got is not None:
-                self._map.move_to_end(key)
-                self.hits += 1
-                return got
-        return self._insert(key, builder())
+        got, ev = self._lookup_or_claim(key)
+        if ev is None:
+            # hit path is the per-lane scan hot path: refresh the gauges only
+            # every 64th hit (builds/clears always push) — the counters are
+            # observability, not accounting, and may lag a scan by a few hits
+            if self.hits % 64 == 1:
+                self._push_metrics()
+            return got
+        try:
+            dev = jnp.asarray(builder())
+            nbytes = int(dev.nbytes)
+            with self._lock:
+                self.misses += 1
+                self._map[key] = dev
+                self._bytes += nbytes
+                while self._bytes > self.budget and len(self._map) > 1:
+                    _, old = self._map.popitem(last=False)
+                    self._bytes -= old.nbytes if hasattr(old, "nbytes") else 0
+        finally:
+            with self._lock:
+                self._building.pop(key, None)
+            ev.set()
+        self._push_metrics()
+        return dev
 
     def get_lane(self, store, pid: int, column: str, version: int,
                  host_data: np.ndarray) -> Any:
-        key = (store.uid, pid, column, version, int(host_data.shape[0]))
-        with self._lock:
-            got = self._map.get(key)
-            if got is not None:
-                self._map.move_to_end(key)
-                self.hits += 1
-                return got
-        return self._insert(key, host_data)
-
-    def _insert(self, key, host_data: np.ndarray):
-        with self._lock:
-            self.misses += 1
-        dev = jnp.asarray(host_data)
-        nbytes = host_data.nbytes
-        with self._lock:
-            existing = self._map.get(key)
-            if existing is not None:
-                # concurrent miss on the same key: keep the first entry so the
-                # byte accounting stays exact
-                return existing
-            self._map[key] = dev
-            self._bytes += nbytes
-            while self._bytes > self.budget and len(self._map) > 1:
-                _, old = self._map.popitem(last=False)
-                self._bytes -= old.nbytes if hasattr(old, "nbytes") else 0
-        return dev
+        return self.get_lane_built(store, pid, column, version,
+                                   int(host_data.shape[0]), lambda: host_data)
 
     def clear(self):
         with self._lock:
             self._map.clear()
             self._bytes = 0
+        self._push_metrics()
 
 
 GLOBAL_DEVICE_CACHE = DeviceCache()

@@ -745,7 +745,8 @@ class HashJoinOp(Operator):
                                                         Optional[Dictionary]]]] = None,
                  spill_threshold: int = 256 << 20,
                  enable_bloom: bool = True, probe_prelude=None,
-                 rf_publish=None, rf_manager=None):
+                 rf_publish=None, rf_manager=None,
+                 frag_cache=None, frag_key=None, frag_note=None):
         assert join_type in ("inner", "left", "semi", "anti")
         # filter-only fused segment (exec/fusion.FusedSegment) ANDed into the
         # probe live mask INSIDE the probe kernels: the WHERE above the probe
@@ -769,6 +770,13 @@ class HashJoinOp(Operator):
         # materializes, publish bloom/min-max filters for probe-side scans
         self.rf_publish = list(rf_publish or [])
         self.rf_manager = rf_manager
+        # cross-query fragment cache (exec/fragment_cache): frag_key is the
+        # build subtree's versioned fingerprint — a warm execution adopts the
+        # cached build batch + CSR/native table + published filters and never
+        # pulls the build operator; frag_note reports the hit (trace/ANALYZE)
+        self.frag_cache = frag_cache
+        self.frag_key = frag_key
+        self.frag_note = frag_note
 
     def _key_compilers(self):
         """Compile key pairs into a common lane domain.
@@ -1110,16 +1118,12 @@ class HashJoinOp(Operator):
             out.append((d, v))
         return out
 
-    def _native_batches(self, build_batch: ColumnBatch) -> Iterator[ColumnBatch]:
-        """CPU-backend join: the native chained-hash hot loop (galaxystore
-        gx_join_build/probe) with vectorized numpy verification/gathers.
-
-        The XLA formulations stay the TPU path; on a scalar core the chained
-        probe walks the build table at L2 speed, which no scatter/sort
-        reformulation matches.  Exact-key verification keeps 64-bit hash
-        collisions harmless; NULL keys never match (effective-live masks)."""
+    def _native_build(self, build_batch: ColumnBatch) -> dict:
+        """Build-side state of the native CPU join — the reusable (and
+        fragment-cacheable) half: key lanes, effective-live mask, and the
+        chained-hash table."""
         from galaxysql_tpu import native
-        bk, pk = self._key_compilers_np()
+        bk, _pk = self._key_compilers_np()
         blanes = self._np_key_lanes(bk, build_batch)
         b_eff = build_batch.np_live()
         for _d, v in blanes:
@@ -1130,13 +1134,35 @@ class HashJoinOp(Operator):
         # hash materialization and no verification pass
         single_int = len(blanes) == 1 and \
             not np.issubdtype(blanes[0][0].dtype, np.floating)
+        bh = None
         if single_int:
             table = native.join_build_k1(blanes[0][0], b_eff)
         else:
-            bh = None
             for d, v in blanes:
                 bh = native.hash_combine(bh, d, v)
             table = native.join_build(bh, b_eff)
+        return {"blanes": blanes, "b_eff": b_eff, "single_int": single_int,
+                "bh": bh, "table": table}
+
+    def _native_batches(self, build_batch: ColumnBatch,
+                        art=None) -> Iterator[ColumnBatch]:
+        """CPU-backend join: the native chained-hash hot loop (galaxystore
+        gx_join_build/probe) with vectorized numpy verification/gathers.
+
+        The XLA formulations stay the TPU path; on a scalar core the chained
+        probe walks the build table at L2 speed, which no scatter/sort
+        reformulation matches.  Exact-key verification keeps 64-bit hash
+        collisions harmless; NULL keys never match (effective-live masks)."""
+        from galaxysql_tpu import native
+        _bk, pk = self._key_compilers_np()
+        nb = art.native if art is not None else None
+        if nb is None:
+            nb = self._native_build(build_batch)
+            if art is not None:
+                art.native = nb
+                self._frag_store(art)
+        blanes, b_eff = nb["blanes"], nb["b_eff"]
+        single_int, bh, table = nb["single_int"], nb["bh"], nb["table"]
         res_np = ExprCompiler(np).compile_predicate(self.residual) \
             if self.residual is not None else None
 
@@ -1239,7 +1265,82 @@ class HashJoinOp(Operator):
             cols[name] = Column(data, valid, c.dtype, c.dictionary)
         return cols
 
+    # -- fragment cache (exec/fragment_cache) --------------------------------
+
+    def _frag_entry_key(self):
+        """Artifact identity: the build subtree's versioned fingerprint plus
+        everything that shapes the stored state — backend (device batch form),
+        native availability (CSR vs chained table), the build key exprs, and
+        the ACTIVE filter-publish spec set (a RUNTIME_FILTER(OFF) run must
+        not hand a filterless artifact to a filters-on execution)."""
+        rf_sig = tuple(sorted((s.filter_id, tuple(sorted(s.kinds)))
+                              for s in self.rf_publish))
+        return ("join_build", self.frag_key.key, jax.default_backend(),
+                bool(K.prefer_scatter()),
+                tuple(expr_cache_key(e) for e in self.build_keys), rf_sig)
+
+    def _frag_lookup(self):
+        if self.frag_cache is None or self.frag_key is None:
+            return None
+        return self.frag_cache.get(self._frag_entry_key())
+
+    def _frag_admit(self, build_batch: ColumnBatch):
+        """Fresh artifact for a cold build (None when caching is off),
+        capturing the runtime filters just published from this build."""
+        if self.frag_cache is None or self.frag_key is None:
+            return None
+        from galaxysql_tpu.exec import fragment_cache as fc
+        from galaxysql_tpu.exec import runtime_filter as _rf
+        art = fc.BuildArtifact(batch=build_batch)
+        art.rows = build_batch.capacity
+        art.filters = _rf.capture_published(self.rf_manager, self.rf_publish)
+        return art
+
+    def _frag_store(self, art):
+        from galaxysql_tpu.exec import fragment_cache as fc
+        self.frag_cache.put(self._frag_entry_key(), art,
+                            fc.artifact_nbytes(art), self.frag_key.tables,
+                            kind="join_build", rows=art.rows)
+
+    def _rf_publish_cached(self, art):
+        from galaxysql_tpu.exec import runtime_filter as _rf
+        _rf.publish_captured(self.rf_manager, self.rf_publish, art.filters)
+
+    def _empty_build_batches(self) -> Iterator[ColumnBatch]:
+        # empty build: inner/semi yield nothing; anti passes probe rows through;
+        # left null-extends using the declared build schema
+        for pb in self.probe.batches():
+            if self.join_type in ("inner", "semi"):
+                continue
+            if self.join_type == "anti":
+                yield pb
+                continue
+            ncols: Dict[str, Column] = {}
+            for name, (typ, d_) in (self.build_schema or {}).items():
+                z = jnp.zeros(pb.capacity, dtype=typ.lane)
+                ncols[name] = Column(z, jnp.zeros(pb.capacity, jnp.bool_), typ, d_)
+            ncols.update(pb.columns)
+            yield ColumnBatch(ncols, pb.live)
+
     def batches(self) -> Iterator[ColumnBatch]:
+        from galaxysql_tpu import native as _native
+        art = self._frag_lookup()
+        if art is not None:
+            # warm path: build batch + CSR/native table + published filters
+            # straight from the fragment cache — the build subplan never runs
+            if self.frag_note is not None:
+                self.frag_note(art)
+            if self.rf_publish:
+                self._rf_publish_cached(art)
+            build_batch = art.batch
+            if build_batch.capacity == 0:
+                yield from self._empty_build_batches()
+                return
+            if K.prefer_scatter() and _native.AVAILABLE:
+                yield from self._native_batches(build_batch, art)
+                return
+            yield from self._device_probe(build_batch, art, stored=True)
+            return
         # accumulate the build side batch-by-batch; crossing the spill
         # threshold hands the ALREADY-collected prefix plus the still-unread
         # remainder to the grace path, so peak memory stays ~threshold (the
@@ -1252,7 +1353,8 @@ class HashJoinOp(Operator):
             build_bytes += _batch_bytes(b)
             if build_bytes > self.spill_threshold:
                 # grace spill: the build never materializes in one piece, so
-                # no filter is published — absent filters pass everything
+                # no filter is published (and nothing is cached) — absent
+                # filters pass everything
                 yield from self._grace_batches(build_parts, build_iter)
                 return
         build_batch = concat_batches(build_parts)
@@ -1269,28 +1371,22 @@ class HashJoinOp(Operator):
             # side gathered out of an upstream join is mostly dead rows —
             # host-compact first (sub-ms at build sizes)
             build_batch = build_batch.compact()
+        art = self._frag_admit(build_batch)
         if build_batch.capacity == 0:
-            # empty build: inner/semi yield nothing; anti passes probe rows through;
-            # left null-extends using the declared build schema
-            for pb in self.probe.batches():
-                if self.join_type in ("inner", "semi"):
-                    continue
-                if self.join_type == "anti":
-                    yield pb
-                    continue
-                ncols: Dict[str, Column] = {}
-                for name, (typ, d_) in (self.build_schema or {}).items():
-                    z = jnp.zeros(pb.capacity, dtype=typ.lane)
-                    ncols[name] = Column(z, jnp.zeros(pb.capacity, jnp.bool_), typ, d_)
-                ncols.update(pb.columns)
-                yield ColumnBatch(ncols, pb.live)
+            if art is not None:
+                self._frag_store(art)
+            yield from self._empty_build_batches()
             return
-        from galaxysql_tpu import native as _native
         if K.prefer_scatter() and _native.AVAILABLE:
-            yield from self._native_batches(build_batch)
+            yield from self._native_batches(build_batch, art)
             return
         build_batch = build_batch.pad_to(bucket_capacity(build_batch.capacity))
+        if art is not None:
+            art.batch = build_batch  # cache the padded device-resident form
+        yield from self._device_probe(build_batch, art, stored=False)
 
+    def _device_probe(self, build_batch: ColumnBatch, art,
+                      stored: bool) -> Iterator[ColumnBatch]:
         residual_pred = (ExprCompiler(jnp).compile_predicate(self.residual)
                          if self.residual is not None else None)
 
@@ -1305,7 +1401,13 @@ class HashJoinOp(Operator):
             _, pk = self._key_compilers()
             bloom_filter = self._build_bloom(build_batch, pk[0])
 
-        csr = self._csr_host(build_batch) if K.prefer_scatter() else None
+        csr = None
+        if K.prefer_scatter():
+            csr = art.csr if art is not None and art.csr is not None \
+                else self._csr_host(build_batch)
+        if art is not None and not stored:
+            art.csr = csr
+            self._frag_store(art)
         plits = self._plits()
         for pb in self.probe.batches():
             if RF_STATS["enabled"]:

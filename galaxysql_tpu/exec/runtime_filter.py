@@ -61,12 +61,12 @@ RF_PUBLISH_MAX_LANES = RF_PUBLISH_MAX_ROWS * 4  # transfer-size bail-out:
 # gates the one extra pre-bloom num_live() sync in HashJoinOp so the default
 # hot path pays nothing.
 RF_STATS = {"enabled": False, "probe_rows": 0, "rows_pruned": 0,
-            "files_pruned": 0, "filters_built": 0}
+            "files_pruned": 0, "filters_built": 0, "filters_cached": 0}
 
 
 def reset_rf_stats(enabled: bool = False):
     RF_STATS.update(probe_rows=0, rows_pruned=0, files_pruned=0,
-                    filters_built=0, enabled=enabled)
+                    filters_built=0, filters_cached=0, enabled=enabled)
 
 
 # -- plan annotations ---------------------------------------------------------
@@ -484,6 +484,42 @@ def publish_from_batch(manager: Optional[RuntimeFilterManager],
     env = {n: (c.np_data(), None if c.valid is None else c.np_valid())
            for n, c in build_batch.columns.items() if n in needed}
     publish_from_env(manager, specs, env, build_batch.np_live())
+
+
+def capture_published(manager: Optional[RuntimeFilterManager],
+                      specs: List[RfPublish]) -> Dict:
+    """Snapshot the filters `specs` just published, keyed (filter_id, kinds)
+    — the fragment-cache handoff: a warm execution re-publishes the snapshot
+    instead of re-reading the build side (exec/fragment_cache.BuildArtifact).
+    A spec absent from the manager (size-gated publish) stays absent: absent
+    filters mean pass-all on both the cold and the warm path."""
+    out: Dict = {}
+    if manager is None:
+        return out
+    for spec in specs:
+        f = manager.filters.get(spec.filter_id)
+        if f is not None:
+            out[(spec.filter_id, spec.kinds)] = f
+    return out
+
+
+def publish_captured(manager: Optional[RuntimeFilterManager],
+                     specs: List[RfPublish], filters: Dict) -> int:
+    """Publish a cached filter snapshot for this execution's active specs.
+    Keys carry the filter kinds, so a snapshot built under a different
+    RUNTIME_FILTER(...) hint never leaks across hint modes."""
+    if manager is None or manager.mode == "off" or not specs or not filters:
+        return 0
+    n = 0
+    for spec in specs:
+        f = filters.get((spec.filter_id, spec.kinds))
+        if f is not None:
+            manager.publish(spec.filter_id, f)
+            n += 1
+    if n:
+        RF_STATS["filters_cached"] += n
+        manager.note_build(0.0)  # registers the rf_* metric family
+    return n
 
 
 def publish_from_dist(manager: Optional[RuntimeFilterManager],

@@ -415,6 +415,15 @@ class Worker:
         if action == "invalidate_plan_cache":
             inst.planner.cache.invalidate_all()
             return {"ok": True, "action": action}, {}
+        if action == "invalidate_fragment_cache":
+            # a coordinator wrote to a table this node may hold cached
+            # fragments for: bump the epoch (remote-keyed fragments) and drop
+            # resident entries (exec/fragment_cache.py invalidation plane)
+            key = payload.get("table_key") or \
+                (f"{payload.get('schema', '').lower()}"
+                 f".{payload.get('table', '').lower()}")
+            inst.frag_cache.bump_epoch(key)
+            return {"ok": True, "action": action}, {}
         if action == "invalidate_baselines":
             for row in list(inst.planner.spm.rows()):
                 inst.planner.spm.delete(row[0])

@@ -185,7 +185,7 @@ class MppExecutor:
                 return self._streaming_chain(node)
             return self._project(node)
         if isinstance(node, L.Aggregate):
-            return self._aggregate(node)
+            return self._aggregate_cached(node)
         if isinstance(node, L.Join):
             return self._join(node)
         if isinstance(node, L.Sort):
@@ -362,6 +362,29 @@ class MppExecutor:
 
     # -- aggregate -----------------------------------------------------------------
 
+    def _aggregate_cached(self, node: L.Aggregate) -> DistBatch:
+        """Fragment-cached aggregate: the grouped output is deterministic and
+        version-keyed, so a warm repeated query replays it instead of
+        re-running the whole SPMD stage tree.  Profiling runs bypass (the
+        stats must describe the real stages)."""
+        from galaxysql_tpu.exec import fragment_cache as fc
+        cache = getattr(self.ctx, "frag", None)
+        if cache is None or getattr(self.ctx, "collect_stats", False):
+            return self._aggregate(node)
+        fkey = fc.fingerprint(node, self.ctx)
+        if fkey is None:
+            return self._aggregate(node)
+        akey = ("mpp_agg", fkey.key, self.S, id(self.mesh))
+        got = cache.get(akey)
+        if got is not None:
+            self.ctx.trace.append(
+                f"frag-cache mpp agg hit [{','.join(sorted(fkey.tables))}]")
+            return got
+        out = self._aggregate(node)
+        cache.put(akey, out, fc._nbytes_of(out), fkey.tables,
+                  kind="mpp_agg", rows=int(out.live.shape[0]))
+        return out
+
     def _aggregate(self, node: L.Aggregate) -> DistBatch:
         calls = [AggCall(a.kind, a.arg, a.out_id) for a in node.aggs]
         child_node, prelude = node.child, None
@@ -500,11 +523,7 @@ class MppExecutor:
             build_node, probe_node = node.left, node.right
             build_keys, probe_keys = probe_keys, build_keys
 
-        build = self.run(build_node)
-        # publish planned runtime filters BEFORE the probe subtree runs: the
-        # filter is built once on the host from the (gathered) build lanes and
-        # reused by every shard's probe-side scan program
-        self._publish_rf(node, build, build_node is node.left)
+        build = self._build_side(node, build_node)
         probe = self.run(probe_node)
         if probe.replicated:
             probe = build_replicated_to_dist_error(node)
@@ -519,15 +538,62 @@ class MppExecutor:
                                      build_ids, probe_ids)
         return self._join_result(node, out, build_ids, probe_ids)
 
+    def _build_side(self, node: L.Join, build_node: L.RelNode) -> DistBatch:
+        """Run (or reuse) a join's build side.  The distributed build lanes +
+        the runtime filters published from them are fragment-cached per mesh:
+        a warm join goes straight to the probe subtree with the sharded build
+        already device-resident and the filters already in hand."""
+        from galaxysql_tpu.exec import fragment_cache as fc
+        from galaxysql_tpu.exec import runtime_filter as rfmod
+        build_is_left = build_node is node.left
+        cache = getattr(self.ctx, "frag", None)
+        akey = None
+        active_specs = rfmod.specs_for(
+            node, "right" if build_is_left else "left",
+            getattr(self.ctx, "rf", None))
+        if cache is not None:
+            fkey = fc.fingerprint(build_node, self.ctx)
+            if fkey is not None:
+                # the active filter-spec set is part of the identity: a
+                # RUNTIME_FILTER(OFF) run must not poison the filters-on path
+                rf_sig = tuple(sorted((s.filter_id, tuple(sorted(s.kinds)))
+                                      for s in active_specs))
+                akey = ("mpp_build", fkey.key, self.S, id(self.mesh), rf_sig)
+                art = cache.get(akey)
+                if art is not None:
+                    self.ctx.trace.append(
+                        f"frag-cache mpp build hit "
+                        f"[{','.join(sorted(fkey.tables))}]")
+                    if getattr(self.ctx, "collect_stats", False):
+                        self.ctx.op_stats.append(
+                            {"node_id": id(build_node), "engine": "mpp",
+                             "operator": type(build_node).__name__,
+                             "batches": 0, "rows_out": art.rows,
+                             "wall_ms": 0.0, "cached": True})
+                    rfmod.publish_captured(getattr(self.ctx, "rf", None),
+                                           active_specs, art.filters)
+                    return art.batch
+        build = self.run(build_node)
+        specs = self._publish_rf(node, build, build_is_left)
+        if akey is not None:
+            art = fc.BuildArtifact(batch=build)
+            art.rows = int(build.live.shape[0])
+            art.filters = rfmod.capture_published(
+                getattr(self.ctx, "rf", None), specs)
+            cache.put(akey, art, fc.artifact_nbytes(art), fkey.tables,
+                      kind="mpp_build", rows=art.rows)
+        return build
+
     def _publish_rf(self, node: L.Join, build: DistBatch, build_is_left: bool):
         from galaxysql_tpu.exec import runtime_filter as rfmod
         rf = getattr(self.ctx, "rf", None)
         probe_side = "right" if build_is_left else "left"
         specs = rfmod.specs_for(node, probe_side, rf)
         if not specs:
-            return
+            return []
         rfmod.publish_from_dist(rf, specs, build.columns, build.live)
         self.ctx.trace.append(f"mpp-rf-publish filters={len(specs)}")
+        return specs
 
     def _join_key_fns(self, build_keys, probe_keys):
         comp = ExprCompiler(jnp)

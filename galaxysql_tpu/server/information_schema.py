@@ -54,6 +54,9 @@ _DEFS: Dict[str, List] = {
     # the typed counter/gauge registry (utils/metrics.py)
     "metrics": [("metric_name", _V), ("metric_kind", _V), ("value", _D),
                 ("help", _V)],
+    # cross-query fragment cache entries (exec/fragment_cache.py)
+    "fragment_cache": [("entry_kind", _V), ("tables", _V), ("rows_cached", _I),
+                       ("bytes", _I), ("hits", _I)],
 }
 
 
@@ -157,3 +160,6 @@ def refresh(instance, session=None):
     metrics = getattr(instance, "metrics", None)
     fill("metrics", ([n, k, float(v), h]
                      for n, k, v, h in (metrics.rows() if metrics else [])))
+    fcache = getattr(instance, "frag_cache", None)
+    fill("fragment_cache", ([k, t, r, b, h] for k, t, r, b, h in
+                            (fcache.rows() if fcache is not None else [])))

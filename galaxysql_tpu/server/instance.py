@@ -71,6 +71,16 @@ class Instance:
         # dict-like view over typed counters (engine_counters virtual table);
         # `counters["x"] += 1` call sites keep working unchanged
         self.counters = self.metrics.counter_map("engine")
+        # cross-query fragment cache (exec/fragment_cache.py): versioned
+        # hash-join build artifacts, deterministic subplan results, cached
+        # runtime-filter publications.  Per-instance so multi-coordinator
+        # tests stay isolated; frag_cache_* metrics ride this registry.
+        from galaxysql_tpu.exec.fragment_cache import FragmentCache
+        self.frag_cache = FragmentCache(metrics=self.metrics)
+        # device lane cache observability: device_cache_* gauges alongside
+        # the frag_cache_* family in SHOW METRICS / /metrics
+        from galaxysql_tpu.exec.device_cache import GLOBAL_DEVICE_CACHE
+        GLOBAL_DEVICE_CACHE.bind_metrics(self.metrics)
         # last-N per-query runtime profiles (information_schema.query_stats,
         # SHOW FULL STATS, web /query/<trace_id>)
         self.profiles = ProfileRing()
@@ -427,6 +437,37 @@ class Instance:
             if pick <= 0:
                 return a, self.workers[a]
         return live[-1][0], self.workers[live[-1][0]]
+
+    def apply_sync_action(self, action: str, payload: dict) -> dict:
+        """Coordinator-side receiver of sync-bus actions (the CN twin of
+        net/worker.Worker._sync): peer coordinators attached to each other's
+        SyncBus via `sync_peer()` invalidate caches without sharing memory."""
+        payload = payload or {}
+        if action == "invalidate_fragment_cache":
+            key = payload.get("table_key") or \
+                f"{payload.get('schema', '').lower()}.{payload.get('table', '').lower()}"
+            self.frag_cache.bump_epoch(key)
+            return {"ok": True, "action": action, "node": self.node_id}
+        if action == "invalidate_plan_cache":
+            self.planner.cache.invalidate_all()
+            return {"ok": True, "action": action, "node": self.node_id}
+        return {"ok": False, "error": f"unknown sync action {action!r}"}
+
+    def sync_peer(self):
+        """In-process SyncBus endpoint for this instance: attach the returned
+        object to a PEER coordinator's `sync_bus` and that peer's broadcasts
+        (fragment/plan-cache invalidation) apply here — the multi-coordinator
+        invalidation plane without a socket in between."""
+        inst = self
+
+        class _Peer:
+            def sync_action(self, action: str, payload: dict) -> dict:
+                return inst.apply_sync_action(action, payload)
+
+            def ping(self, timeout: float = 5.0) -> bool:
+                return True
+
+        return _Peer()
 
     def mesh(self):
         """The instance's device mesh for MPP execution (None on a single device)."""

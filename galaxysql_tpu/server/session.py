@@ -88,6 +88,11 @@ class Transaction:
         # worker branches of this txn: (host, port) -> xid (TsoTransaction's
         # per-shard XA branches; committed via the 2PC coordinator)
         self.remote: Dict[Tuple[str, int], str] = {}
+        # (schema, table) of worker-resident tables this txn wrote: fragment
+        # epochs bump again AFTER commit/rollback — the statement-time bump
+        # alone leaves a window where a peer re-caches pre-commit state under
+        # the new epoch and never hears about the commit
+        self.remote_tables: set = set()
 
     def touched_tables(self):
         seen = {}
@@ -394,6 +399,7 @@ class Session:
             if rows:
                 total += self._load_rows(tm, store, columns, rows, ts, txn)
         tm.bump_version()
+        self._note_write(tm)
         self.instance.catalog.version += 1
         return ok(affected=total, info=f"Records: {total}")
 
@@ -589,8 +595,13 @@ class Session:
         # collection (device syncs!) only when profiling is asked for
         ctx.profile = prof
         ctx.collect_stats = self._profiling_enabled()
-        if self.txn is not None and self.txn.remote:
-            ctx.remote_xids = dict(self.txn.remote)
+        if self.txn is not None:
+            # the fragment cache bypasses any table this txn has uncommitted
+            # writes on (provisional rows are visible to this session only)
+            ctx.txn_write_uids = frozenset(
+                st.uid for st in self.txn.touched_tables())
+            if self.txn.remote:
+                ctx.remote_xids = dict(self.txn.remote)
         from galaxysql_tpu.plan import logical as L
         mdl_keys = {f"{n.table.schema.lower()}.{n.table.name.lower()}"
                     for n in L.walk(plan.rel) if isinstance(n, L.Scan)}
@@ -808,6 +819,16 @@ class Session:
         self.txn = None
         if txn is None:
             return
+        try:
+            self._commit_txn(txn)
+        finally:
+            # post-outcome epoch bump for worker-resident tables this txn
+            # wrote: whatever peers cached between the statement-time bump
+            # and the commit apply is invalidated now that the outcome holds
+            for sch, tbl in txn.remote_tables:
+                self._note_remote_write(sch, tbl)
+
+    def _commit_txn(self, txn):
         policy = str(self.instance.config.get("TRANSACTION_POLICY", self.vars))
         if policy.upper() == "XA" or txn.remote:
             # two-phase commit across the touched stores (+ worker branches),
@@ -853,6 +874,8 @@ class Session:
         self.txn = None
         if txn is None:
             return
+        for sch, tbl in txn.remote_tables:
+            self._note_remote_write(sch, tbl)
         # undo via the XA participant helper: stamps own appended rows permanently
         # dead and restores provisional delete stamps — lanes never shrink (see
         # StoreParticipant.rollback for the concurrent-writer invariant)
@@ -868,6 +891,25 @@ class Session:
         if self.txn is not None:
             return -self.txn.txn_id, self.txn
         return self.instance.tso.next_timestamp(), None
+
+    def _note_write(self, tm):
+        """Post-DML fragment-cache hygiene: the version bump already makes
+        stale fingerprints unreachable; this frees their bytes immediately.
+        GSI stores took the same write but autocommit statements have no
+        commit-time participant bump for them — bump here so version-keyed
+        caches (fragment, device lanes) never serve a stale covering-index
+        scan."""
+        metas = [tm]
+        try:
+            for _i, gtm, _gstore in self._gsi_targets(tm):
+                gtm.bump_version()
+                metas.append(gtm)
+        except Exception:
+            pass  # virtual/remote tables without index stores
+        fcache = getattr(self.instance, "frag_cache", None)
+        if fcache is not None:
+            for t in metas:
+                fcache.invalidate_table(f"{t.schema.lower()}.{t.name.lower()}")
 
     def _run_insert(self, stmt: ast.Insert, params: Optional[list]) -> ResultSet:
         schema = self._require_schema()
@@ -911,6 +953,7 @@ class Session:
                                                 before_counts[pid], added,
                                                 ts, txn, self)
         tm.bump_version()
+        self._note_write(tm)
         self.instance.catalog.version += 1
         return ok(affected=n)
 
@@ -974,9 +1017,25 @@ class Session:
                 raise errors.TddlError(f"worker DML failed: {err}")
             if addr == primary:
                 affected = int(resp.get("affected", 0))
+        # remote tables have no CN-side version: bump the local fragment
+        # epoch and ride the SyncBus so every attached node (workers, peer
+        # coordinators via Instance.sync_peer) drops its cached fragments —
+        # the cross-coordinator invalidation plane.  The statement-time bump
+        # covers long transactions; _commit/_rollback bump AGAIN once the
+        # outcome is applied, closing the window where a peer re-caches
+        # pre-commit worker state under the new epoch.
+        self.txn.remote_tables.add((tm.schema, tm.name))
+        self._note_remote_write(tm.schema, tm.name)
         if auto:
             self._commit()
         return ok(affected=affected)
+
+    def _note_remote_write(self, schema: str, table: str):
+        fcache = getattr(self.instance, "frag_cache", None)
+        if fcache is not None:
+            fcache.bump_epoch(f"{schema.lower()}.{table.lower()}")
+        self.instance.sync_bus.broadcast(
+            "invalidate_fragment_cache", {"schema": schema, "table": table})
 
     def _dml_match(self, tm: TableMeta, where: Optional[ast.ExprNode],
                    params: Optional[list], alias: str):
@@ -1060,6 +1119,7 @@ class Session:
             n += ids.size
         tm.stats.row_count = max(tm.stats.row_count - n, 0)
         tm.bump_version()
+        self._note_write(tm)
         self.instance.catalog.version += 1
         return ok(affected=n)
 
@@ -1131,6 +1191,7 @@ class Session:
                                                 ts, txn, self)
             n += ids.size
         tm.bump_version()
+        self._note_write(tm)
         self.instance.catalog.version += 1
         return ok(affected=n)
 
@@ -1249,6 +1310,7 @@ class Session:
         tm = self.instance.catalog.table(stmt.name.schema or schema, stmt.name.table)
         self.instance.store(tm.schema, tm.name).truncate()
         tm.bump_version()
+        self._note_write(tm)
         self.instance.catalog.version += 1
         return ok()
 

@@ -152,6 +152,14 @@ def handle(session, stmt: ast.Show):
         return ResultSet(["Conn", "Elapsed_ms", "SQL", "Trace_id", "Workload"],
                          [dt.BIGINT, dt.DOUBLE, dt.VARCHAR, dt.BIGINT,
                           dt.VARCHAR], rows)
+    if kind == "fragment" and (stmt.target or "").lower() == "cache":
+        # SHOW FRAGMENT CACHE: one row per resident entry, MRU first, plus
+        # the totals SHOW METRICS carries as frag_cache_* counters
+        fcache = getattr(inst, "frag_cache", None)
+        rows = fcache.rows() if fcache is not None else []
+        return ResultSet(["Kind", "Tables", "Rows", "Bytes", "Hits"],
+                         [dt.VARCHAR, dt.VARCHAR, dt.BIGINT, dt.BIGINT,
+                          dt.BIGINT], rows)
     if kind == "metrics":
         # the typed counter/gauge registry (information_schema.metrics twin)
         rows = [(n, k, float(v), h) for n, k, v, h in inst.metrics.rows()]

@@ -16,6 +16,8 @@ behind them:
   planning pass, BLOOM/MINMAX restrict the filter kinds.  `=` syntax is
   accepted too (RUNTIME_FILTER=OFF).
 - NO_FUSE                  disable pipeline segment fusion for the statement
+- FRAGMENT_CACHE(OFF|ON)   per-statement control of the cross-query fragment
+  cache (exec/fragment_cache.py): OFF bypasses build/subplan/filter reuse
 - BASELINE_OFF             bypass SPM for the statement (plan as costed)
 
 Unknown directives are ignored (hints must never break a query), matching the
@@ -58,6 +60,10 @@ def parse_hints(comment: Optional[str]) -> Dict[str, object]:
                 out["runtime_filter"] = mode
         elif name == "NO_FUSE":
             out["no_fuse"] = True
+        elif name == "FRAGMENT_CACHE" and arglist:
+            mode = arglist[0].lower()
+            if mode in ("off", "on"):
+                out["fragment_cache"] = mode
         elif name == "BASELINE_OFF":
             out["baseline_off"] = True
     return out

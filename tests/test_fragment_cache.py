@@ -1,0 +1,456 @@
+"""Cross-query fragment cache: versioned hash-join build reuse, deterministic
+subplan results, and cached runtime-filter publications.
+
+The `fragment_cache`-marked tests are the fast smoke target (`make
+cache-smoke`): warm (second-execution) results must be identical to
+`FRAGMENT_CACHE(OFF)` on TPC-H Q3/Q5/Q9 and SSB Q2.1, locally and on the
+8-device mesh, and every invalidation edge (DML/DDL version bumps, txn-local
+writes, flashback reads, cross-coordinator SyncBus) must never serve a stale
+read with the cache enabled by default.
+"""
+
+import numpy as np
+import pytest
+
+from galaxysql_tpu.exec import fragment_cache as fcmod
+from galaxysql_tpu.exec.fragment_cache import (CachedSubplanOp, FragmentCache,
+                                               fingerprint)
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+
+
+def _rows_equal(a, b):
+    keyed = lambda rows: sorted(rows, key=lambda r: tuple(str(x) for x in r))
+    assert keyed(a) == keyed(b)
+
+
+# -- unit: cache mechanics ----------------------------------------------------
+
+
+class TestCacheMechanics:
+    def test_lru_byte_budget_and_evictions(self):
+        c = FragmentCache(budget_bytes=1000)
+        for i in range(5):
+            assert c.put(("k", i), i, 300, frozenset({"s.t"}), "subplan")
+        assert c.bytes <= 1000
+        assert c.evictions > 0
+        assert c.get(("k", 4)) == 4          # MRU survived
+        assert c.get(("k", 0)) is None       # LRU evicted
+
+    def test_entry_above_cap_rejected(self):
+        c = FragmentCache(budget_bytes=1 << 30)
+        assert not c.put(("big",), 1, c.entry_max_bytes + 1,
+                         frozenset(), "join_build")
+        assert c.admission_rejects == 1
+        assert len(c) == 0
+
+    def test_memory_pool_gates_admission(self):
+        from galaxysql_tpu.exec.memory import MemoryPool
+        parent = MemoryPool("test-root", 500)
+        c = FragmentCache(budget_bytes=10_000, mem_parent=parent)
+        assert c.put(("a",), 1, 400, frozenset(), "subplan")
+        # a second 400b entry exceeds the PARENT pool: LRU shed, then admit
+        assert c.put(("b",), 2, 400, frozenset(), "subplan")
+        assert c.get(("a",)) is None
+        assert parent.reserved <= 500
+
+    def test_revoker_sheds_bytes_under_pressure(self):
+        from galaxysql_tpu.exec.memory import MemoryPool
+        parent = MemoryPool("test-root", 1000)
+        c = FragmentCache(budget_bytes=1000, mem_parent=parent)
+        c.put(("a",), 1, 600, frozenset(), "subplan")
+        # memory pressure at the shared parent walks into the cache's pool
+        # revoker: cached fragments are shed before queries start spilling
+        released = parent.revoke(500)
+        assert released >= 500
+        assert len(c) == 0
+        assert parent.reserved == 0
+
+    def test_invalidate_table_frees_bytes(self):
+        c = FragmentCache()
+        c.put(("a",), 1, 100, frozenset({"d.x"}), "subplan")
+        c.put(("b",), 2, 100, frozenset({"d.y"}), "subplan")
+        assert c.invalidate_table("d.x") == 1
+        assert c.get(("b",)) == 2
+        assert c.bytes == 100
+        assert c.pool.reserved == 100
+
+    def test_epoch_bump_invalidates(self):
+        c = FragmentCache()
+        e0 = c.epoch("w.dim")
+        c.put(("r", e0), 1, 10, frozenset({"w.dim"}), "subplan")
+        c.bump_epoch("w.dim")
+        assert c.epoch("w.dim") == e0 + 1
+        assert c.get(("r", e0)) is None
+
+    def test_concurrent_put_keeps_first_and_exact_bytes(self):
+        c = FragmentCache()
+        assert c.put(("k",), "first", 50, frozenset(), "subplan")
+        assert c.put(("k",), "second", 50, frozenset(), "subplan")
+        assert c.get(("k",)) == "first"
+        assert c.bytes == 50
+        assert c.pool.reserved == 50
+
+    def test_cached_subplan_op_streams_and_caches(self):
+        from galaxysql_tpu.chunk.batch import batch_from_pydict
+        from galaxysql_tpu.exec.operators import SourceOp
+        from galaxysql_tpu.types import datatype as dt
+        b = batch_from_pydict({"k": [1, 2, 3]}, {"k": dt.BIGINT})
+        c = FragmentCache()
+        fkey = fcmod.FragKey(("frag", "x"), frozenset({"d.t"}))
+        pulls = []
+
+        class Counting(SourceOp):
+            def batches(self):
+                pulls.append(1)
+                yield from super().batches()
+
+        op = CachedSubplanOp(Counting([b]), c, fkey)
+        assert len(list(op.batches())) == 1
+        assert len(list(op.batches())) == 1
+        assert len(pulls) == 1  # second pull served from cache
+        assert c.hits >= 1
+
+
+# -- fingerprints -------------------------------------------------------------
+
+
+@pytest.fixture()
+def joined_session():
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE f; USE f")
+    s.execute("CREATE TABLE dim (id BIGINT PRIMARY KEY, name VARCHAR(16))")
+    s.execute("CREATE TABLE fact (id BIGINT, v BIGINT)")
+    s.execute("INSERT INTO dim VALUES (1,'a'),(2,'b'),(3,'c')")
+    s.execute("INSERT INTO fact VALUES " +
+              ",".join(f"({i % 3 + 1},{i})" for i in range(400)))
+    yield s
+    s.close()
+
+
+JOIN_Q = ("SELECT d.name, sum(f.v) FROM fact f JOIN dim d ON f.id = d.id "
+          "GROUP BY d.name ORDER BY d.name")
+
+
+def _plan_ctx(s, sql):
+    from galaxysql_tpu.plan.physical import ExecContext
+    inst = s.instance
+    plan = inst.planner.plan_select(sql, s.schema)
+    ctx = ExecContext(inst.stores, inst.tso.next_timestamp(), [],
+                      archive=inst.archive, archive_instance=inst,
+                      hints=getattr(plan, "hints", None))
+    return plan, ctx
+
+
+class TestFingerprint:
+    def test_version_bump_changes_key(self, joined_session):
+        s = joined_session
+        plan, ctx = _plan_ctx(s, "SELECT id, name FROM dim")
+        f1 = fingerprint(plan.rel, ctx)
+        assert f1 is not None and f1.tables == frozenset({"f.dim"})
+        s.execute("INSERT INTO dim VALUES (4,'d')")
+        plan2, ctx2 = _plan_ctx(s, "SELECT id, name FROM dim")
+        f2 = fingerprint(plan2.rel, ctx2)
+        assert f2 is not None and f2.key != f1.key
+
+    def test_literals_are_value_sensitive(self, joined_session):
+        s = joined_session
+        p1, c1 = _plan_ctx(s, "SELECT id FROM dim WHERE id > 1")
+        p2, c2 = _plan_ctx(s, "SELECT id FROM dim WHERE id > 2")
+        assert fingerprint(p1.rel, c1).key != fingerprint(p2.rel, c2).key
+
+    def test_flashback_scan_uncacheable(self, joined_session):
+        s = joined_session
+        ts = s.instance.tso.next_timestamp()
+        plan, ctx = _plan_ctx(s, f"SELECT id FROM dim AS OF TSO {ts}")
+        assert fingerprint(plan.rel, ctx) is None
+
+    def test_txn_write_set_bypasses(self, joined_session):
+        s = joined_session
+        plan, ctx = _plan_ctx(s, "SELECT id, name FROM dim")
+        store = s.instance.store("f", "dim")
+        ctx.txn_id = 77
+        ctx.txn_write_uids = frozenset({store.uid})
+        assert fingerprint(plan.rel, ctx) is None
+        ctx.txn_write_uids = frozenset()   # writes elsewhere: cacheable
+        assert fingerprint(plan.rel, ctx) is not None
+        ctx.txn_write_uids = None          # unknown write set: bypass
+        assert fingerprint(plan.rel, ctx) is None
+
+    def test_old_snapshot_bypasses(self, joined_session):
+        s = joined_session
+        old_snap = s.instance.tso.next_timestamp()
+        s.execute("INSERT INTO dim VALUES (9,'i')")
+        plan, ctx = _plan_ctx(s, "SELECT id, name FROM dim")
+        assert fingerprint(plan.rel, ctx) is not None
+        ctx.snapshot_ts = old_snap  # predates the settled stamp: bypass
+        assert fingerprint(plan.rel, ctx) is None
+
+    def test_outside_runtime_filter_target_bypasses(self, joined_session):
+        s = joined_session
+        plan, ctx = _plan_ctx(s, JOIN_Q)
+        from galaxysql_tpu.plan import logical as L
+        scans = [n for n in L.walk(plan.rel) if isinstance(n, L.Scan)]
+        target = next((n for n in scans if n.rf_targets), None)
+        if target is None:
+            pytest.skip("planner planted no filter on this shape")
+        # the scan ALONE is masked by a filter produced outside it: bypass
+        assert fingerprint(target, ctx) is None
+        # the whole tree contains the producing join: self-contained
+        assert fingerprint(plan.rel, ctx) is not None
+
+    def test_information_schema_uncacheable(self, joined_session):
+        s = joined_session
+        s.execute("SELECT table_name FROM information_schema.tables")
+        plan, ctx = _plan_ctx(
+            s, "SELECT table_name FROM information_schema.tables")
+        assert fingerprint(plan.rel, ctx) is None
+
+
+# -- end-to-end: equivalence + invalidation -----------------------------------
+
+
+@pytest.mark.fragment_cache
+class TestEndToEnd:
+    def test_warm_join_hits_and_matches(self, joined_session):
+        s = joined_session
+        fc = s.instance.frag_cache
+        fc.clear()
+        cold = s.execute(JOIN_Q)
+        assert len(fc) > 0
+        h0 = fc.hits
+        warm = s.execute(JOIN_Q)
+        assert fc.hits > h0
+        # the aggregate-replay lane serves the whole warm query
+        assert any("frag-subplan hit" in t for t in s.last_trace)
+        _rows_equal(cold.rows, warm.rows)
+        off = s.execute("/*+TDDL:FRAGMENT_CACHE(OFF)*/ " + JOIN_Q)
+        _rows_equal(warm.rows, off.rows)
+        # with the replay entries dropped, the join-build artifact lane
+        # engages: the probe pipeline runs against the cached build
+        fc.drop_kind("subplan")
+        again = s.execute(JOIN_Q)
+        assert any("frag-cache build hit" in t for t in s.last_trace)
+        _rows_equal(again.rows, off.rows)
+
+    def test_dml_invalidates(self, joined_session):
+        s = joined_session
+        s.execute(JOIN_Q)
+        s.execute(JOIN_Q)  # warm
+        s.execute("INSERT INTO dim VALUES (7,'g')")
+        s.execute("INSERT INTO fact VALUES (7, 1000)")
+        got = s.execute(JOIN_Q)
+        assert ("g", 1000) in [tuple(r) for r in got.rows]
+        off = s.execute("/*+TDDL:FRAGMENT_CACHE(OFF)*/ " + JOIN_Q)
+        _rows_equal(got.rows, off.rows)
+
+    def test_update_and_delete_invalidate(self, joined_session):
+        s = joined_session
+        s.execute(JOIN_Q)
+        s.execute(JOIN_Q)
+        s.execute("UPDATE dim SET name = 'zz' WHERE id = 1")
+        got = s.execute(JOIN_Q)
+        assert any(r[0] == "zz" for r in got.rows)
+        s.execute("DELETE FROM dim WHERE id = 2")
+        got2 = s.execute(JOIN_Q)
+        assert not any(r[0] == "b" for r in got2.rows)
+        _rows_equal(got2.rows,
+                    s.execute("/*+TDDL:FRAGMENT_CACHE(OFF)*/ " + JOIN_Q).rows)
+
+    def test_ddl_invalidates(self, joined_session):
+        s = joined_session
+        s.execute(JOIN_Q)
+        s.execute(JOIN_Q)
+        s.execute("ALTER TABLE dim ADD COLUMN extra BIGINT")
+        got = s.execute("SELECT d.name, sum(f.v) FROM fact f JOIN dim d "
+                        "ON f.id = d.id GROUP BY d.name ORDER BY d.name")
+        _rows_equal(got.rows,
+                    s.execute("/*+TDDL:FRAGMENT_CACHE(OFF)*/ " + JOIN_Q).rows)
+
+    def test_txn_local_writes_bypass(self, joined_session):
+        s = joined_session
+        s.execute(JOIN_Q)
+        s.execute(JOIN_Q)  # warm
+        s.execute("BEGIN")
+        s.execute("INSERT INTO dim VALUES (8,'h')")
+        s.execute("INSERT INTO fact VALUES (8, 500)")
+        # the txn must see its OWN uncommitted rows despite the warm cache
+        got = s.execute(JOIN_Q)
+        assert ("h", 500) in [tuple(r) for r in got.rows]
+        s.execute("ROLLBACK")
+        got2 = s.execute(JOIN_Q)
+        assert not any(r[0] == "h" for r in got2.rows)
+        # another session is never served the txn-local view
+        s2 = Session(s.instance, schema="f")
+        _rows_equal(s2.execute(JOIN_Q).rows, got2.rows)
+        s2.close()
+
+    def test_flashback_bypasses(self, joined_session):
+        s = joined_session
+        ts1 = s.instance.tso.next_timestamp()
+        s.execute("INSERT INTO dim VALUES (6,'f')")
+        s.execute("INSERT INTO fact VALUES (6, 99)")
+        s.execute(JOIN_Q)
+        s.execute(JOIN_Q)  # warm at current snapshot
+        old = s.execute(
+            "SELECT d.name, sum(f2.v) FROM fact AS OF TSO %d f2 "
+            "JOIN dim AS OF TSO %d d ON f2.id = d.id "
+            "GROUP BY d.name ORDER BY d.name" % (ts1, ts1))
+        assert not any(r[0] == "f" for r in old.rows)
+
+    def test_env_and_config_escape_hatches(self, joined_session, monkeypatch):
+        s = joined_session
+        fc = s.instance.frag_cache
+        monkeypatch.setattr(fcmod, "ENABLED", False)
+        fc.clear()
+        s.execute(JOIN_Q)
+        assert len(fc) == 0
+        monkeypatch.setattr(fcmod, "ENABLED", True)
+        s.execute("SET GLOBAL ENABLE_FRAGMENT_CACHE = 0")
+        s.execute(JOIN_Q)
+        assert len(fc) == 0
+        s.execute("SET GLOBAL ENABLE_FRAGMENT_CACHE = 1")
+        s.execute(JOIN_Q)
+        assert len(fc) > 0
+
+    def test_observability_surfaces(self, joined_session):
+        s = joined_session
+        s.execute(JOIN_Q)
+        s.execute(JOIN_Q)
+        rows = s.execute("SHOW FRAGMENT CACHE").rows
+        assert rows and any("f.dim" in r[1] for r in rows)
+        names = {r[0] for r in s.execute("SHOW METRICS").rows}
+        assert {"frag_cache_hits", "frag_cache_misses", "frag_cache_bytes",
+                "frag_cache_evictions"} <= names
+        isr = s.execute("SELECT entry_kind, tables FROM "
+                        "information_schema.fragment_cache").rows
+        assert any("f.dim" in r[1] for r in isr)
+
+    def test_explain_analyze_cached_build_tag(self, joined_session):
+        s = joined_session
+        s.execute(JOIN_Q)  # warm the artifact
+        lines = s.execute("EXPLAIN ANALYZE " + JOIN_Q).rows
+        text = "\n".join(r[0] for r in lines)
+        assert "[cached build]" in text
+
+
+# -- TPC-H / SSB equivalence (the acceptance bar) -----------------------------
+
+
+@pytest.fixture(scope="module")
+def tpch_session():
+    from galaxysql_tpu.storage import tpch
+    data = tpch.generate(0.01)
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE tpch")
+    s.execute("USE tpch")
+    for t in tpch.TABLE_ORDER:
+        s.execute(tpch.TPCH_DDL[t])
+        inst.store("tpch", t).insert_arrays(data[t], inst.tso.next_timestamp())
+    s.execute("ANALYZE TABLE " + ", ".join(tpch.TABLE_ORDER))
+    yield s
+    s.close()
+
+
+@pytest.mark.fragment_cache
+class TestTpchEquivalence:
+    """Warm (cache-hitting) executions must be BIT-identical to
+    FRAGMENT_CACHE(OFF): the cached artifacts replay the same arrays through
+    the same kernels, so even float aggregation order is unchanged."""
+
+    @pytest.mark.parametrize("qid", [3, 5, 9])
+    def test_cache_on_equals_off(self, tpch_session, qid):
+        from galaxysql_tpu.storage.tpch_queries import QUERIES
+        s = tpch_session
+        s.instance.frag_cache.clear()
+        cold = s.execute(QUERIES[qid])
+        warm = s.execute(QUERIES[qid])
+        off = s.execute("/*+TDDL:FRAGMENT_CACHE(OFF)*/ " + QUERIES[qid])
+        assert cold.rows == warm.rows == off.rows
+
+    def test_q5_actually_hits(self, tpch_session):
+        from galaxysql_tpu.storage.tpch_queries import QUERIES
+        s = tpch_session
+        s.instance.frag_cache.clear()
+        s.execute(QUERIES[5])
+        h0 = s.instance.frag_cache.hits
+        s.execute(QUERIES[5])
+        assert s.instance.frag_cache.hits > h0
+
+
+@pytest.mark.fragment_cache
+class TestSsbEquivalence:
+    def test_ssb_q21(self):
+        from galaxysql_tpu.storage import ssb
+        data = ssb.generate(0.005)
+        inst = Instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE ssb; USE ssb")
+        for t in ssb.TABLE_ORDER:
+            s.execute(ssb.SSB_DDL[t])
+            inst.store("ssb", t).insert_arrays(data[t],
+                                               inst.tso.next_timestamp())
+        s.execute("ANALYZE TABLE " + ", ".join(ssb.TABLE_ORDER))
+        cold = s.execute(ssb.QUERIES["2.1"])
+        warm = s.execute(ssb.QUERIES["2.1"])
+        off = s.execute("/*+TDDL:FRAGMENT_CACHE(OFF)*/ " + ssb.QUERIES["2.1"])
+        assert cold.rows == warm.rows == off.rows
+        s.close()
+
+
+@pytest.mark.fragment_cache
+@pytest.mark.slow  # compiles MPP shard programs; covered by `make cache-smoke`
+class TestMeshEquivalence:
+    @pytest.mark.parametrize("qid", [3, 5, 9])
+    def test_mpp_cache_on_equals_off(self, tpch_session, qid):
+        import jax
+        from galaxysql_tpu.parallel.mpp import MppExecutor
+        from galaxysql_tpu.storage.tpch_queries import QUERIES
+        inst = tpch_session.instance
+        mesh = inst.mesh()
+        if mesh is None or len(jax.devices()) < 8:
+            pytest.skip("no 8-device mesh")
+        inst.frag_cache.clear()
+
+        def run(sql):
+            plan, ctx = _plan_ctx(tpch_session, sql)
+            return MppExecutor(ctx, mesh).execute(plan.rel), ctx
+        cold, _ = run(QUERIES[qid])
+        warm, wctx = run(QUERIES[qid])
+        off, _ = run("/*+TDDL:FRAGMENT_CACHE(OFF)*/ " + QUERIES[qid])
+        assert cold.to_pylist() == warm.to_pylist() == off.to_pylist()
+        assert any("frag-cache mpp" in t for t in wctx.trace)
+        # the per-shard build-reuse lane under the aggregate replay
+        inst.frag_cache.drop_kind("mpp_agg")
+        again, actx = run(QUERIES[qid])
+        assert again.to_pylist() == off.to_pylist()
+        assert any("frag-cache mpp build hit" in t for t in actx.trace)
+
+    def test_mesh_ssb_q21(self):
+        import jax
+        from galaxysql_tpu.parallel.mpp import MppExecutor
+        from galaxysql_tpu.storage import ssb
+        data = ssb.generate(0.005)
+        inst = Instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE ssb; USE ssb")
+        for t in ssb.TABLE_ORDER:
+            s.execute(ssb.SSB_DDL[t])
+            inst.store("ssb", t).insert_arrays(data[t],
+                                               inst.tso.next_timestamp())
+        s.execute("ANALYZE TABLE " + ", ".join(ssb.TABLE_ORDER))
+        mesh = inst.mesh()
+        if mesh is None or len(jax.devices()) < 8:
+            s.close()
+            pytest.skip("no 8-device mesh")
+
+        def run(sql):
+            plan, ctx = _plan_ctx(s, sql)
+            return MppExecutor(ctx, mesh).execute(plan.rel)
+        cold = run(ssb.QUERIES["2.1"])
+        warm = run(ssb.QUERIES["2.1"])
+        off = run("/*+TDDL:FRAGMENT_CACHE(OFF)*/ " + ssb.QUERIES["2.1"])
+        assert cold.to_pylist() == warm.to_pylist() == off.to_pylist()
+        s.close()

@@ -68,3 +68,101 @@ class TestTwoCoordinators:
         c = Instance(data_dir=gms_dir)
         assert c.config.get("SLOW_SQL_MS", {}) == 4321
         sa.close()
+
+
+@pytest.mark.fragment_cache
+class TestFragmentCacheAcrossCoordinators:
+    """Two coordinators over ONE worker-resident table: remote-table fragment
+    reuse on each CN, with DML on either side invalidating the other through
+    the `invalidate_fragment_cache` SyncBus action (exec/fragment_cache.py).
+
+    Remote tables have no CN-side version, so their fingerprints ride a
+    per-table epoch — the broadcast is the ONLY thing standing between a
+    peer's write and a stale cached build."""
+
+    @pytest.fixture()
+    def two_cns_one_worker(self):
+        import os
+        import subprocess
+        import sys
+        init = ("CREATE DATABASE w; USE w; "
+                "CREATE TABLE dim (k BIGINT PRIMARY KEY, label VARCHAR(16)); "
+                "INSERT INTO dim VALUES (1,'alpha'), (2,'beta'), (3,'gamma')")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "galaxysql_tpu.net.worker", "--port", "0",
+             "--platform", "cpu", "--init-sql", init],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            text=True)
+        line = p.stdout.readline()
+        assert line.startswith("WORKER_READY"), line
+        port = int(line.split()[1])
+        nodes = []
+        for _ in range(2):
+            inst = Instance()
+            s = Session(inst)
+            s.execute("CREATE DATABASE w")
+            s.execute("USE w")
+            s.execute("CREATE TABLE fact (k BIGINT, v BIGINT)")
+            s.execute("INSERT INTO fact VALUES (1,10),(2,20),(3,30),(1,40)")
+            inst.attach_remote_table("w", "dim", "127.0.0.1", port)
+            nodes.append((inst, s))
+        (a, sa), (b, sb) = nodes
+        # the cross-coordinator invalidation plane: each CN's broadcasts also
+        # reach its peer (Instance.sync_peer rides the same SyncBus protocol)
+        a.sync_bus.attach(b.sync_peer())
+        b.sync_bus.attach(a.sync_peer())
+        yield sa, sb
+        sa.close()
+        sb.close()
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+
+    JOIN = ("SELECT d.label, sum(f.v) FROM fact f JOIN dim d ON f.k = d.k "
+            "GROUP BY d.label ORDER BY d.label")
+
+    def test_peer_dml_invalidates_remote_fragment(self, two_cns_one_worker):
+        sa, sb = two_cns_one_worker
+        a = sa.instance
+        a.frag_cache.clear()
+        cold = sa.execute(self.JOIN)
+        h0 = a.frag_cache.hits
+        warm = sa.execute(self.JOIN)
+        assert warm.rows == cold.rows
+        assert a.frag_cache.hits > h0  # the remote build artifact was reused
+        # coordinator B writes through the shared worker; its broadcast must
+        # bump A's epoch so A's next read misses and re-reads the worker
+        sb.execute("INSERT INTO dim VALUES (9, 'omega')")
+        sb.execute("INSERT INTO fact VALUES (9, 900)")
+        sa.execute("INSERT INTO fact VALUES (9, 1)")
+        got = sa.execute(self.JOIN)
+        assert ("omega", 1) in [tuple(r) for r in got.rows]
+
+    def test_txn_commit_rebumps_epoch(self, two_cns_one_worker):
+        """The stale-window regression: B writes INSIDE a txn (statement-time
+        bump fires pre-commit), A re-caches the still-uncommitted worker
+        state under the new epoch, then B COMMITs — the commit-time bump must
+        invalidate A's pre-commit fragment or A serves old rows forever."""
+        sa, sb = two_cns_one_worker
+        sa.execute(self.JOIN)
+        sb.execute("BEGIN")
+        sb.execute("INSERT INTO dim VALUES (8, 'theta')")
+        sa.execute("INSERT INTO fact VALUES (8, 5)")
+        # A caches the PRE-commit view under the post-statement epoch
+        pre = sa.execute(self.JOIN)
+        assert not any(r[0] == "theta" for r in pre.rows)
+        sa.execute(self.JOIN)  # warm on the pre-commit view
+        sb.execute("COMMIT")
+        got = sa.execute(self.JOIN)
+        assert ("theta", 5) in [tuple(r) for r in got.rows]
+
+    def test_sync_action_bumps_epoch_directly(self, two_cns_one_worker):
+        sa, sb = two_cns_one_worker
+        a, b = sa.instance, sb.instance
+        e0 = a.frag_cache.epoch("w.dim")
+        acks = b.sync_bus.broadcast("invalidate_fragment_cache",
+                                    {"schema": "w", "table": "dim"})
+        assert any(ack.get("ok") for ack in acks)
+        assert a.frag_cache.epoch("w.dim") == e0 + 1
