@@ -34,7 +34,14 @@ rf-smoke:
 cache-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m fragment_cache -p no:cacheprovider
 
+# fast tracing smoke: TPC-H Q5 with tracing on vs off (bit-identical results,
+# unchanged dispatch count when off), span-tree shape (operators, fused
+# segments, MPP shard subtrees, worker graft), compile events, and a
+# well-formed Chrome-trace JSON from /trace/<trace_id>
+trace-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m tracing -p no:cacheprovider
+
 bench:
 	$(PY) bench.py
 
-.PHONY: tier1 fusion-smoke obs-smoke rf-smoke cache-smoke bench
+.PHONY: tier1 fusion-smoke obs-smoke rf-smoke cache-smoke trace-smoke bench

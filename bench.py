@@ -363,7 +363,7 @@ def dispatch_microbench(runs: int):
 
 
 def _bench_query(s, q, runs):
-    best, _d = _bench_query_d(s, q, runs)
+    best, _d, _c = _bench_query_d(s, q, runs)
     return best
 
 
@@ -397,12 +397,16 @@ def _profile_summary(s, q):
 
 
 def _bench_query_d(s, q, runs):
-    """(best wall seconds, steady-state streaming dispatches per execution).
+    """(best wall seconds, steady-state streaming dispatches per execution,
+    compile stats).
 
     The dispatch count is the number the fusion pass moves (deterministic,
     unlike wall time on a shared host): one streaming-program invocation per
     batch per segment — an XLA dispatch on the device path, a host-np program
-    call on the TP path."""
+    call on the TP path.  Compile stats bracket the warmup (cold trace+compile
+    cost of the query's program set) and the timed loop (steady-state
+    retraces, which a healthy lifted-key cache keeps at ZERO — a regression
+    here means some program's key became value-sensitive)."""
     from galaxysql_tpu.exec import operators as _ops
 
     def _frag_clear():
@@ -412,10 +416,16 @@ def _bench_query_d(s, q, runs):
         fcache = getattr(s.instance, "frag_cache", None)
         if fcache is not None:
             fcache.clear()
+    _ops.reset_compile_stats()
     s.execute(q)  # warmup: compile + populate device cache
+    compile_stats = {
+        "compile_ms": round(_ops.COMPILE_STATS["compile_ms"], 3),
+        "retrace_count": _ops.COMPILE_STATS["retraces"],
+    }
     times = []
     _frag_clear()
     _ops.reset_dispatch_stats()
+    _ops.reset_compile_stats()
     t0 = time.perf_counter()
     s.execute(q)
     times.append(time.perf_counter() - t0)
@@ -425,7 +435,8 @@ def _bench_query_d(s, q, runs):
         t0 = time.perf_counter()
         s.execute(q)
         times.append(time.perf_counter() - t0)
-    return min(times), dispatches
+    compile_stats["retraces_steady"] = _ops.COMPILE_STATS["retraces"]
+    return min(times), dispatches, compile_stats
 
 
 def main():
@@ -466,24 +477,24 @@ def main():
     })
 
     # -- TPC-H Q3: 3-way join + high-NDV agg + top-n ---------------------------
-    q3_best, q3_d = _bench_query_d(s, QUERIES[3], runs)
+    q3_best, q3_d, q3_c = _bench_query_d(s, QUERIES[3], runs)
     q3_base = min(pandas_q3(data)[0] for _ in range(runs))
     results.append({
         "metric": f"tpch_q3_sf{sf:g}_rows_per_sec_per_chip",
         "value": round(n_rows / q3_best, 1), "unit": "rows/s",
         "vs_baseline": round(q3_base / q3_best, 3), "platform": platform,
-        "dispatches_per_exec": q3_d,
+        "dispatches_per_exec": q3_d, "compile": q3_c,
         "profile": _profile_summary(s, QUERIES[3]),
     })
 
     # -- TPC-H Q5: 6-way shuffle join (config 3) -------------------------------
-    q5_best, q5_d = _bench_query_d(s, QUERIES[5], runs)
+    q5_best, q5_d, q5_c = _bench_query_d(s, QUERIES[5], runs)
     q5_base = min(pandas_q5(data)[0] for _ in range(runs))
     results.append({
         "metric": f"tpch_q5_sf{sf:g}_rows_per_sec_per_chip",
         "value": round(n_rows / q5_best, 1), "unit": "rows/s",
         "vs_baseline": round(q5_base / q5_best, 3), "platform": platform,
-        "dispatches_per_exec": q5_d,
+        "dispatches_per_exec": q5_d, "compile": q5_c,
         "profile": _profile_summary(s, QUERIES[5]),
     })
 
@@ -499,13 +510,13 @@ def main():
     })
 
     # -- TPC-H Q9: 6-table product-profit join (runtime-filter headline) -------
-    q9_best, q9_d = _bench_query_d(s, QUERIES[9], runs)
+    q9_best, q9_d, q9_c = _bench_query_d(s, QUERIES[9], runs)
     q9_base = min(pandas_q9(data)[0] for _ in range(runs))
     results.append({
         "metric": f"tpch_q9_sf{sf:g}_rows_per_sec_per_chip",
         "value": round(n_rows / q9_best, 1), "unit": "rows/s",
         "vs_baseline": round(q9_base / q9_best, 3), "platform": platform,
-        "dispatches_per_exec": q9_d,
+        "dispatches_per_exec": q9_d, "compile": q9_c,
         "profile": _profile_summary(s, QUERIES[9]),
     })
 
@@ -557,14 +568,14 @@ def main():
             inst.store("tpcds", t).insert_pylists(ddata[t],
                                                   inst.tso.next_timestamp())
         s.execute("ANALYZE TABLE " + ", ".join(tpcds.TABLE_ORDER))
-        ds_best, ds_d = _bench_query_d(s, tpcds.QUERIES["q7"], runs)
+        ds_best, ds_d, ds_c = _bench_query_d(s, tpcds.QUERIES["q7"], runs)
         ds_base = min(pandas_ds_q7(ddata)[0] for _ in range(runs))
         n_ss = len(ddata["store_sales"]["ss_item_sk"])
         results.append({
             "metric": f"tpcds_q7_sf{sf / 2:g}_rows_per_sec_per_chip",
             "value": round(n_ss / ds_best, 1), "unit": "rows/s",
             "vs_baseline": round(ds_base / ds_best, 3), "platform": platform,
-            "dispatches_per_exec": ds_d,
+            "dispatches_per_exec": ds_d, "compile": ds_c,
             "profile": _profile_summary(s, tpcds.QUERIES["q7"]),
         })
         s.execute("USE tpch")
@@ -580,7 +591,7 @@ def main():
             inst.store("ssb", t).insert_arrays(sdata[t],
                                                inst.tso.next_timestamp())
         s.execute("ANALYZE TABLE " + ", ".join(ssb.TABLE_ORDER))
-        ssb_best, ssb_d = _bench_query_d(s, ssb.QUERIES["1.1"], runs)
+        ssb_best, ssb_d, ssb_c = _bench_query_d(s, ssb.QUERIES["1.1"], runs)
 
         def pandas_ssb(d):
             lo, da = d["lineorder"], d["dates"]
@@ -601,7 +612,7 @@ def main():
             "metric": f"ssb_q1.1_sf{sf / 2:g}_rows_per_sec_per_chip",
             "value": round(n_lo / ssb_best, 1), "unit": "rows/s",
             "vs_baseline": round(ssb_base / ssb_best, 3), "platform": platform,
-            "dispatches_per_exec": ssb_d,
+            "dispatches_per_exec": ssb_d, "compile": ssb_c,
             "profile": _profile_summary(s, ssb.QUERIES["1.1"]),
         })
         s.execute("USE tpch")
@@ -622,7 +633,7 @@ def main():
         })
 
     # -- TPC-H Q1 (headline; LAST so a single-line parse of the tail sees it) --
-    q1_best, q1_d = _bench_query_d(s, QUERIES[1], runs)
+    q1_best, q1_d, q1_c = _bench_query_d(s, QUERIES[1], runs)
     q1_base = min(pandas_q1(data)[0] for _ in range(runs))
     results.append({
         "metric": f"tpch_q1_sf{(big_sf if big_sf > 0 else sf):g}"
@@ -630,7 +641,7 @@ def main():
         "value": round((len(data['lineitem']['l_orderkey'])) / q1_best, 1),
         "unit": "rows/s",
         "vs_baseline": round(q1_base / q1_best, 3), "platform": platform,
-        "dispatches_per_exec": q1_d,
+        "dispatches_per_exec": q1_d, "compile": q1_c,
         "profile": _profile_summary(s, QUERIES[1]),
     })
 

@@ -81,6 +81,12 @@ ENABLE_QUERY_PROFILING = _p(
     "ENABLE_QUERY_PROFILING", False,
     "collect per-operator rows/time + segment spans into QueryProfile "
     "(forces device syncs; the default hot path pays nothing)")
+ENABLE_QUERY_TRACING = _p(
+    "ENABLE_QUERY_TRACING", False,
+    "record a hierarchical span tree per query (operators, fused segments, "
+    "MPP shards, worker fragments, compile/transfer telemetry) for "
+    "SHOW TRACE / information_schema.query_spans / web /trace/<id>; "
+    "may sync devices — the default hot path pays nothing)")
 FAILPOINT_ENABLE = _p("FAILPOINT_ENABLE", False, "fail-point injection master switch")
 
 

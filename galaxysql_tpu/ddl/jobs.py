@@ -104,11 +104,14 @@ class AddColumnTask(DdlTask):
         elif after:
             pos = next((i + 1 for i, c in enumerate(tm.columns)
                         if c.name.lower() == after.lower()), pos)
-        tm.columns.insert(pos, cm)
-        tm.by_name[name.lower()] = cm
+        # resolution structures BEFORE list visibility: the planner reads
+        # tm.columns without the MDL, so a column it can see must already
+        # resolve through by_name/dictionaries
         if typ.is_string:
             from galaxysql_tpu.chunk.batch import Dictionary
             tm.dictionaries[name.lower()] = Dictionary()
+        tm.by_name[name.lower()] = cm
+        tm.columns.insert(pos, cm)
         # physical: add the lane to every partition (default-filled)
         store = ctx.instance.store(tm.schema, tm.name)
         for p in store.partitions:

@@ -25,6 +25,37 @@ import numpy as np
 
 Key = Tuple[int, int, str, int, int]  # (store.uid, pid, column, version, row_count)
 
+# host->device transfer accounting: every cache MISS materializes + ships a
+# lane to the device; bytes/counts accumulate here (plain adds, host-side) and
+# traced queries additionally get one `transfer` span per shipped lane.
+TRANSFER_STATS = {"bytes": 0, "transfers": 0}
+
+
+def reset_transfer_stats():
+    TRANSFER_STATS["bytes"] = 0
+    TRANSFER_STATS["transfers"] = 0
+
+
+def hbm_high_water() -> Dict[str, int]:
+    """Per-device peak memory (bytes) where the backend exposes it (TPU/GPU
+    runtimes do; CPU may not).  Called only from traced/profiled paths — the
+    stats query is host-side but there is no reason to poll it hot."""
+    import jax
+    out: Dict[str, int] = {}
+    try:
+        devices = jax.devices()
+    except RuntimeError:
+        return out
+    for d in devices:
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if ms:
+            out[str(d)] = int(ms.get("peak_bytes_in_use",
+                                     ms.get("bytes_in_use", 0)))
+    return out
+
 
 class DeviceCache:
     def __init__(self, budget_bytes: int = 8 << 30):
@@ -102,6 +133,12 @@ class DeviceCache:
         try:
             dev = jnp.asarray(builder())
             nbytes = int(dev.nbytes)
+            TRANSFER_STATS["bytes"] += nbytes
+            TRANSFER_STATS["transfers"] += 1
+            from galaxysql_tpu.utils import tracing as _tr
+            tc = _tr.current()
+            if tc is not None:
+                tc.event(f"h2d:{column}", kind="transfer", bytes=nbytes)
             with self._lock:
                 self.misses += 1
                 self._map[key] = dev

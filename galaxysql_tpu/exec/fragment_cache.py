@@ -378,12 +378,20 @@ class FragmentCache:
             if e is None:
                 self.misses += 1
                 self._push_metrics_locked()
-                return None
-            self._map.move_to_end(key)
-            e.hits += 1
-            self.hits += 1
-            self._push_metrics_locked()
-            return e.value
+                hit, kind, rows = False, str(key[0]), 0
+            else:
+                self._map.move_to_end(key)
+                e.hits += 1
+                self.hits += 1
+                self._push_metrics_locked()
+                hit, kind, rows = True, e.kind, e.rows
+        # traced queries see cache decisions as zero-duration spans under the
+        # operator that asked (hit = the subtree below it never ran)
+        from galaxysql_tpu.utils import tracing as _tr
+        tc = _tr.current()
+        if tc is not None:
+            tc.event(f"frag-cache:{kind}", kind="cache", hit=hit, rows=rows)
+        return e.value if e is not None else None
 
     def put(self, key: Tuple, value, nbytes: int, tables: FrozenSet[str],
             kind: str, rows: int = 0) -> bool:

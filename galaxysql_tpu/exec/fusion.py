@@ -277,18 +277,43 @@ class FusedSegment:
         are distributed jax arrays, live is the shard-local mask)."""
         self._compiled_fresh = False
         sink = self.stats_sink
-        t0 = time.perf_counter() if (_tracer_on() or sink is not None) else 0.0
+        tc = _trace_ctx()
+        timed = sink is not None or tc is not None or _tracer_on()
+        t0 = time.perf_counter() if timed else 0.0
         if sink is not None:
             out, live2, counts = self._program(jit, stats=True)(
                 env, live, self.lits())
-            sink.append((np.asarray(counts),
-                         round((time.perf_counter() - t0) * 1000, 3)))
         else:
+            counts = None
             out, live2 = self._program(jit)(env, live, self.lits())
         ops.DISPATCH_STATS["dispatches"] += 1
-        if _tracer_on():
-            self._record_span(live, live2, t0)
+        if timed:
+            wall = round((time.perf_counter() - t0) * 1000, 3)
+            self._observe(tc, sink, counts, wall)
+            if _tracer_on():
+                self._record_span(live, live2, t0)
         return out, live2
+
+    def _observe(self, tc, sink, counts, wall_ms: float):
+        """Shared measured-dispatch bookkeeping: the wall histogram, the
+        stats-sink row, and (traced queries) one child `segment` span —
+        fused dispatches land as CHILDREN of the enclosing operator span
+        instead of the flat per-query list profiling keeps."""
+        from galaxysql_tpu.utils.metrics import SEGMENT_WALL_MS
+        SEGMENT_WALL_MS.observe(wall_ms)
+        if sink is not None and counts is not None:
+            counts = np.asarray(counts)
+            sink.append((counts, wall_ms))
+        if tc is not None:
+            from galaxysql_tpu.utils import tracing as _tr
+            attrs = {"compiled": self._compiled_fresh,
+                     "segment_id": self.segment_id}
+            if counts is not None:
+                attrs["rows_in"] = int(counts[0])
+                attrs["rows_out"] = int(counts[-1])
+            tc.add(f"segment:{self.chain}", kind="segment",
+                   start_us=_tr.now_us() - int(wall_ms * 1000),
+                   dur_us=wall_ms * 1000, **attrs)
 
     def attach_columns(self, src_columns: Dict[str, Column],
                        out: Dict[str, Any]) -> Dict[str, Column]:
@@ -316,7 +341,9 @@ class FusedSegment:
         host = batch.capacity <= ops.TP_HOST_ROWS and ops._is_host_batch(batch)
         self._compiled_fresh = False
         sink = self.stats_sink
-        t0 = time.perf_counter() if (_tracer_on() or sink is not None) else 0.0
+        tc = _trace_ctx()
+        timed = sink is not None or tc is not None or _tracer_on()
+        t0 = time.perf_counter() if timed else 0.0
         counts = None
         if host:
             env = {n: (c.data, c.valid) for n, c in batch.columns.items()}
@@ -336,11 +363,11 @@ class FusedSegment:
             else:
                 out, live = f(batch_env(batch), batch.live_mask(), self.lits())
         ops.DISPATCH_STATS["dispatches"] += 1
-        if sink is not None:
-            sink.append((np.asarray(counts),
-                         round((time.perf_counter() - t0) * 1000, 3)))
-        if _tracer_on():
-            self._record_span(batch.live_mask(), live, t0)
+        if timed:
+            wall = round((time.perf_counter() - t0) * 1000, 3)
+            self._observe(tc, sink, counts, wall)
+            if _tracer_on():
+                self._record_span(batch.live_mask(), live, t0)
         return ColumnBatch(self.attach_columns(batch.columns, out), live)
 
     def run_live_np(self, batch: ColumnBatch) -> np.ndarray:
@@ -367,6 +394,12 @@ def _tracer_on() -> bool:
     from galaxysql_tpu.utils.tracing import SEGMENT_TRACER
     # a query-scoped sink on this thread OR the legacy module-level ring
     return SEGMENT_TRACER.active
+
+
+def _trace_ctx():
+    """The thread's active TraceContext (span tracing), or None."""
+    from galaxysql_tpu.utils import tracing
+    return tracing.current()
 
 
 class FusedPipelineOp(ops.Operator):

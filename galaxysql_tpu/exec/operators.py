@@ -64,9 +64,55 @@ _JIT_CACHE_LIMIT = 4096
 # sync, no lock (approximate under concurrency, exact in the bench loop).
 DISPATCH_STATS = {"dispatches": 0}
 
+# XLA trace+compile accounting: `retraces` counts global_jit builder runs
+# (cache misses — each is a fresh program trace), `compile_ms` accumulates the
+# wall time of each fresh program's FIRST invocation, which is where jax
+# synchronously traces + compiles before dispatching.  Host-side plain adds;
+# bench.py snapshots these per query so compile-cache regressions surface in
+# the perf trajectory, and traced queries get one `compile` span per event.
+COMPILE_STATS = {"retraces": 0, "compile_ms": 0.0}
+
 
 def reset_dispatch_stats():
     DISPATCH_STATS["dispatches"] = 0
+
+
+def reset_compile_stats():
+    COMPILE_STATS["retraces"] = 0
+    COMPILE_STATS["compile_ms"] = 0.0
+
+
+def _timed_first_call(key, f):
+    """Wrap a freshly built program so its first invocation — where jax pays
+    the synchronous trace+compile — is timed into COMPILE_STATS and, when a
+    query is being traced, recorded as a `compile` span attributed to the
+    active span.  After the first call the bare program is swapped back into
+    _JIT_CACHE so steady-state dispatches pay no wrapper frame; callers still
+    holding the wrapper degrade to a single cell-load per call."""
+    import time as _t
+    cell = [None]
+
+    def wrapper(*a, **k):
+        inner = cell[0]
+        if inner is not None:
+            return inner(*a, **k)
+        t0 = _t.perf_counter()
+        out = f(*a, **k)
+        dt_ms = (_t.perf_counter() - t0) * 1000.0
+        cell[0] = f
+        with _JIT_CACHE_LOCK:
+            if _JIT_CACHE.get(key) is wrapper:
+                _JIT_CACHE[key] = f
+        COMPILE_STATS["compile_ms"] += dt_ms
+        from galaxysql_tpu.utils import tracing as _tr
+        tc = _tr.current()
+        if tc is not None:
+            head = key[0] if isinstance(key, tuple) and key else "program"
+            tc.event(f"compile:{head}", kind="compile",
+                     wall_ms=round(dt_ms, 3))
+        return out
+
+    return wrapper
 
 
 def global_jit(key: Tuple, builder, built_flag=None):
@@ -81,13 +127,17 @@ def global_jit(key: Tuple, builder, built_flag=None):
     Eviction is LRU one-at-a-time (move-to-end on hit, evict oldest on
     overflow) — a full clear at the limit would thundering-herd every hot query
     into a simultaneous retrace+recompile.  `built_flag`, when given, is called
-    iff the builder actually ran (compile-vs-cached observability for tracing)."""
+    iff the builder actually ran (compile-vs-cached observability for tracing).
+    Builder runs also feed COMPILE_STATS + the active trace's compile spans."""
     with _JIT_CACHE_LOCK:
         f = _JIT_CACHE.get(key)
         if f is not None:
             _JIT_CACHE.move_to_end(key)
             return f
     f = builder()
+    COMPILE_STATS["retraces"] += 1
+    if callable(f):
+        f = _timed_first_call(key, f)
     if built_flag is not None:
         built_flag()
     with _JIT_CACHE_LOCK:

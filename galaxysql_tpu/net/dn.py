@@ -20,6 +20,7 @@ import json
 import socket
 import struct
 import threading
+from time import perf_counter as _perf
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -86,24 +87,80 @@ class WorkerClient:
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._sock = s
 
+    # ops whose worker-side execution is worth a span subtree; control-plane
+    # chatter (ping, sync, xa_*) stays untraced
+    _TRACED_OPS = frozenset({"exec_plan", "exec_sql", "dml"})
+
     def request(self, header: dict,
                 arrays: Optional[Dict[str, np.ndarray]] = None
                 ) -> Tuple[dict, Dict[str, np.ndarray]]:
-        with self._lock:
-            self._connect()
-            try:
-                send_msg(self._sock, header, arrays)
-                resp, arrs = recv_msg(self._sock)
-            except (ConnectionError, OSError):
-                # one reconnect: the worker may have restarted between queries
-                self.close()
+        from galaxysql_tpu.utils import tracing
+        from galaxysql_tpu.utils.metrics import RPC_RTT_MS
+        tc = tracing.current()
+        rpc_span = None
+        if tc is not None and header.get("op") in self._TRACED_OPS:
+            # inject trace context into the fragment RPC: the worker opens
+            # child spans under `parent` and ships them back in the response
+            header = dict(header)
+            header["trace"] = {"trace_id": tc.trace_id,
+                               "parent": tc.cursor, "node": tc.node}
+            rpc_span = tc.begin(f"rpc:{header['op']}", kind="rpc",
+                                worker=f"{self.addr[0]}:{self.addr[1]}")
+        # timestamps bracket the ACTUAL wire round-trip (captured inside the
+        # lock, re-captured on the reconnect retry): lock-wait and retry time
+        # must skew neither the NTP-style clock offset nor rpc_rtt_ms
+        t_send = t_recv = 0
+        rtt_ms = 0.0
+        try:
+            with self._lock:
                 self._connect()
-                send_msg(self._sock, header, arrays)
-                resp, arrs = recv_msg(self._sock)
+                try:
+                    t_send, t0 = tracing.now_us(), _perf()
+                    send_msg(self._sock, header, arrays)
+                    resp, arrs = recv_msg(self._sock)
+                except (ConnectionError, OSError):
+                    # one reconnect: the worker may have restarted between
+                    # queries
+                    self.close()
+                    self._connect()
+                    t_send, t0 = tracing.now_us(), _perf()
+                    send_msg(self._sock, header, arrays)
+                    resp, arrs = recv_msg(self._sock)
+                rtt_ms = (_perf() - t0) * 1000.0
+                t_recv = tracing.now_us()
+        finally:
+            if rpc_span is not None:
+                tc.end(rpc_span)
+        RPC_RTT_MS.observe(rtt_ms)
+        if rpc_span is not None:
+            self._graft_trace(tc, rpc_span, resp, t_send, t_recv)
         if resp.get("error"):
             from galaxysql_tpu.utils import errors
             raise errors.TddlError(f"worker {self.addr}: {resp['error']}")
         return resp, arrs
+
+    @staticmethod
+    def _graft_trace(tc, rpc_span, resp: dict, t_send: int, t_recv: int):
+        """Adopt the worker's span subtree under the RPC span, correcting its
+        wall clock: the NTP-style offset `((t_send+t_recv) - (w_recv+w_send))
+        / 2` maps the worker's timestamps onto the coordinator's timeline
+        (symmetric-latency assumption — localhost sockets here, where the
+        residual error is microseconds)."""
+        wt = resp.pop("trace", None)
+        if not wt:
+            return
+        try:
+            w_recv = int(wt.get("w_recv_us", 0))
+            w_send = int(wt.get("w_send_us", 0))
+            offset = ((t_send + t_recv) - (w_recv + w_send)) // 2 \
+                if w_recv and w_send else 0
+            spans = tc.graft(wt.get("spans") or [], parent=rpc_span.span_id,
+                             offset_us=offset)
+            rpc_span.attrs["worker_spans"] = len(spans)
+            rpc_span.attrs["clock_offset_us"] = offset
+        except Exception:
+            # a malformed trace payload must never fail the data request
+            rpc_span.attrs["worker_spans"] = -1
 
     def execute(self, sql: str, schema: str = "",
                 xid: Optional[str] = None) -> Tuple[List[str], List[str],

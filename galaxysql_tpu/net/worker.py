@@ -42,6 +42,30 @@ class Worker:
     # -- request handlers ----------------------------------------------------
 
     def handle(self, header: dict, arrays: Dict[str, np.ndarray]):
+        tr = header.get("trace")
+        if tr:
+            return self._handle_traced(header, arrays, tr)
+        return self._handle(header, arrays)
+
+    def _handle_traced(self, header: dict, arrays: Dict[str, np.ndarray],
+                       tr: dict):
+        """Coordinator-injected trace context: run the request under a
+        worker-local TraceContext and ship the recorded spans back (plus this
+        process's request/reply wall clocks, so the coordinator can correct
+        for clock offset before grafting them into the query's tree)."""
+        from galaxysql_tpu.utils import tracing
+        w_recv = tracing.now_us()
+        tc = tracing.TraceContext(int(tr.get("trace_id", 0)),
+                                  node=self.instance.node_id)
+        with tracing.activate(tc):
+            with tc.span(f"worker:{header.get('op')}", kind="worker"):
+                resp, out = self._handle(header, arrays)
+        resp = dict(resp)
+        resp["trace"] = {"w_recv_us": w_recv, "w_send_us": tracing.now_us(),
+                         "spans": [s.to_dict() for s in tc.spans]}
+        return resp, out
+
+    def _handle(self, header: dict, arrays: Dict[str, np.ndarray]):
         op = header.get("op")
         if op == "ping":
             return {"ok": True, "node": self.instance.node_id}, {}
@@ -206,10 +230,17 @@ class Worker:
         return {"ok": True, "xids": xids}, {}
 
     def _exec_sql(self, header: dict):
+        import contextlib
         from galaxysql_tpu.server.session import Session
+        from galaxysql_tpu.utils import tracing
         sql = header["sql"]
         with self._lock:
             self.queries.append(sql)
+        tc = tracing.current()
+
+        def scope(name):
+            return tc.span(name, kind="operator") if tc is not None \
+                else contextlib.nullcontext()
         # an xid routes the statement through that branch's open session so
         # reads observe the branch's own uncommitted writes (the degrade path
         # must keep the same txn visibility the fragment path has)
@@ -218,10 +249,16 @@ class Worker:
         if branch is not None:
             if header.get("schema"):
                 branch.schema = header["schema"]
-            return self._serialize_rs(branch.execute(sql))
+            with scope("execute"):
+                rs = branch.execute(sql)
+            with scope("serialize"):
+                return self._serialize_rs(rs)
         s = Session(self.instance, schema=header.get("schema") or None)
         try:
-            return self._serialize_rs(s.execute(sql))
+            with scope("execute"):
+                rs = s.execute(sql)
+            with scope("serialize"):
+                return self._serialize_rs(rs)
         finally:
             s.close()
 
@@ -334,6 +371,38 @@ class Worker:
         cols_out: Dict[str, list] = {c: [] for c in f["columns"]}
         valid_out: Dict[str, list] = {c: [] for c in f["columns"]}
         deleted_keys: list = []
+        # traced fragments: scan / rf-prune / serialize child spans under the
+        # worker root (grafted into the coordinator's tree by the RPC layer)
+        import contextlib
+        from galaxysql_tpu.utils import tracing
+        tc = tracing.current()
+        scan_scope = tc.span("scan", kind="operator",
+                             table=f"{f['schema']}.{f['table']}") \
+            if tc is not None else contextlib.nullcontext()
+        # rf-prune attribution is traced-only: counting surviving rows costs
+        # an O(partition) sum the untraced fragment path must not pay
+        rf_clock = [0.0, 0] \
+            if tc is not None and (f.get("rf_in") or sargs) else None
+        with scan_scope:
+            err = self._exec_plan_scan(f, store, snapshot, txn_id, lane_point,
+                                       point, sargs, since, del_of, cols_out,
+                                       valid_out, deleted_keys, rf_clock)
+        if err is not None:
+            return err, {}
+        if rf_clock is not None:
+            tc.add("rf-prune", kind="operator",
+                   dur_us=round(rf_clock[0] * 1e6, 1),
+                   rows_pruned=rf_clock[1])
+        ser_scope = tc.span("serialize", kind="operator") \
+            if tc is not None else contextlib.nullcontext()
+        with ser_scope:
+            return self._exec_plan_reply(f, tm, del_of, cols_out, valid_out,
+                                         deleted_keys, snapshot)
+
+    def _exec_plan_scan(self, f, store, snapshot, txn_id, lane_point, point,
+                        sargs, since, del_of, cols_out, valid_out,
+                        deleted_keys, rf_clock):
+        import time as _t
         for p in store.partitions:
             if p.num_rows == 0:
                 continue
@@ -352,10 +421,12 @@ class Worker:
                     vis = p.visible_mask(snapshot, txn_id)
                     if since is not None:
                         vis = vis & (p.begin_ts > int(since))
+                    t_rf = _t.perf_counter() if rf_clock is not None else 0.0
+                    before = int(vis.sum()) if rf_clock is not None else 0
                     for col, op, val in sargs:
                         opf = self._SARG_OPS.get(op)
                         if opf is None:
-                            return {"error": f"unsupported sarg op {op!r}"}, {}
+                            return {"error": f"unsupported sarg op {op!r}"}
                         lane = p.lanes[col]
                         # integer lanes compare in int64 — a float64 cast
                         # collapses values beyond 2^53 and worker-side
@@ -375,6 +446,11 @@ class Worker:
                         vis = vis & p.valid[col] & \
                             np.isin(lane, arr.astype(lane.dtype, copy=False))
                     ids = np.nonzero(vis)[0]
+                    if rf_clock is not None:
+                        # rf-prune attribution (host-side): time + rows
+                        # removed by SARGs/IN-lists, summed over partitions
+                        rf_clock[0] += _t.perf_counter() - t_rf
+                        rf_clock[1] += before - int(ids.size)
                 if del_of is not None:
                     dmask = (p.end_ts >= 0) & (p.end_ts > int(since or 0)) & \
                         (p.end_ts <= snapshot)
@@ -385,6 +461,11 @@ class Worker:
                 for c in f["columns"]:
                     cols_out[c].append(p.lanes[c][ids])
                     valid_out[c].append(p.valid[c][ids])
+        return None
+
+    def _exec_plan_reply(self, f, tm, del_of, cols_out, valid_out,
+                         deleted_keys, snapshot):
+        """Wire-encode the gathered lanes (the `serialize` span's work)."""
         arrays: Dict[str, np.ndarray] = {}
         types = []
         for c in f["columns"]:

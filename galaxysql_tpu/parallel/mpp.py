@@ -148,8 +148,34 @@ class MppExecutor:
     # -- dispatch ----------------------------------------------------------------
 
     def run(self, node: L.RelNode) -> DistBatch:
-        if not getattr(self.ctx, "collect_stats", False):
-            return self._run_node(node)
+        from galaxysql_tpu.utils import tracing
+        tc = tracing.current()
+        collecting = getattr(self.ctx, "collect_stats", False)
+        if tc is None:
+            return self._run_collect(node) if collecting \
+                else self._run_node(node)
+        # traced: one `stage` span per plan node (nested — the stage tree IS
+        # the span tree), with per-shard child spans on sharded outputs so the
+        # Chrome-trace export shows one row per shard and mesh skew is
+        # visible.  Counting shard rows syncs the device — tracing is opt-in,
+        # exactly like profiling.
+        sp = tc.begin(f"mpp:{type(node).__name__}", kind="stage")
+        try:
+            out = self._run_collect(node) if collecting \
+                else self._run_node(node)
+        finally:
+            tc.end(sp)
+        live = np.asarray(out.live)
+        sp.attrs["rows"] = int(live.sum())
+        sp.attrs["replicated"] = out.replicated
+        if not out.replicated and live.size and live.size % self.S == 0:
+            for si, rn in enumerate(live.reshape(self.S, -1).sum(axis=1)):
+                tc.add(f"shard{si}", kind="shard", parent=sp.span_id,
+                       start_us=sp.start_us, dur_us=sp.dur_us,
+                       shard=si, rows=int(rn))
+        return out
+
+    def _run_collect(self, node: L.RelNode) -> DistBatch:
         # profiling: per-stage wall + row counts (the reference's MPP
         # QueryStats/StageStats/TaskStats, §5.1).  Counting live rows forces a
         # device sync per stage — exactly why the default path never enters

@@ -294,8 +294,12 @@ class Binder:
                 return self._bind_view_ref(view, t, scope)
             tm = self.catalog.table(schema, t.table)
             alias = (t.alias or t.table).lower()
-            cols = [(f"{alias}.{c.name}", c.name) for c in tm.columns]
-            scan = L.Scan(tm, alias, cols)
+            # ONE read of the live column list; the metas ride the scan so
+            # fields() never re-resolves names a concurrent DDL may drop
+            metas = list(tm.columns)
+            cols = [(f"{alias}.{c.name}", c.name) for c in metas]
+            scan = L.Scan(tm, alias, cols,
+                          col_meta={c.name: c for c in metas})
             as_of = t.as_of
             if isinstance(as_of, ast.ParamRef):
                 as_of = int(self.params[as_of.index])

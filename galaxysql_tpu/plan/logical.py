@@ -69,10 +69,17 @@ class RelNode:
 
 class Scan(RelNode):
     def __init__(self, table: TableMeta, alias: str,
-                 columns: Sequence[Tuple[str, str]]):  # (out_id, table_column)
+                 columns: Sequence[Tuple[str, str]],  # (out_id, table_column)
+                 col_meta: Optional[Dict[str, Any]] = None):
         self.table = table
         self.alias = alias
         self.columns = list(columns)
+        # bind-time ColumnMeta snapshot: planning holds no MDL, so a
+        # concurrent DROP COLUMN can remove a name from the live catalog
+        # between Scan construction and a later fields() call — resolving
+        # through the snapshot keeps the plan self-consistent (pruning will
+        # drop the unreferenced lane anyway)
+        self._col_meta: Dict[str, Any] = dict(col_meta or {})
         self.children = []
         # filled by the pruning pass; None = all partitions
         self.partitions: Optional[List[int]] = None
@@ -92,10 +99,19 @@ class Scan(RelNode):
         # the scan (the join above re-verifies, so these prune, never decide)
         self.rf_targets: List[Any] = []
 
+    def column_meta(self, col: str):
+        """ColumnMeta for a scan column — the bind-time snapshot when one was
+        taken, the live catalog otherwise (rule-built scans)."""
+        cm = self._col_meta.get(col)
+        if cm is None:
+            cm = self.table.column(col)
+            self._col_meta[col] = cm
+        return cm
+
     def fields(self) -> List[Field]:
         out = []
         for out_id, col in self.columns:
-            cm = self.table.column(col)
+            cm = self.column_meta(col)
             out.append((out_id, cm.dtype, self.table.dictionaries.get(col.lower())))
         return out
 

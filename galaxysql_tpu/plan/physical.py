@@ -523,12 +523,72 @@ def record_rf_stats(ctx, segment, rf_node, totals):
                         node_id=id(rf_node) if rf_node is not None else None)
 
 
+class TraceOp(ops.Operator):
+    """Span-tracing wrapper: one `operator` span per plan node, parented at
+    BUILD time (the plan tree is the span tree), timed at DRAIN time.  While a
+    batch is being pulled from the wrapped operator the context's cursor
+    points at this span, so leaf recorders that fire inside the pull — fused
+    segment dispatches, compile events, device-cache transfers, worker RPCs —
+    attach under the operator doing the work.  Row counts are deliberately
+    NOT collected here (that is profiling's job and costs a device sync);
+    tracing measures only where wall time went."""
+
+    def __init__(self, inner: ops.Operator, span, tc):
+        self.inner = inner
+        self.span = span
+        self.tc = tc
+
+    def batches(self):
+        import time as _t
+        from galaxysql_tpu.utils import tracing as _tr
+        tc, sp = self.tc, self.span
+        sp.start_us = _tr.now_us()
+        t0 = _t.perf_counter()
+        batches = 0
+        it = self.inner.batches()
+        while True:
+            prev = tc.cursor
+            tc.cursor = sp.span_id
+            try:
+                try:
+                    b = next(it)
+                except StopIteration:
+                    break
+            finally:
+                tc.cursor = prev
+            batches += 1
+            # finalize-per-pull: a downstream LIMIT may drop the generator
+            # without exhausting it, and the span must still carry real time
+            sp.dur_us = round((_t.perf_counter() - t0) * 1e6, 1)
+            sp.attrs["batches"] = batches
+            yield b
+        sp.dur_us = round((_t.perf_counter() - t0) * 1e6, 1)
+        sp.attrs["batches"] = batches
+
+
 def build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
-    op = _build_operator(node, ctx)
+    from galaxysql_tpu.utils import tracing
+    tc = tracing.current()
+    if tc is None:
+        op = _build_operator(node, ctx)
+        if getattr(ctx, "collect_stats", False) and \
+                not isinstance(op, SegmentStatsOp):
+            return StatsOp(op, node, ctx)
+        return op
+    # traced build: mint this node's span under the parent operator's (the
+    # recursion below threads the cursor through ctx), then wrap the drain
+    parent = getattr(ctx, "_trace_parent", None)
+    sp = tc.add(type(node).__name__, kind="operator",
+                parent=tc.cursor if parent is None else parent)
+    ctx._trace_parent = sp.span_id
+    try:
+        op = _build_operator(node, ctx)
+    finally:
+        ctx._trace_parent = parent
     if getattr(ctx, "collect_stats", False) and \
             not isinstance(op, SegmentStatsOp):
-        return StatsOp(op, node, ctx)
-    return op
+        op = StatsOp(op, node, ctx)
+    return TraceOp(op, sp, tc)
 
 
 def _fusing(ctx: ExecContext) -> bool:

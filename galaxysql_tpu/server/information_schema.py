@@ -51,6 +51,11 @@ _DEFS: Dict[str, List] = {
                     ("rows_returned", _I), ("operator_count", _I),
                     ("segment_count", _I), ("profiled", _I),
                     ("peak_rss_kb", _I), ("sql_text", _V)],
+    # per-query span trees (TraceContext; ENABLE_QUERY_TRACING) — one row per
+    # span of every retained traced profile, worker-side spans included
+    "query_spans": [("trace_id", _I), ("span_id", _I), ("parent_id", _I),
+                    ("span_name", _V), ("kind", _V), ("node", _V),
+                    ("start_us", _I), ("dur_us", _D), ("attrs", _V)],
     # the typed counter/gauge registry (utils/metrics.py)
     "metrics": [("metric_name", _V), ("metric_kind", _V), ("value", _D),
                 ("help", _V)],
@@ -157,6 +162,12 @@ def refresh(instance, session=None):
                           len(p.segments), 1 if p.profiled else 0,
                           p.peak_rss_kb, p.sql]
                          for p in (profiles.entries() if profiles else [])))
+    import json as _json
+    fill("query_spans", ([p.trace_id, sp.span_id, sp.parent_id, sp.name,
+                          sp.kind, sp.node, sp.start_us, float(sp.dur_us),
+                          _json.dumps(sp.attrs, default=str)[:512]]
+                         for p in (profiles.entries() if profiles else [])
+                         for sp in p.spans))
     metrics = getattr(instance, "metrics", None)
     fill("metrics", ([n, k, float(v), h]
                      for n, k, v, h in (metrics.rows() if metrics else [])))

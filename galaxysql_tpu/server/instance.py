@@ -62,12 +62,23 @@ class Instance:
         self.sync_bus = SyncBus()
         from galaxysql_tpu.meta.ha import HaManager
         self.ha = HaManager(self)
-        from galaxysql_tpu.utils.metrics import MetricsRegistry
-        from galaxysql_tpu.utils.tracing import ProfileRing
+        from galaxysql_tpu.utils.metrics import (MetricsRegistry, RPC_RTT_MS,
+                                                 SEGMENT_WALL_MS)
+        from galaxysql_tpu.utils.tracing import ProfileRing, TraceIdAllocator
         # typed counter/gauge registry: SQL (information_schema.metrics,
         # SHOW METRICS), web (/metrics Prometheus text) and the legacy
         # engine-counter surface all render from here
         self.metrics = MetricsRegistry()
+        # process-shared latency histograms (segment dispatch wall, worker RPC
+        # round-trip) surface through this instance's registry; query latency
+        # is per-instance and observed in Session._finish_query
+        self.metrics.adopt(SEGMENT_WALL_MS)
+        self.metrics.adopt(RPC_RTT_MS)
+        self.metrics.histogram("query_latency_ms",
+                               "end-to-end query latency (ms)")
+        # node-prefixed trace-id mint: peer coordinators (sync_peer setups)
+        # must never stamp two queries with one id
+        self.trace_ids = TraceIdAllocator(self.node_id)
         # dict-like view over typed counters (engine_counters virtual table);
         # `counters["x"] += 1` call sites keep working unchanged
         self.counters = self.metrics.counter_map("engine")

@@ -112,6 +112,7 @@ class Session:
         self.user_vars: Dict[str, Any] = {}
         self.user = "root"
         self.last_trace: List[str] = []
+        self.last_spans: List[Any] = []  # last traced query's span tree
         instance.sessions[self.conn_id] = self
 
     # -- public API -----------------------------------------------------------
@@ -502,6 +503,10 @@ class Session:
         return bool(self.instance.config.get("ENABLE_QUERY_PROFILING",
                                              self.vars))
 
+    def _tracing_enabled(self) -> bool:
+        return bool(self.instance.config.get("ENABLE_QUERY_TRACING",
+                                             self.vars))
+
     def _finish_query(self, sql: str, elapsed: float, prof, workload: str,
                       engine: str, rows: int, ctx=None):
         """Every query's single exit ramp: fill + record the QueryProfile,
@@ -527,6 +532,8 @@ class Session:
         inst = self.instance
         inst.profiles.record(prof)
         m = inst.metrics
+        m.histogram("query_latency_ms",
+                    "end-to-end query latency (ms)").observe(elapsed * 1000)
         m.counter("queries_total", "queries executed").inc()
         m.counter(f"queries_{workload.lower()}",
                   f"{workload} workload queries").inc()
@@ -544,21 +551,84 @@ class Session:
     def _run_query(self, stmt, sql: str, params: Optional[list]) -> ResultSet:
         schema = self._require_schema()
         t0 = time.time()
-        from galaxysql_tpu.utils.tracing import QueryProfile, next_trace_id
-        prof = QueryProfile(trace_id=next_trace_id(),
-                            sql=(sql or "<stmt>")[:512], schema=schema,
-                            conn_id=self.conn_id, started_at=t0)
+        from galaxysql_tpu.utils import tracing
+        prof = tracing.QueryProfile(trace_id=self.instance.trace_ids.next(),
+                                    sql=(sql or "<stmt>")[:512], schema=schema,
+                                    conn_id=self.conn_id, started_at=t0)
         if "information_schema" in (sql or "").lower() or \
                 schema.lower() == "information_schema":
             from galaxysql_tpu.server import information_schema
             information_schema.refresh(self.instance, self)
         from galaxysql_tpu.utils.ccl import GLOBAL_CCL
         admission = GLOBAL_CCL.admit(self, sql or "")
+        tc = None
+        if self._tracing_enabled():
+            tc = tracing.TraceContext(prof.trace_id,
+                                      node=self.instance.node_id)
+            prof.spans = tc.spans  # alias: the ring sees spans as they land
+        else:
+            self.last_spans = []  # SHOW TRACE must not show a stale tree
         try:
-            return self._run_query_admitted(stmt, sql, params, schema, t0,
-                                            prof)
+            if tc is None:
+                return self._run_query_admitted(stmt, sql, params, schema,
+                                                t0, prof)
+            with tracing.activate(tc):
+                with tc.span("query", kind="query", sql=prof.sql[:128],
+                             conn=self.conn_id, schema=schema):
+                    rs = self._run_query_admitted(stmt, sql, params, schema,
+                                                  t0, prof)
+            self._finish_trace(tc)
+            return rs
+        except Exception as e:
+            self._record_query_error(sql, t0, prof, e, tc)
+            raise
         finally:
             admission.release()
+
+    def _finish_trace(self, tc):
+        """Close out a traced query: stamp device telemetry on the root span
+        and park the tree for SHOW TRACE."""
+        from galaxysql_tpu.exec.device_cache import hbm_high_water
+        if tc.spans:
+            hbm = hbm_high_water()
+            if hbm:
+                tc.spans[0].attrs["hbm_peak_bytes"] = hbm
+        self.last_spans = list(tc.spans)
+
+    def _record_query_error(self, sql, t0, prof, exc, tc):
+        """A query that dies mid-execution still owes observability its
+        elapsed-time attribution: record the profile (with the error), an
+        error span closing the trace, and a slow-log entry when the time
+        already spent crosses the slow gate — SHOW SLOW and SHOW TRACE must
+        explain slow FAILURES, not just slow successes (utils/errors.py
+        supplies the errno/sqlstate attributes)."""
+        from galaxysql_tpu.utils import errors as _err
+        from galaxysql_tpu.utils.tracing import GLOBAL_STATS, SLOW_LOG
+        elapsed = time.time() - t0
+        prof.elapsed_ms = round(elapsed * 1000, 3)
+        prof.error = f"{type(exc).__name__}: {exc}"[:512]
+        inst = self.instance
+        if tc is not None:
+            # the query span has already closed (cursor is back at 0), so
+            # parent explicitly under the root — the tree must stay closed
+            tc.add("error", kind="error", parent=tc.root_id,
+                   **_err.span_attrs(exc))
+            self._finish_trace(tc)
+        inst.profiles.record(prof)
+        GLOBAL_STATS.bump("errors")
+        inst.metrics.counter("query_errors",
+                             "queries failed mid-execution").inc()
+        self.last_trace = [f"trace-id {prof.trace_id}",
+                           f"error {prof.error}",
+                           f"elapsed={elapsed:.3f}s"]
+        slow_ms = inst.config.get("SLOW_SQL_MS", self.vars)
+        if slow_ms is not None and slow_ms >= 0 and elapsed * 1000 >= slow_ms:
+            SLOW_LOG.record(sql or "<stmt>", elapsed, self.conn_id,
+                            trace_id=prof.trace_id, workload=prof.workload,
+                            error=type(exc).__name__)
+            GLOBAL_STATS.bump("slow")
+            inst.metrics.counter("slow_queries",
+                                 "queries over SLOW_SQL_MS").inc()
 
     def _run_query_admitted(self, stmt, sql, params, schema, t0,
                             prof) -> ResultSet:
@@ -1402,8 +1472,7 @@ class Session:
         lines = plan.explain().split("\n")
         if stmt.analyze:
             from galaxysql_tpu.utils.tracing import (QueryProfile,
-                                                     SEGMENT_TRACER,
-                                                     next_trace_id)
+                                                     SEGMENT_TRACER)
             cache = None
             if plan.workload == "AP" and self.instance.config.get(
                     "ENABLE_TPU_ENGINE", self.vars):
@@ -1417,10 +1486,16 @@ class Session:
                               archive=self.instance.archive,
                               archive_instance=self.instance)
             ctx.collect_stats = True  # per-operator rows/time (RuntimeStatistics)
-            prof = QueryProfile(trace_id=next_trace_id(),
+            prof = QueryProfile(trace_id=self.instance.trace_ids.next(),
                                 sql="<explain analyze>", schema=schema,
                                 conn_id=self.conn_id, started_at=time.time())
             ctx.profile = prof
+            # compile/transfer attribution: deltas over the process counters
+            # bracket this execution (host-side reads, free)
+            from galaxysql_tpu.exec.device_cache import TRANSFER_STATS
+            from galaxysql_tpu.exec.operators import COMPILE_STATS
+            c0 = dict(COMPILE_STATS)
+            x0 = dict(TRANSFER_STATS)
             op = build_operator(plan.rel, ctx)
             from galaxysql_tpu.plan import logical as L
             mdl_keys = {f"{n.table.schema.lower()}.{n.table.name.lower()}"
@@ -1439,8 +1514,15 @@ class Session:
             from galaxysql_tpu.plan.physical import annotate_explain
             lines = annotate_explain(plan.rel, ctx.op_stats,
                                      rf=getattr(ctx, "rf", None))
+            d_retr = COMPILE_STATS["retraces"] - c0["retraces"]
+            d_cms = COMPILE_STATS["compile_ms"] - c0["compile_ms"]
+            d_bytes = TRANSFER_STATS["bytes"] - x0["bytes"]
+            d_xfers = TRANSFER_STATS["transfers"] - x0["transfers"]
             lines += [f"-- trace_id: {prof.trace_id}", f"-- rows: {rows}",
-                      f"-- elapsed: {elapsed:.3f}s"] + \
+                      f"-- elapsed: {elapsed:.3f}s",
+                      f"-- compile: retraces={d_retr} wall={d_cms:.3f}ms",
+                      f"-- transfer: h2d_bytes={d_bytes} "
+                      f"transfers={d_xfers}"] + \
                 [f"-- {t}" for t in ctx.trace]
             for st in ctx.op_stats:
                 tag = f" fused({st['segment']})" if st.get("fused") else ""

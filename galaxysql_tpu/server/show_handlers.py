@@ -145,13 +145,16 @@ def handle(session, stmt: ast.Show):
     if kind == "slow":
         from galaxysql_tpu.utils.tracing import SLOW_LOG
         # Trace_id links a slow row to its profile (SHOW FULL STATS /
-        # information_schema.query_stats / web /query/<trace_id>)
+        # information_schema.query_stats / web /query/<trace_id>); Error is
+        # non-empty for queries that died mid-execution AFTER crossing the
+        # slow gate — slow failures explain themselves here too
         rows = [(e.conn_id, round(e.elapsed_s * 1000, 1), e.sql,
-                 e.trace_id, e.workload)
+                 e.trace_id, e.workload, e.error)
                 for e in SLOW_LOG.entries()]
-        return ResultSet(["Conn", "Elapsed_ms", "SQL", "Trace_id", "Workload"],
+        return ResultSet(["Conn", "Elapsed_ms", "SQL", "Trace_id", "Workload",
+                          "Error"],
                          [dt.BIGINT, dt.DOUBLE, dt.VARCHAR, dt.BIGINT,
-                          dt.VARCHAR], rows)
+                          dt.VARCHAR, dt.VARCHAR], rows)
     if kind == "fragment" and (stmt.target or "").lower() == "cache":
         # SHOW FRAGMENT CACHE: one row per resident entry, MRU first, plus
         # the totals SHOW METRICS carries as frag_cache_* counters
@@ -197,8 +200,15 @@ def handle(session, stmt: ast.Show):
         return ResultSet(["Level", "Code", "Message"],
                          [dt.VARCHAR, dt.BIGINT, dt.VARCHAR], [])
     if kind == "trace":
-        return ResultSet(["Trace"], [dt.VARCHAR],
-                         [(t,) for t in session.last_trace])
+        # flat trace tags first (the legacy SQLTracer lines), then — when the
+        # last query ran with ENABLE_QUERY_TRACING — the full span tree,
+        # worker-side spans included
+        lines = list(session.last_trace)
+        spans = getattr(session, "last_spans", None)
+        if spans:
+            from galaxysql_tpu.utils.tracing import span_tree_lines
+            lines += span_tree_lines(spans)
+        return ResultSet(["Trace"], [dt.VARCHAR], [(t,) for t in lines])
     if kind in ("status", "engines", "charset", "collation"):
         if kind == "engines":
             return ResultSet(["Engine", "Support", "Comment"],

@@ -12,6 +12,10 @@ resources served by the MPP coordinator's HTTP server).  Endpoints:
 - /query-stats       last-N QueryProfile summaries (newest first)
 - /query/<trace_id>  one query's full profile: per-operator rows/time,
                      fused-segment spans, trace tags (QueryStats analog)
+- /trace/<trace_id>  the query's span tree as Chrome-trace/Perfetto JSON
+                     (load in chrome://tracing or ui.perfetto.dev: one pid
+                     per node — coordinator + each worker — one tid row per
+                     mesh shard, compile/transfer events attributed in place)
 - /metrics           the typed counter/gauge registry in Prometheus text
                      exposition format (the scrape endpoint)
 
@@ -56,7 +60,8 @@ class WebConsole:
                     "last_trace": list(getattr(s, "last_trace", []))[-8:]})
             slow = [{"sql": e.sql, "elapsed_s": e.elapsed_s,
                      "conn_id": e.conn_id, "at": e.at,
-                     "trace_id": e.trace_id, "workload": e.workload}
+                     "trace_id": e.trace_id, "workload": e.workload,
+                     "error": e.error}
                     for e in SLOW_LOG.entries()]
             return {"sessions": sessions, "slow_queries": slow[-50:]}
         if path == "/cluster":
@@ -98,6 +103,12 @@ class WebConsole:
             if p is None:
                 return None
             return p.to_dict()  # segments/op_stats serialized there
+        if path.startswith("/trace/"):
+            from galaxysql_tpu.utils.tracing import chrome_trace
+            p = inst.profiles.get(path[len("/trace/"):])
+            if p is None or not p.spans:
+                return None  # untraced query: no tree to export
+            return chrome_trace(p.trace_id, p.spans)
         return None
 
     def metrics_text(self) -> str:

@@ -86,3 +86,15 @@ class CclRejectError(TddlError):
     """Query rejected/queued-timeout by concurrency control (CCL analog)."""
     errno = 3168
     sqlstate = "HY000"
+
+
+def span_attrs(exc: BaseException) -> dict:
+    """Error attributes for span tracing: the (errno, sqlstate) taxonomy above
+    rides error spans so SHOW TRACE / the Chrome-trace export explain a failed
+    query the same way the wire's ERR packet would."""
+    return {
+        "exception": type(exc).__name__,
+        "errno": int(getattr(exc, "errno", 1105) or 1105),
+        "sqlstate": str(getattr(exc, "sqlstate", "HY000") or "HY000"),
+        "message": str(exc)[:256],
+    }

@@ -13,6 +13,7 @@ query hot path).
 
 from __future__ import annotations
 
+import random
 import re
 import threading
 from typing import Dict, Iterator, List, Tuple
@@ -77,6 +78,80 @@ class Gauge:
         return self._value
 
 
+class Histogram:
+    """Quantile summary over a bounded reservoir (Prometheus `summary`).
+
+    Algorithm R reservoir sampling: the first `reservoir` observations are
+    kept verbatim, later ones replace a uniformly random slot with probability
+    reservoir/count — every observation ever made has equal survival odds, so
+    p50/p95/p99 stay unbiased without unbounded memory.  All host-side float
+    work under the lock; nothing here may touch device state."""
+
+    __slots__ = ("name", "help", "_buf", "_cap", "_count", "_sum", "_lock")
+
+    kind = "histogram"
+    QUANTILES = (0.5, 0.95, 0.99)
+
+    def __init__(self, name: str, help: str = "", reservoir: int = 1024):
+        self.name = name
+        self.help = help
+        self._buf: List[float] = []
+        self._cap = reservoir
+        self._count = 0
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float):
+        v = float(v)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if len(self._buf) < self._cap:
+                self._buf.append(v)
+            else:
+                j = random.randrange(self._count)
+                if j < self._cap:
+                    self._buf[j] = v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            if not self._buf:
+                return 0.0
+            s = sorted(self._buf)
+        idx = min(int(q * len(s)), len(s) - 1)
+        return s[idx]
+
+    def quantiles(self) -> Dict[float, float]:
+        with self._lock:
+            if not self._buf:
+                return {q: 0.0 for q in self.QUANTILES}
+            s = sorted(self._buf)
+        return {q: s[min(int(q * len(s)), len(s) - 1)]
+                for q in self.QUANTILES}
+
+    @property
+    def value(self) -> float:
+        """Scalar view (p50) for generic metric listings."""
+        return self.quantile(0.5)
+
+
+# process-shared histograms: observed from code that has no Instance handle
+# (fused-segment dispatches, worker RPC clients); every Instance adopts them
+# into its registry so SHOW METRICS / /metrics export the quantiles.
+SEGMENT_WALL_MS = Histogram(
+    "segment_wall_ms", "fused-segment dispatch wall time (ms)")
+RPC_RTT_MS = Histogram(
+    "rpc_rtt_ms", "coordinator->worker RPC round-trip (ms)")
+
+
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
 
@@ -115,24 +190,63 @@ class MetricsRegistry:
     def gauge(self, name: str, help: str = "") -> Gauge:
         return self._get_or_create(name, Gauge, help)
 
+    def histogram(self, name: str, help: str = "") -> Histogram:
+        return self._get_or_create(name, Histogram, help)
+
+    def adopt(self, metric) -> None:
+        """Register an EXISTING metric object (the process-shared histograms)
+        under its own name; same kind-conflict rule as get-or-create."""
+        name = _sanitize(metric.name)
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                self._metrics[name] = metric
+            elif m is not metric and not isinstance(metric, type(m)):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}")
+
     def counter_map(self, prefix: str) -> "CounterMap":
         return CounterMap(self, prefix)
 
     def rows(self) -> List[Tuple[str, str, float, str]]:
         """(name, kind, value, help) per metric, name-sorted — the
-        information_schema.metrics / SHOW METRICS row shape."""
+        information_schema.metrics / SHOW METRICS row shape.  Histograms
+        expand into one row per quantile plus _count/_sum so SQL surfaces see
+        scalars."""
         with self._lock:
             ms = sorted(self._metrics.items())
-        return [(n, m.kind, m.value, m.help) for n, m in ms]
+        out: List[Tuple[str, str, float, str]] = []
+        for n, m in ms:
+            if m.kind == "histogram":
+                qs = m.quantiles()
+                for q, v in sorted(qs.items()):
+                    out.append((f"{n}_p{int(q * 100)}", "histogram",
+                                float(v), m.help))
+                out.append((f"{n}_count", "histogram", float(m.count), m.help))
+                out.append((f"{n}_sum", "histogram", float(m.sum), m.help))
+            else:
+                out.append((n, m.kind, m.value, m.help))
+        return out
 
     def prometheus_text(self) -> str:
-        """Prometheus text exposition format (one block per metric)."""
+        """Prometheus text exposition format (one block per metric;
+        histograms render as summaries with quantile labels)."""
         out = []
-        for name, kind, value, help in self.rows():
+        with self._lock:
+            ms = sorted(self._metrics.items())
+        for name, m in ms:
             full = f"{self.namespace}_{name}"
-            if help:
-                out.append(f"# HELP {full} {help}")
-            out.append(f"# TYPE {full} {kind}")
+            if m.help:
+                out.append(f"# HELP {full} {m.help}")
+            if m.kind == "histogram":
+                out.append(f"# TYPE {full} summary")
+                for q, v in sorted(m.quantiles().items()):
+                    out.append(f'{full}{{quantile="{q}"}} {v}')
+                out.append(f"{full}_sum {m.sum}")
+                out.append(f"{full}_count {m.count}")
+                continue
+            out.append(f"# TYPE {full} {m.kind}")
+            value = m.value
             if isinstance(value, float) and not value.is_integer():
                 out.append(f"{full} {value}")
             else:

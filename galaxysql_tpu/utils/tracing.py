@@ -1,8 +1,17 @@
-"""Tracing / profiling: slow-SQL recorder + per-query runtime statistics.
+"""Tracing / profiling: slow-SQL recorder, per-query runtime statistics, and
+the hierarchical span-tracing subsystem.
 
 Reference analog: SURVEY.md §5.1 — `SQLRecorder` (slow-SQL ring), `SQLTracer`
 (SHOW TRACE, held per session as `last_trace`), and `RuntimeStatistics` counters
-surfaced via EXPLAIN ANALYZE and SHOW FULL STATS.
+surfaced via EXPLAIN ANALYZE and SHOW FULL STATS.  The span layer goes past the
+coordinator boundary the reference stops at: one `TraceContext` per traced
+query collects a span TREE — coordinator operators, fused-segment dispatches,
+MPP per-shard stages, device-cache transfers, XLA compile events, and
+worker-process child spans grafted back over the wire with clock-offset
+correction — exported as Chrome-trace/Perfetto JSON from `/trace/<trace_id>`.
+
+Everything here is opt-in: with tracing off, `current()` returns None and no
+code path allocates a span, times a dispatch, or syncs a device.
 """
 
 from __future__ import annotations
@@ -13,18 +22,41 @@ import dataclasses
 import itertools
 import threading
 import time
+import zlib
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
-# -- monotonic trace ids -------------------------------------------------------
+# -- node-prefixed trace ids ---------------------------------------------------
+#
+# Trace ids stay BIGINT-shaped (every surface — SHOW SLOW, query_stats,
+# /query/<id> — stores them as int64), but the high bits carry a per-instance
+# node hash: two coordinators (Instance.sync_peer topologies) mint from their
+# own allocators and can never collide the way the old process-monotonic
+# counter did when each process restarted its count at 1.
 
-_TRACE_IDS = itertools.count(1)
-_TRACE_ID_LOCK = threading.Lock()
+_NODE_BITS = 40  # low bits: per-node monotonic counter (~10^12 queries)
 
 
-def next_trace_id() -> int:
-    """Process-monotonic query trace id (the reference's traceId, §5.1)."""
-    with _TRACE_ID_LOCK:
-        return next(_TRACE_IDS)
+class TraceIdAllocator:
+    """Per-instance trace-id mint: `(crc32(node_id) << 40) | counter`.
+
+    Monotonic within a node; globally unique across nodes up to the 22-bit
+    node-hash birthday bound (id collisions across coordinators were certain
+    before — two nodes both counting 1, 2, 3…)."""
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        self._prefix = (zlib.crc32(node_id.encode()) & 0x3FFFFF) << _NODE_BITS
+        self._count = itertools.count(1)
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            return self._prefix | next(self._count)
+
+
+def trace_node_hash(trace_id: int) -> int:
+    """The minting node's 22-bit hash embedded in a trace id."""
+    return (int(trace_id) >> _NODE_BITS) & 0x3FFFFF
 
 
 @dataclasses.dataclass
@@ -35,6 +67,7 @@ class SlowEntry:
     at: float
     trace_id: int = 0     # links SHOW SLOW rows to information_schema.query_stats
     workload: str = ""    # TP | AP
+    error: str = ""       # non-empty: the query FAILED after elapsed_s
 
 
 class SlowLog:
@@ -45,10 +78,11 @@ class SlowLog:
         self._lock = threading.Lock()
 
     def record(self, sql: str, elapsed_s: float, conn_id: int,
-               trace_id: int = 0, workload: str = ""):
+               trace_id: int = 0, workload: str = "", error: str = ""):
         with self._lock:
             self._ring.append(SlowEntry(sql[:512], elapsed_s, conn_id,
-                                        time.time(), trace_id, workload))
+                                        time.time(), trace_id, workload,
+                                        error))
 
     def entries(self) -> List[SlowEntry]:
         with self._lock:
@@ -136,6 +170,207 @@ class SegmentTracer:
 SEGMENT_TRACER = SegmentTracer()
 
 
+# -- hierarchical span tracing -------------------------------------------------
+
+
+def now_us() -> int:
+    """Wall-clock microseconds — the shared timebase span timestamps use so
+    worker-process spans can be offset-corrected against the coordinator's."""
+    return int(time.time() * 1e6)
+
+
+@dataclasses.dataclass
+class Span:
+    """One node of a query's span tree.  `parent_id == 0` marks the root.
+    Mutable on purpose: operator spans are opened at plan-build time and their
+    timing filled in as execution drains them."""
+
+    span_id: int
+    parent_id: int
+    name: str
+    kind: str                  # query|operator|segment|stage|shard|rpc|worker|
+    #                            compile|transfer|cache|error
+    node: str = ""             # node_id of the process that recorded it
+    start_us: int = 0
+    dur_us: float = 0.0
+    attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def span_from_dict(d: Dict[str, Any]) -> Span:
+    return Span(int(d.get("span_id", 0)), int(d.get("parent_id", 0)),
+                str(d.get("name", "")), str(d.get("kind", "")),
+                str(d.get("node", "")), int(d.get("start_us", 0)),
+                float(d.get("dur_us", 0.0)), dict(d.get("attrs") or {}))
+
+
+class TraceContext:
+    """Per-query span collector.
+
+    A query executes on ONE host thread (MPP stages are host-dispatched from
+    it; worker spans arrive on it via the RPC reply), so parenting uses a plain
+    `cursor` — the span id runtime recorders should attach under.  Structural
+    code (operator build, stage recursion, RPC round-trips) moves the cursor
+    with begin/end or the `span()` context manager; leaf recorders (segment
+    dispatches, compile events, cache transfers) just read it."""
+
+    def __init__(self, trace_id: int, node: str = ""):
+        self.trace_id = trace_id
+        self.node = node
+        self.spans: List[Span] = []
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self.cursor = 0  # current parent span id (0 = attach to root/none)
+
+    # -- span construction ---------------------------------------------------
+
+    def add(self, name: str, kind: str, parent: Optional[int] = None,
+            start_us: Optional[int] = None, dur_us: float = 0.0,
+            **attrs) -> Span:
+        """Append a span (explicit or cursor parent); returns it for later
+        timing fill-in."""
+        with self._lock:
+            sid = next(self._ids)
+            sp = Span(sid, self.cursor if parent is None else parent,
+                      name, kind, self.node,
+                      now_us() if start_us is None else start_us,
+                      dur_us, attrs)
+            self.spans.append(sp)
+        return sp
+
+    def event(self, name: str, kind: str = "event", **attrs) -> Span:
+        """Instantaneous (zero-duration) span under the cursor — compile
+        events, cache hits, transfer markers."""
+        return self.add(name, kind, **attrs)
+
+    def begin(self, name: str, kind: str, **attrs) -> Span:
+        """Open a span and move the cursor under it (manual form; pair with
+        `end`)."""
+        sp = self.add(name, kind, **attrs)
+        sp._t0 = time.perf_counter()
+        sp._prev_cursor = self.cursor
+        self.cursor = sp.span_id
+        return sp
+
+    def end(self, sp: Span):
+        sp.dur_us = round((time.perf_counter() - sp._t0) * 1e6, 1)
+        self.cursor = sp._prev_cursor
+
+    @contextlib.contextmanager
+    def span(self, name: str, kind: str, **attrs):
+        sp = self.begin(name, kind, **attrs)
+        try:
+            yield sp
+        except BaseException as e:
+            sp.attrs["error"] = f"{type(e).__name__}: {e}"[:256]
+            raise
+        finally:
+            self.end(sp)
+
+    @property
+    def root_id(self) -> int:
+        return self.spans[0].span_id if self.spans else 0
+
+    # -- cross-process grafting ----------------------------------------------
+
+    def graft(self, span_dicts: List[Dict[str, Any]], parent: int,
+              offset_us: int = 0) -> List[Span]:
+        """Adopt spans recorded by another process: remint span ids into this
+        context's id space (the worker's counter collides with ours), hang
+        orphans under `parent`, and shift their wall clocks by `offset_us`
+        (the NTP-style offset the RPC layer measured)."""
+        remap: Dict[int, int] = {}
+        out: List[Span] = []
+        with self._lock:
+            for d in span_dicts:
+                sp = span_from_dict(d)
+                new_id = next(self._ids)
+                remap[sp.span_id] = new_id
+                sp.span_id = new_id
+                sp.parent_id = remap.get(sp.parent_id, parent)
+                sp.start_us += offset_us
+                self.spans.append(sp)
+                out.append(sp)
+        return out
+
+    # -- rendering -----------------------------------------------------------
+
+    def tree_lines(self) -> List[str]:
+        return span_tree_lines(self.spans)
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        return chrome_trace(self.trace_id, self.spans)
+
+
+def span_tree_lines(spans: List[Span]) -> List[str]:
+    """The span tree as indented text (the SHOW TRACE rendering)."""
+    children: Dict[int, List[Span]] = {}
+    by_id = {s.span_id: s for s in spans}
+    roots: List[Span] = []
+    for s in spans:
+        if s.parent_id and s.parent_id in by_id:
+            children.setdefault(s.parent_id, []).append(s)
+        else:
+            roots.append(s)
+    lines: List[str] = []
+
+    def walk(sp: Span, depth: int):
+        extra = " ".join(f"{k}={v}" for k, v in sorted(sp.attrs.items()))
+        node = f" @{sp.node}" if sp.node else ""
+        lines.append(f"{'  ' * depth}{sp.name} [{sp.kind}] "
+                     f"{sp.dur_us / 1000:.3f}ms{node}"
+                     f"{(' ' + extra) if extra else ''}")
+        for c in children.get(sp.span_id, []):
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    return lines
+
+
+def chrome_trace(trace_id: int, spans: List[Span]) -> Dict[str, Any]:
+    """Chrome-trace / Perfetto JSON (`chrome://tracing` 'JSON Array' dialect
+    wrapped in an object): complete `X` events, one pid per recording node,
+    one tid row per shard/worker lane so mesh skew is visible at a glance."""
+    pids: Dict[str, int] = {}
+    events: List[Dict[str, Any]] = []
+    for sp in spans:
+        pid = pids.setdefault(sp.node or "local", len(pids) + 1)
+        tid = int(sp.attrs.get("shard", 0)) + 1 if "shard" in sp.attrs else 0
+        events.append({"name": sp.name, "cat": sp.kind or "span", "ph": "X",
+                       "ts": sp.start_us, "dur": max(sp.dur_us, 1.0),
+                       "pid": pid, "tid": tid,
+                       "args": {"span_id": sp.span_id,
+                                "parent_id": sp.parent_id, **sp.attrs}})
+    meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": node}} for node, pid in pids.items()]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+            "otherData": {"trace_id": str(trace_id)}}
+
+
+# thread-local active TraceContext: leaf recorders everywhere (fusion
+# dispatches, global_jit compiles, device-cache transfers, RPC clients) read
+# it; only the session (or the worker RPC handler) ever sets it.
+
+_ACTIVE = threading.local()
+
+
+def current() -> Optional[TraceContext]:
+    return getattr(_ACTIVE, "trace", None)
+
+
+@contextlib.contextmanager
+def activate(tc: Optional[TraceContext]):
+    prev = current()
+    _ACTIVE.trace = tc
+    try:
+        yield tc
+    finally:
+        _ACTIVE.trace = prev
+
+
 # -- per-query runtime statistics ---------------------------------------------
 
 
@@ -160,6 +395,10 @@ class QueryProfile:
     op_stats: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
     segments: List[SegmentSpan] = dataclasses.field(default_factory=list)
     trace: List[str] = dataclasses.field(default_factory=list)
+    # span tree (TraceContext.spans alias) when the query ran traced; includes
+    # grafted worker-side spans and compile/transfer telemetry events
+    spans: List[Span] = dataclasses.field(default_factory=list)
+    error: str = ""               # non-empty: the query FAILED mid-execution
 
     def to_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -185,10 +424,18 @@ class ProfileRing:
         with self._lock:
             return list(self._ring)
 
-    def get(self, trace_id: int) -> Optional[QueryProfile]:
+    def get(self, trace_id) -> Optional[QueryProfile]:
+        """Exact-id lookup.  Ids are node-prefixed (TraceIdAllocator), so a
+        ring shared between peer-coordinator tests can never serve node A's
+        profile for node B's id; numeric strings (the web console's raw path
+        segment) are accepted."""
+        try:
+            tid = int(trace_id)
+        except (TypeError, ValueError):
+            return None
         with self._lock:
             for p in self._ring:
-                if p.trace_id == trace_id:
+                if p.trace_id == tid:
                     return p
         return None
 

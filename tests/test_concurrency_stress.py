@@ -116,6 +116,28 @@ def query_worker(inst, stop, failures):
         s.close()
 
 
+class TestPlanDdlRace:
+    def test_scan_fields_survive_concurrent_drop_column(self, inst):
+        """Planning holds no MDL, so a DROP COLUMN can land between the
+        binder's read of the column list and a later fields() call on the
+        scan.  The bind-time ColumnMeta snapshot must keep the plan
+        self-consistent — pruning drops the unreferenced lane anyway.
+        (Deterministic replay of the storm's rarest interleaving.)"""
+        from galaxysql_tpu.plan import logical as L
+        s = Session(inst, schema="cs")
+        try:
+            s.execute("ALTER TABLE t ADD COLUMN x1 BIGINT DEFAULT 7")
+            tm = inst.catalog.table("cs", "t")
+            metas = list(tm.columns)
+            scan = L.Scan(tm, "t", [(f"t.{c.name}", c.name) for c in metas],
+                          col_meta={c.name: c for c in metas})
+            s.execute("ALTER TABLE t DROP COLUMN x1")
+            fields = scan.fields()  # must not raise UnknownColumnError
+            assert "t.x1" in [f[0] for f in fields]
+        finally:
+            s.close()
+
+
 class TestConcurrencyStress:
     def test_dml_rollback_ddl_query_storm(self, inst):
         oracle = {}

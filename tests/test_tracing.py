@@ -1,0 +1,492 @@
+"""Distributed span tracing: TraceContext span trees (operators, fused
+segments, MPP shard subtrees, worker-process graft), node-prefixed trace ids,
+the Histogram metric type, Chrome-trace export, error spans, and the
+tracing-off hot-path guard.
+
+The `tracing`-marked tests are the fast smoke target (`make trace-smoke`).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from galaxysql_tpu.exec import operators as ops
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.utils import tracing
+from galaxysql_tpu.utils.metrics import Histogram, MetricsRegistry
+
+
+def _spans_of(inst, trace_id):
+    p = inst.profiles.get(trace_id)
+    assert p is not None
+    return p.spans
+
+
+def _last_tid(s):
+    return int(s.last_trace[0].split()[-1])  # "trace-id N"
+
+
+def _assert_tree_closed(spans):
+    """Every non-root parent resolves INSIDE this query's own span set — a
+    span grafted from (or leaked to) another query would break closure."""
+    ids = {sp.span_id for sp in spans}
+    assert len(ids) == len(spans), "duplicate span ids"
+    roots = [sp for sp in spans if sp.parent_id == 0]
+    assert len(roots) == 1 and roots[0].kind == "query"
+    for sp in spans:
+        if sp.parent_id:
+            assert sp.parent_id in ids, (sp.name, sp.parent_id)
+
+
+# -- trace ids ----------------------------------------------------------------
+
+
+@pytest.mark.tracing
+class TestTraceIds:
+    def test_two_instances_never_collide(self):
+        a = tracing.TraceIdAllocator("cn-aaaa0001")
+        b = tracing.TraceIdAllocator("cn-bbbb0002")
+        ida = [a.next() for _ in range(100)]
+        idb = [b.next() for _ in range(100)]
+        assert not set(ida) & set(idb)
+        assert ida == sorted(ida) and idb == sorted(idb)  # monotonic per node
+        assert all(i > 0 for i in ida + idb)  # BIGINT-safe, truthy
+        assert tracing.trace_node_hash(ida[0]) == \
+            tracing.trace_node_hash(ida[-1])
+        assert tracing.trace_node_hash(ida[0]) != \
+            tracing.trace_node_hash(idb[0])
+
+    def test_profile_ring_lookup_by_string(self):
+        from galaxysql_tpu.utils.tracing import ProfileRing, QueryProfile
+        ring = ProfileRing()
+        ring.record(QueryProfile(trace_id=12345, sql="x", schema="s",
+                                 conn_id=1))
+        assert ring.get("12345").trace_id == 12345
+        assert ring.get("nonsense") is None
+        assert ring.get(999) is None
+
+
+# -- histogram metric ---------------------------------------------------------
+
+
+class TestHistogram:
+    def test_quantiles_and_reservoir(self):
+        h = Histogram("lat_ms", "latency", reservoir=256)
+        for v in range(1, 101):
+            h.observe(float(v))
+        assert h.count == 100 and h.sum == 5050.0
+        qs = h.quantiles()
+        assert 45 <= qs[0.5] <= 55
+        assert 90 <= qs[0.95] <= 100
+        assert 94 <= qs[0.99] <= 100
+        # reservoir stays bounded under heavy load
+        for v in range(10_000):
+            h.observe(float(v % 7))
+        assert len(h._buf) <= 256 and h.count == 10_100
+
+    def test_registry_rows_and_prometheus_summary(self):
+        reg = MetricsRegistry(namespace="t")
+        h = reg.histogram("query_latency_ms", "query latency")
+        for v in (1.0, 2.0, 3.0, 4.0):
+            h.observe(v)
+        names = {n for n, _k, _v, _h in reg.rows()}
+        assert {"query_latency_ms_p50", "query_latency_ms_p95",
+                "query_latency_ms_p99", "query_latency_ms_count",
+                "query_latency_ms_sum"} <= names
+        text = reg.prometheus_text()
+        assert "# TYPE t_query_latency_ms summary" in text
+        assert 't_query_latency_ms{quantile="0.5"}' in text
+        assert "t_query_latency_ms_count 4" in text
+        with pytest.raises(TypeError):
+            reg.counter("query_latency_ms")
+
+    def test_instance_exports_latency_quantiles(self):
+        inst = Instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE hq; USE hq; CREATE TABLE t (a BIGINT)")
+        s.execute("SELECT count(*) FROM t")
+        rows = {r[0] for r in s.execute("SHOW METRICS").rows}
+        assert "query_latency_ms_p95" in rows
+        assert "segment_wall_ms_p95" in rows
+        assert "rpc_rtt_ms_p95" in rows
+        s.close()
+
+
+# -- local span trees ---------------------------------------------------------
+
+
+@pytest.mark.tracing
+class TestLocalSpanTree:
+    @pytest.fixture(scope="class")
+    def session(self):
+        inst = Instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE tr")
+        s.execute("USE tr")
+        s.execute("CREATE TABLE t (a BIGINT PRIMARY KEY, b BIGINT)")
+        inst.store("tr", "t").insert_pylists(
+            {"a": list(range(5000)), "b": [i % 13 for i in range(5000)]},
+            inst.tso.next_timestamp())
+        yield s
+        s.close()
+
+    def test_traced_query_builds_nested_tree(self, session):
+        s = session
+        s.vars["ENABLE_QUERY_TRACING"] = True
+        try:
+            r = s.execute("SELECT a, b * 2 FROM t WHERE a < 500")
+        finally:
+            s.vars.pop("ENABLE_QUERY_TRACING", None)
+        assert len(r.rows) == 500
+        spans = _spans_of(s.instance, _last_tid(s))
+        _assert_tree_closed(spans)
+        by_kind = {}
+        for sp in spans:
+            by_kind.setdefault(sp.kind, []).append(sp)
+        root = by_kind["query"][0]
+        assert root.dur_us > 0 and root.attrs["schema"] == "tr"
+        # operator spans nest under the root (plan tree = span tree)
+        assert by_kind.get("operator"), [s.kind for s in spans]
+        ids = {sp.span_id: sp for sp in spans}
+        for op in by_kind["operator"]:
+            cur = op
+            while cur.parent_id:
+                cur = ids[cur.parent_id]
+            assert cur is root
+        # the fused filter>project dispatch is a CHILD span, not a flat list
+        segs = by_kind.get("segment", [])
+        assert any("filter" in sp.name for sp in segs)
+        assert all(sp.parent_id for sp in segs)
+        assert all(sp.node == s.instance.node_id for sp in spans)
+
+    def test_compile_events_attributed(self, session):
+        s = session
+        s.vars["ENABLE_QUERY_TRACING"] = True
+        try:
+            # a brand-new expression shape forces at least one fresh program
+            s.execute("SELECT a * 7 + 1, b - 2 FROM t WHERE a < 321")
+        finally:
+            s.vars.pop("ENABLE_QUERY_TRACING", None)
+        spans = _spans_of(s.instance, _last_tid(s))
+        compiles = [sp for sp in spans if sp.kind == "compile"]
+        assert compiles, [sp.kind for sp in spans]
+        assert all(sp.attrs.get("wall_ms", 0) >= 0 for sp in compiles)
+
+    def test_show_trace_renders_tree_then_clears(self, session):
+        s = session
+        s.vars["ENABLE_QUERY_TRACING"] = True
+        try:
+            s.execute("SELECT count(*) FROM t")
+        finally:
+            s.vars.pop("ENABLE_QUERY_TRACING", None)
+        lines = [r[0] for r in s.execute("SHOW TRACE").rows]
+        assert any(l.startswith("query [query]") for l in lines), lines
+        # tracing off again: the next query's SHOW TRACE has no stale tree
+        s.execute("SELECT count(*) FROM t")
+        lines = [r[0] for r in s.execute("SHOW TRACE").rows]
+        assert not any("[query]" in l for l in lines)
+
+    def test_query_spans_virtual_table(self, session):
+        s = session
+        s.vars["ENABLE_QUERY_TRACING"] = True
+        try:
+            s.execute("SELECT a FROM t WHERE a < 9")
+        finally:
+            s.vars.pop("ENABLE_QUERY_TRACING", None)
+        tid = _last_tid(s)
+        r = s.execute(
+            "SELECT span_name, kind, parent_id FROM "
+            f"information_schema.query_spans WHERE trace_id = {tid}")
+        kinds = {row[1] for row in r.rows}
+        assert "query" in kinds and "operator" in kinds
+        assert any(row[2] == 0 for row in r.rows)  # exactly the root
+
+    def test_chrome_trace_export_endpoint(self, session):
+        from galaxysql_tpu.server.web import WebConsole
+        s = session
+        web = WebConsole(s.instance)
+        port = web.start()
+        try:
+            s.vars["ENABLE_QUERY_TRACING"] = True
+            try:
+                s.execute("SELECT a, b FROM t WHERE b = 3")
+            finally:
+                s.vars.pop("ENABLE_QUERY_TRACING", None)
+            tid = _last_tid(s)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/trace/{tid}", timeout=10) as r:
+                d = json.loads(r.read())
+            assert d["otherData"]["trace_id"] == str(tid)
+            evs = [e for e in d["traceEvents"] if e["ph"] == "X"]
+            assert evs
+            for e in evs:
+                assert {"name", "cat", "ts", "dur", "pid", "tid"} <= set(e)
+            assert any(e["cat"] == "query" for e in evs)
+            # an untraced query's id 404s instead of returning an empty tree
+            s.execute("SELECT count(*) FROM t")
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/trace/{_last_tid(s)}",
+                    timeout=10)
+        finally:
+            web.stop()
+
+    def test_error_spans_and_slow_log(self, session):
+        from galaxysql_tpu.utils.tracing import SLOW_LOG
+        s = session
+        SLOW_LOG.clear()
+        s.execute("SET SLOW_SQL_MS = 0")
+        s.vars["ENABLE_QUERY_TRACING"] = True
+        try:
+            with pytest.raises(Exception):
+                s.execute("SELECT no_such_column FROM t")
+        finally:
+            s.vars.pop("ENABLE_QUERY_TRACING", None)
+            s.execute("SET SLOW_SQL_MS = -1")
+        p = s.instance.profiles.entries()[-1]
+        assert p.error.startswith("UnknownColumnError")
+        assert p.elapsed_ms >= 0
+        _assert_tree_closed(p.spans)  # the error span must NOT be a 2nd root
+        err_spans = [sp for sp in p.spans if sp.kind == "error"]
+        assert err_spans and err_spans[0].attrs["errno"] == 1054
+        assert err_spans[0].parent_id == p.spans[0].span_id
+        # SHOW SLOW explains the failure: elapsed recorded + error column
+        rows = s.execute("SHOW SLOW").rows
+        assert any(row[3] == p.trace_id and row[5] == "UnknownColumnError"
+                   for row in rows), rows
+        # SHOW TRACE shows the failed query's tree with the error span
+        lines = [r[0] for r in s.execute("SHOW TRACE").rows]
+        assert any("error" in l for l in lines)
+
+
+# -- concurrent sessions: no span cross-talk ----------------------------------
+
+
+@pytest.mark.tracing
+class TestConcurrentTracing:
+    def test_two_sessions_isolated_trees(self):
+        inst = Instance()
+        s0 = Session(inst)
+        s0.execute("CREATE DATABASE ctr; USE ctr")
+        s0.execute("CREATE TABLE big (a BIGINT, b BIGINT)")
+        s0.execute("CREATE TABLE small (a BIGINT, b BIGINT)")
+        inst.store("ctr", "big").insert_pylists(
+            {"a": list(range(3000)), "b": list(range(3000))},
+            inst.tso.next_timestamp())
+        inst.store("ctr", "small").insert_pylists(
+            {"a": list(range(700)), "b": list(range(700))},
+            inst.tso.next_timestamp())
+        results = {}
+        barrier = threading.Barrier(2)
+
+        def run(name, table, rounds=6):
+            s = Session(inst, "ctr")
+            s.vars["ENABLE_QUERY_TRACING"] = True
+            barrier.wait()
+            tids = []
+            for _ in range(rounds):
+                s.execute(f"SELECT a, b + 1 FROM {table} WHERE a >= 0")
+                tids.append(_last_tid(s))
+            results[name] = tids
+            s.close()
+
+        t1 = threading.Thread(target=run, args=("big", "big"))
+        t2 = threading.Thread(target=run, args=("small", "small"))
+        t1.start(); t2.start()
+        t1.join(); t2.join()
+        for name in ("big", "small"):
+            for tid in results[name]:
+                spans = _spans_of(inst, tid)
+                _assert_tree_closed(spans)
+                root = spans[0]
+                assert root.kind == "query"
+                assert name in root.attrs["sql"], (name, root.attrs)
+        s0.close()
+
+
+# -- MPP: one span subtree per shard ------------------------------------------
+
+
+@pytest.mark.tracing
+class TestMppShardSpans:
+    def test_stage_tree_with_shard_children(self):
+        inst = Instance()
+        if inst.mesh() is None:
+            pytest.skip("single device: no MPP mesh")
+        S = inst.mesh().shape["shard"]
+        s = Session(inst)
+        s.execute("CREATE DATABASE mtr; USE mtr")
+        s.execute("CREATE TABLE big (k VARCHAR(4), v BIGINT)")
+        rng = np.random.default_rng(0)
+        inst.store("mtr", "big").insert_arrays(
+            {"k": np.array(["x", "y", "z"])[rng.integers(0, 3, 60_000)],
+             "v": rng.integers(0, 1000, 60_000)}, inst.tso.next_timestamp())
+        s.execute("ANALYZE TABLE big")
+        s.vars["MPP_MIN_AP_ROWS"] = 1000
+        s.vars["ENABLE_QUERY_TRACING"] = True
+        r = s.execute("SELECT k, sum(v) FROM big GROUP BY k ORDER BY k")
+        assert len(r.rows) == 3
+        p = inst.profiles.entries()[-1]
+        assert p.engine == "mpp"
+        _assert_tree_closed(p.spans)
+        stages = [sp for sp in p.spans if sp.kind == "stage"]
+        assert any(sp.name == "mpp:Scan" for sp in stages), \
+            [sp.name for sp in stages]
+        scan = next(sp for sp in stages if sp.name == "mpp:Scan")
+        shards = [sp for sp in p.spans
+                  if sp.kind == "shard" and sp.parent_id == scan.span_id]
+        assert len(shards) == S
+        assert sum(sp.attrs["rows"] for sp in shards) == 60_000
+        # chrome export: one tid row per shard
+        ct = tracing.chrome_trace(p.trace_id, p.spans)
+        tids = {e["tid"] for e in ct["traceEvents"]
+                if e.get("cat") == "shard"}
+        assert len(tids) == S
+        s.close()
+
+
+# -- worker process: grafted spans --------------------------------------------
+
+
+INIT_SQL = (
+    "CREATE DATABASE w; USE w; "
+    "CREATE TABLE dim (k BIGINT PRIMARY KEY, label VARCHAR(16)); "
+    "INSERT INTO dim VALUES (1,'alpha'), (2,'beta'), (3,'gamma'), (4,'delta')"
+)
+
+
+@pytest.mark.tracing
+class TestWorkerSpanGraft:
+    @pytest.fixture(scope="class")
+    def worker_session(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "galaxysql_tpu.net.worker", "--port", "0",
+             "--platform", "cpu", "--init-sql", INIT_SQL],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            text=True)
+        line = p.stdout.readline()
+        if not line.startswith("WORKER_READY"):
+            err = p.stderr.read()[-3000:] if p.stderr else ""
+            raise AssertionError(f"worker failed to start: {line!r}\n{err}")
+        port = int(line.split()[1])
+        inst = Instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE w")
+        s.execute("USE w")
+        inst.attach_remote_table("w", "dim", "127.0.0.1", port)
+        yield s
+        s.close()
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+
+    def test_worker_spans_graft_under_rpc_span(self, worker_session):
+        s = worker_session
+        s.vars["ENABLE_QUERY_TRACING"] = True
+        try:
+            r = s.execute("SELECT k, label FROM dim ORDER BY k")
+        finally:
+            s.vars.pop("ENABLE_QUERY_TRACING", None)
+        assert len(r.rows) == 4
+        spans = _spans_of(s.instance, _last_tid(s))
+        _assert_tree_closed(spans)  # ONE tree: graft remints ids + parents
+        coord = s.instance.node_id
+        worker_spans = [sp for sp in spans if sp.node and sp.node != coord]
+        assert worker_spans, "no grafted worker-side spans"
+        rpc = [sp for sp in spans if sp.kind == "rpc"]
+        assert rpc and rpc[0].attrs.get("worker_spans", 0) >= 1
+        assert "clock_offset_us" in rpc[0].attrs
+        # the worker's subtree nests under the coordinator's rpc span
+        ids = {sp.span_id: sp for sp in spans}
+        rpc_ids = {sp.span_id for sp in rpc}
+        for sp in worker_spans:
+            cur = sp
+            seen_rpc = False
+            while cur.parent_id:
+                cur = ids[cur.parent_id]
+                if cur.span_id in rpc_ids:
+                    seen_rpc = True
+            assert seen_rpc, (sp.name, sp.node)
+        # the fragment executed worker-side: scan + serialize child spans
+        names = {sp.name for sp in worker_spans}
+        assert any(n.startswith("worker:") for n in names), names
+        assert "scan" in names and "serialize" in names, names
+        # clock correction keeps worker spans inside the query's envelope
+        root = spans[0]
+        for sp in worker_spans:
+            assert sp.start_us >= root.start_us - 1_000_000
+            assert sp.start_us <= root.start_us + root.dur_us + 1_000_000
+
+
+# -- tracing off: bit-identical results, unchanged dispatch count -------------
+
+
+@pytest.mark.tracing
+@pytest.mark.slow
+class TestTracingEquivalenceTpchQ5:
+    def test_q5_traced_vs_untraced_bit_identical(self):
+        from galaxysql_tpu.storage import tpch
+        from galaxysql_tpu.storage.tpch_queries import QUERIES
+        data = tpch.generate(0.01)
+        inst = Instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE tpch")
+        s.execute("USE tpch")
+        for t in tpch.TABLE_ORDER:
+            s.execute(tpch.TPCH_DDL[t])
+            inst.store("tpch", t).insert_pylists(
+                data[t], inst.tso.next_timestamp())
+        s.execute("ANALYZE TABLE " + ", ".join(tpch.TABLE_ORDER))
+        plain = s.execute(QUERIES[5])
+        # drop the compiled-program cache so the traced run pays (and records)
+        # fresh trace+compile events — the compile-attribution acceptance shape
+        with ops._JIT_CACHE_LOCK:
+            ops._JIT_CACHE.clear()
+        s.vars["ENABLE_QUERY_TRACING"] = True
+        traced = s.execute(QUERIES[5])
+        tid = _last_tid(s)
+        s.vars.pop("ENABLE_QUERY_TRACING", None)
+        assert traced.rows == plain.rows  # bit-identical, not approximate
+        spans = _spans_of(inst, tid)
+        _assert_tree_closed(spans)
+        assert any(sp.kind == "operator" for sp in spans)
+        assert any(sp.kind == "compile" for sp in spans), \
+            sorted({sp.kind for sp in spans})
+        json.dumps(tracing.chrome_trace(tid, spans))  # well-formed export
+        # hot-path guard: a traced run must not perturb the untraced steady
+        # state (same programs, same dispatch count, no stats variants)
+        s.execute(QUERIES[5])  # settle
+        ops.reset_dispatch_stats()
+        s.execute(QUERIES[5])
+        baseline = ops.DISPATCH_STATS["dispatches"]
+        s.vars["ENABLE_QUERY_TRACING"] = True
+        s.execute(QUERIES[5])
+        s.vars.pop("ENABLE_QUERY_TRACING", None)
+        ops.reset_dispatch_stats()
+        s.execute(QUERIES[5])
+        assert ops.DISPATCH_STATS["dispatches"] == baseline
+        s.close()
+
+
+@pytest.mark.tracing
+class TestTracingOffFastPath:
+    def test_no_trace_context_when_disabled(self):
+        inst = Instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE off; USE off; CREATE TABLE t (a BIGINT)")
+        inst.store("off", "t").insert_pylists(
+            {"a": list(range(100))}, inst.tso.next_timestamp())
+        s.execute("SELECT count(*) FROM t")
+        p = inst.profiles.entries()[-1]
+        assert p.spans == [] and not p.error
+        assert tracing.current() is None
+        s.close()
