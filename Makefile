@@ -44,4 +44,13 @@ trace-smoke:
 bench:
 	$(PY) bench.py
 
-.PHONY: tier1 fusion-smoke obs-smoke rf-smoke cache-smoke trace-smoke bench
+# fast batching smoke: the batching marker suite (batched vs sequential
+# bit-identical results under 100+ concurrent sessions, poisoned-key error
+# isolation, snapshot/txn bypass edges, static-bucket retrace guard) plus the
+# closed-loop multi-session serving bench (QPS/chip + p99, batching on vs off)
+batch-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m batching -p no:cacheprovider
+	JAX_PLATFORMS=cpu BENCH_BATCH_SESSIONS=100,1000 $(PY) bench.py --batch-only
+
+.PHONY: tier1 fusion-smoke obs-smoke rf-smoke cache-smoke trace-smoke bench \
+	batch-smoke

@@ -45,6 +45,17 @@ ENABLE_FRAGMENT_CACHE = _p("ENABLE_FRAGMENT_CACHE", True,
                            "cross-query fragment cache: hash-join build "
                            "reuse, deterministic subplan results, cached "
                            "runtime filters")
+ENABLE_BATCH_SCHEDULER = _p("ENABLE_BATCH_SCHEDULER", True,
+                            "coalesce plan-cache-identical point reads from "
+                            "concurrent sessions into one vectorized batch "
+                            "dispatch (server/batch_scheduler.py)")
+BATCH_WINDOW_US = _p("BATCH_WINDOW_US", 0,
+                     "fixed batch collection window in microseconds "
+                     "(0 = adaptive 100-500us, gated on live point-query "
+                     "concurrency; sequential traffic pays nothing)")
+BATCH_MAX_GROUP = _p("BATCH_MAX_GROUP", 1024,
+                     "max point queries coalesced per batch group "
+                     "(clamped to the static key-bucket ladder cap)")
 
 # --- plan cache / optimizer --------------------------------------------------
 PLAN_CACHE = _p("PLAN_CACHE", True, "enable parameterized plan cache")

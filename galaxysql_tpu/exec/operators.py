@@ -176,6 +176,236 @@ def lifted_keys(lift, exprs: Sequence[ir.Expr]):
     return tuple(keys)
 
 
+# -- cross-session batched point lookup (server/batch_scheduler.py) -----------
+#
+# The mega-batched TP serving path: B parameter keys from concurrent sessions
+# stack into ONE runtime argument of one jitted program per partition, instead
+# of B separate index probes each paying its own dispatch + Python machinery
+# (the Tailwind launch/transfer amortization case).  Programs key on STATIC
+# batch-bucket sizes (`_BATCH_KEY_BUCKETS`) and the capacity-ladder-padded
+# partition size, so steady-state traffic never retraces — only a genuinely
+# new (bucket, capacity, dtype) shape compiles.
+
+_BATCH_KEY_BUCKETS = (1, 4, 16, 64, 256, 1024)
+BATCH_MAX_KEYS = _BATCH_KEY_BUCKETS[-1]
+BATCH_MAXDUP = 8  # in-program cap on physical versions per key (overflow -> host)
+
+
+def batch_key_bucket(n: int) -> int:
+    """Smallest static key-batch bucket holding n keys (jit-shape ladder)."""
+    for b in _BATCH_KEY_BUCKETS:
+        if n <= b:
+            return b
+    return BATCH_MAX_KEYS
+
+
+def _lane_pad_value(dtype: np.dtype):
+    """A sort-order-maximal pad for sorted key lanes (pads never match a real
+    searchsorted window because their MVCC stamps mark them dead anyway)."""
+    if np.issubdtype(dtype, np.floating):
+        return np.inf
+    return np.iinfo(dtype).max
+
+
+def _batched_point_program(B: int, cap: int, maxdup: int, dtype_str: str):
+    """One jitted program: B keys against a capacity-padded sorted key lane.
+
+    Inputs (all runtime args — values never bake into the trace):
+      skeys[cap]  sorted key lane, padded with the dtype max
+      sbegin[cap] begin_ts permuted to sorted order; NULL-key rows and pads
+                  carry -1 (never visible)
+      send[cap]   end_ts permuted to sorted order, pads 0 (dead)
+      keys[B]     the stacked parameter keys (pad slots ignored by the host)
+      snap, txn   0-d int64 arrays (abstract scalars: no per-value retrace)
+    Returns (pos[B, maxdup], overflow[B]): visible sorted-domain positions
+    (-1 = none) in ascending row order per key, and a per-key flag when the
+    equal-key window exceeded maxdup (host falls back for that key only)."""
+    def build():
+        def prog(skeys, sbegin, send, keys, snap, txn):
+            lo = jnp.searchsorted(skeys, keys, side="left")
+            hi = jnp.searchsorted(skeys, keys, side="right")
+            pos = lo[:, None] + jnp.arange(maxdup)[None, :]
+            in_rng = pos < hi[:, None]
+            posc = jnp.minimum(pos, cap - 1)
+            b = sbegin[posc]
+            e = send[posc]
+            # mirror native.visible_mask: committed-and-past-snapshot insert,
+            # minus committed-and-past-snapshot delete, plus own provisional
+            ins = ((b >= 0) & (b <= snap)) | (b == -txn)
+            dele = ((e >= 0) & (e <= snap)) | (e == -txn)
+            vis = in_rng & ins & ~dele
+            return jnp.where(vis, posc, -1), (hi - lo) > maxdup
+        return jax.jit(prog)
+    return global_jit(("batch_point", dtype_str, B, cap, maxdup), build)
+
+
+def _tail_windows(lane, n0: int, n: int, keys):
+    """Sorted probe of the unsorted appended tail rows [n0, n): returns
+    (torder, tlo, thi) — torder[tlo[i]:thi[i]] + n0 are key i's candidate
+    row ids, in ascending row order (stable argsort).  Shared by the host
+    and device batched-point paths so their tail handling stays
+    bit-identical."""
+    tail = lane[n0:n]
+    torder = np.argsort(tail, kind="stable")
+    tsorted = tail[torder]
+    tlo = np.searchsorted(tsorted, keys, side="left")
+    thi = np.searchsorted(tsorted, keys, side="right")
+    return torder, tlo, thi
+
+
+def _host_batched_point(part, col: str, lane_vals, snap: int, txn_id: int
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """XLA:CPU formulation of the batched point lookup: one vectorized numpy
+    sweep over the sorted key index for ALL keys (same backend-adaptive
+    doctrine as `kernels.relational.prefer_scatter` — on CPU the per-call jax
+    dispatch costs more than the whole probe).  Caller holds `part.lock`.
+    Bit-identical CSR to the device program path."""
+    from galaxysql_tpu import native
+    k = len(lane_vals)
+    n = part.num_rows
+    lane = part.lanes[col]
+    valid = part.valid[col]
+    begin, end = part.begin_ts, part.end_ts
+    n0, perm, skeys = part.key_index(col)
+    keys = np.asarray(lane_vals).astype(lane.dtype)
+    lo = np.searchsorted(skeys, keys, side="left")
+    hi = np.searchsorted(skeys, keys, side="right")
+    if n > n0:
+        # unsorted appended tail: extend each key's candidate set
+        torder, tlo, thi = _tail_windows(lane, n0, n, keys)
+    else:
+        tlo = thi = np.zeros(k, dtype=np.int64)
+    reps = (hi - lo) + (thi - tlo)
+    total = int(reps.sum())
+    offsets = np.zeros(k + 1, dtype=np.int64)
+    if total == 0:
+        return np.zeros(0, dtype=np.int64), offsets
+    # flatten every key's sorted-window (+ tail-window) positions in one shot:
+    # within a key, index-window ids (ascending rows) come first, tail ids
+    # (all >= n0) after — exactly key_candidates' ordering
+    per_key = []
+    for i in range(k):
+        ids = perm[lo[i]:hi[i]]
+        if thi[i] > tlo[i]:
+            tids = torder[tlo[i]:thi[i]] + n0
+            ids = np.concatenate([ids, tids]) if ids.size else tids
+        per_key.append(ids)
+    flat = np.concatenate(per_key)
+    keep = valid[flat] & native.visible_mask(begin[flat], end[flat],
+                                             snap, txn_id)
+    key_of = np.repeat(np.arange(k), reps)[keep]
+    np.cumsum(np.bincount(key_of, minlength=k), out=offsets[1:])
+    return flat[keep], offsets
+
+
+def batched_point_lookup(store, pid: int, part, col: str, version: int,
+                         lane_vals, snap: int, txn_id: int = 0,
+                         device_cache=None, force_device: bool = False
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """Visible row ids of `col == v` for a stack of keys against one
+    partition, resolved by ONE jitted dispatch over the sorted key index
+    (device backends), or one vectorized host sweep (XLA:CPU, where the
+    dispatch itself would dominate — `force_device` pins the program path).
+
+    Returns CSR (ids, offsets): ids[offsets[i]:offsets[i+1]] are key i's
+    matching row ids, ascending — bit-identical to the sequential
+    key_candidates + validity + visible_mask path.  The capacity-padded
+    sorted artifacts (keys / permuted MVCC stamps) are version-keyed through
+    `device_cache` (the DeviceCache lane budget) so steady-state flushes ship
+    only the B keys; the unsorted appended tail and >BATCH_MAXDUP version
+    pileups are probed host-side per flush."""
+    from galaxysql_tpu import native
+    k = len(lane_vals)
+    with part.lock:
+        if not force_device and jax.default_backend() == "cpu":
+            return _host_batched_point(part, col, lane_vals, snap, txn_id)
+        n = part.num_rows
+        lane = part.lanes[col]
+        valid = part.valid[col]
+        begin, end = part.begin_ts, part.end_ts
+        n0, perm, skeys = part.key_index(col)
+        cap = bucket_capacity(max(n0, 1))
+        B = batch_key_bucket(k)
+        pad = _lane_pad_value(lane.dtype)
+        keys = np.full(B, pad, dtype=lane.dtype)
+        keys[:k] = np.asarray(lane_vals).astype(lane.dtype)
+
+        def _pad(arr, fill):
+            if arr.shape[0] == cap:
+                return arr
+            out = np.full(cap, fill, dtype=arr.dtype)
+            out[:arr.shape[0]] = arr
+            return out
+
+        def build_keys():
+            return _pad(skeys, pad)
+
+        def build_begin():
+            # NULL key slots fold into the begin stamp (-1 = never visible):
+            # the sequential path's part.valid[col] filter, one array early
+            return _pad(np.where(valid[:n0][perm], begin[:n0][perm],
+                                 np.int64(-1)), np.int64(-1))
+
+        def build_end():
+            return _pad(end[:n0][perm], np.int64(0))
+
+        if device_cache is not None:
+            # the cached artifacts are materializations of THIS sorted-index
+            # build, so the key must carry the index identity (lane_gen, n0)
+            # as well as the table version: key_index() can rebuild with a
+            # larger n0 within one version (tail growth past _INDEX_TAIL
+            # mid-statement), and a (version, cap)-only hit would then map
+            # stale sorted positions through the fresh perm — wrong rows
+            sig = f"{col}::{part.lane_gen}.{n0}"
+            dk = device_cache.get_lane_built(store, pid, f"bp_keys::{sig}",
+                                             version, cap, build_keys)
+            db = device_cache.get_lane_built(store, pid, f"bp_begin::{sig}",
+                                             version, cap, build_begin)
+            de = device_cache.get_lane_built(store, pid, f"bp_end::{sig}",
+                                             version, cap, build_end)
+        else:
+            dk, db, de = build_keys(), build_begin(), build_end()
+        prog = _batched_point_program(B, cap, BATCH_MAXDUP, str(lane.dtype))
+        DISPATCH_STATS["dispatches"] += 1
+        pos, overflow = prog(dk, db, de, keys,
+                             np.int64(snap), np.int64(txn_id))
+        pos = np.asarray(pos)[:k]
+        overflow = np.asarray(overflow)[:k]
+
+        # fast path: no appended tail, no version-pileup overflow — flatten
+        # the position matrix in one shot (row-major keeps per-key ascending)
+        mask = pos >= 0
+        counts = mask.sum(axis=1)
+        if n == n0 and not overflow.any():
+            offsets = np.zeros(k + 1, dtype=np.int64)
+            np.cumsum(counts, out=offsets[1:])
+            return perm[pos[mask]], offsets
+
+        per_key: List[np.ndarray] = [perm[row[row >= 0]] for row in pos]
+        if n > n0:
+            # unsorted appended tail: one vectorized sorted probe for all keys
+            torder, tlo, thi = _tail_windows(lane, n0, n, keys[:k])
+            for i in np.nonzero(thi > tlo)[0]:
+                tids = torder[tlo[i]:thi[i]] + n0
+                keep = valid[tids] & native.visible_mask(
+                    begin[tids], end[tids], snap, txn_id)
+                tids = tids[keep]
+                if tids.size:
+                    per_key[i] = np.concatenate([per_key[i], tids]) \
+                        if per_key[i].size else tids
+        for i in np.nonzero(overflow)[0]:
+            # >BATCH_MAXDUP physical versions: exact host probe for this key
+            ids = part.key_candidates(col, lane_vals[i])
+            keep = valid[ids] & native.visible_mask(begin[ids], end[ids],
+                                                    snap, txn_id)
+            per_key[i] = ids[keep]
+        offsets = np.zeros(k + 1, dtype=np.int64)
+        np.cumsum(np.asarray([a.size for a in per_key]), out=offsets[1:])
+        flat = (np.concatenate(per_key) if offsets[-1]
+                else np.zeros(0, dtype=np.int64))
+        return flat, offsets
+
+
 def _is_host_batch(b: ColumnBatch) -> bool:
     """True when every lane is host numpy (TP scans yield these): small point
     queries then run the np expression backend directly — per-call jax dispatch

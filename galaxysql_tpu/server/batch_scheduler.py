@@ -1,0 +1,558 @@
+"""Cross-session mega-batched TP serving: the point-query batching scheduler.
+
+At millions-of-users scale the TP ceiling is dispatches/sec, not single-query
+latency: every session dispatching its own program serializes on the Python
+machinery and the device launch path.  This scheduler coalesces sessions
+executing the SAME parameterized point statement (plan-cache identity:
+`ParameterizedSql.cache_key` + the registered PointPlan) inside a short
+collection window into ONE vectorized lookup — parameter keys stacked as a
+batched runtime argument of a single jitted program per partition
+(`exec/operators.batched_point_lookup`), results gathered once and scattered
+back per session.  The Tailwind case (PAPERS.md): amortize launch + transfer
+across requests.
+
+Protocol (leader/follower, no dedicated threads):
+
+- `submit()` under the scheduler lock either JOINS an open group for the
+  statement (follower: parks on a per-request event) or OPENS one (leader).
+- The leader sleeps the collection window — adaptive: the window opens only
+  when several point queries are IN FLIGHT right now (sequential traffic
+  sees window 0 and falls straight back to the unbatched fast path, zero
+  added latency) and sizes itself by the observed arrival rate toward
+  `MAX_WINDOW_S` so saturated traffic approaches the max bucket — then
+  seals the group, executes it, scatters rows/errors, and wakes followers.
+- A group that fills to the max static bucket (1024, the
+  `exec/operators._BATCH_KEY_BUCKETS` ladder cap) seals early.
+
+Correctness envelope:
+
+- Snapshot semantics: autocommit sessions share ONE flush-time TSO (all
+  members linearize at the flush instant — they were concurrent); sessions
+  inside a read-only transaction group only with sessions pinned to the SAME
+  snapshot (the group key carries `pinned_ts`); sessions whose transaction
+  holds writes (local, GSI, or remote branches) BYPASS batching entirely —
+  their provisional stamps need the own-txn visibility path.
+- Error isolation: a poisoned key fails only its own session(s); any
+  group-scope failure falls every member back to the sequential path, where
+  errors surface with per-session attribution.
+- Plan validity: the group key carries the catalog schema_version; a DDL
+  between submit and flush fails the version re-check and falls back.  The
+  flush itself holds shared MDL on the table, like every other read path.
+
+Escape hatches (the fusion/fragment-cache hatch trio): `BATCH(OFF)` hint
+(hinted statements never register PointPlans, so they take the planned path
+by construction), `GALAXYSQL_BATCHING=0` env, `ENABLE_BATCH_SCHEDULER`
+config param.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from galaxysql_tpu.utils.failpoint import FAIL_POINTS, FP_BATCH_POISON_KEY, \
+    FailPointError
+
+# kill switch: GALAXYSQL_BATCHING=0 disables the whole subsystem (every point
+# query runs the sequential fast path, exactly the pre-batching engine)
+ENABLED = os.environ.get("GALAXYSQL_BATCHING", "1") != "0"
+
+_BATCH_MAX_KEYS: Optional[int] = None  # lazy mirror of operators.BATCH_MAX_KEYS
+
+
+def _close_pool(pool):
+    """weakref.finalize target: must not reference the scheduler itself."""
+    pool.close()
+
+
+@dataclasses.dataclass
+class BatchRequest:
+    """One session's slot in a group; the leader fills rows/error/fallback."""
+
+    lane_val: Any
+    t0: float
+    prof: Any = None  # the session's QueryProfile: leader bulk-finishes it
+    event: threading.Event = dataclasses.field(default_factory=threading.Event)
+    rows: Optional[List[tuple]] = None
+    error: Optional[BaseException] = None
+    fallback: bool = False
+    group_size: int = 0
+    wait_us: float = 0.0
+
+
+class _Group:
+    __slots__ = ("gkey", "pp", "pinned_ts", "requests", "t0", "full",
+                 "sealed", "target")
+
+    def __init__(self, gkey, pp, pinned_ts, t0, target=None):
+        self.gkey = gkey
+        self.pp = pp
+        self.pinned_ts = pinned_ts
+        self.requests: List[BatchRequest] = []
+        self.t0 = t0
+        self.full = threading.Event()
+        self.sealed = False
+        # adaptive mode: the in-flight demand at open time — once this many
+        # members joined, all known demand has arrived and the group seals
+        # without waiting out the window (None = pinned-window mode)
+        self.target = target
+
+
+class BatchScheduler:
+    """Per-Instance scheduler; sessions reach it via `_try_batched_point`."""
+
+    MIN_WINDOW_S = 100e-6
+    MAX_WINDOW_S = 500e-6
+    # adaptive collection extends past one window quantum WHILE members keep
+    # arriving (follower wake->resubmit is serialized by the interpreter, so
+    # a mega-group trickles in over several quanta); this caps the total
+    # collection time of any one group.  Group-commit pacing (below) is what
+    # actually sizes saturated groups; this guards open-loop trickle and
+    # bounds the wait a member can be asked to pay.
+    MAX_COLLECT_S = 25e-3
+    # below this many point queries in flight RIGHT NOW, batching cannot pay
+    # for its wait: the window collapses to 0 and sequential/low-concurrency
+    # traffic keeps its p50.  (Arrival RATE is the wrong gate: a saturated
+    # sequential path caps the observed rate at its own ceiling, so a
+    # rate-gated window never opens exactly when batching would help most.)
+    MIN_INFLIGHT = 4
+    TARGET_GROUP = 256  # window sizes itself to collect about this many
+
+    def __init__(self, instance):
+        self.instance = instance
+        self._lock = threading.Lock()
+        self._groups: Dict[Tuple, _Group] = {}
+        # group-commit pacing: gkey -> done-event of the flush in progress.
+        # While a statement's flush drains, its NEXT group keeps collecting
+        # (the new leader parks on this event instead of spending only a
+        # microsecond window), so saturated group sizes approach the live
+        # session count instead of the handful that arrive in one window.
+        self._flush_done: Dict[Tuple, threading.Event] = {}
+        # concurrency gate: point-path executions in flight right now
+        # (sessions bracket the WHOLE point path with point_begin/point_end).
+        # A deque-of-tokens, NOT an int-under-a-lock: deque append/pop are
+        # single C-level (GIL-atomic) ops, so the two-per-query bracket never
+        # parks a thread — a shared lock here convoys at high session counts.
+        self._inflight_tokens: collections.deque = collections.deque()
+        # EWMA of submit inter-arrival gap (seconds); starts "slow" so the
+        # first queries of a burst lead unbatched while the estimate catches up
+        self._interval_ewma = 1.0
+        self._last_arrival = time.perf_counter()
+        self._window_open_s = 0.0
+        self._born = time.perf_counter()
+        m = instance.metrics
+        self.batched = m.counter(
+            "batched_queries", "point queries served by a batch group")
+        self.flushes = m.counter(
+            "batch_flushes", "batch group executions (vectorized flushes)")
+        self.fallbacks = m.counter(
+            "batch_fallbacks", "batch members returned to the sequential path")
+        self.singletons = m.counter(
+            "batch_singletons", "groups flushed with a single member")
+        # flush scratch rides the global memory pool: pressure sheds batch
+        # work (fallback to sequential) before queries spill.  Instances have
+        # no teardown, so a finalizer detaches the child pool on GC (same
+        # pattern as FragmentCache's pool child).
+        import weakref
+        from galaxysql_tpu.exec.memory import GLOBAL_POOL
+        self.pool = GLOBAL_POOL.child("batch-scheduler", 256 << 20)
+        weakref.finalize(self, _close_pool, self.pool)
+
+    # -- gating ----------------------------------------------------------------
+
+    def enabled(self, session) -> bool:
+        return ENABLED and bool(self.instance.config.get(
+            "ENABLE_BATCH_SCHEDULER", session.vars))
+
+    def _max_group(self) -> int:
+        global _BATCH_MAX_KEYS
+        if _BATCH_MAX_KEYS is None:  # deferred: operators pulls in jax
+            from galaxysql_tpu.exec.operators import BATCH_MAX_KEYS
+            _BATCH_MAX_KEYS = BATCH_MAX_KEYS
+        cfg = self.instance.config.get("BATCH_MAX_GROUP") or _BATCH_MAX_KEYS
+        return max(1, min(int(cfg), _BATCH_MAX_KEYS))
+
+    def point_begin(self):
+        """Sessions bracket the whole point path (batched OR sequential) so
+        `_window_s` sees true point-query concurrency, the signal batching
+        amortizes over."""
+        self._inflight_tokens.append(None)
+
+    def point_end(self):
+        try:
+            self._inflight_tokens.pop()
+        except IndexError:  # pragma: no cover - bracket imbalance guard
+            pass
+
+    @property
+    def _inflight(self) -> int:
+        return len(self._inflight_tokens)
+
+    def _window_s(self) -> float:
+        """Collection window for a group opening NOW (caller holds the lock).
+
+        `BATCH_WINDOW_US` > 0 pins it (deterministic tests); otherwise the
+        window opens only when >= MIN_INFLIGHT point queries are in flight
+        (concurrency IS the amortizable demand — sequential traffic pays
+        nothing), sized to collect ~TARGET_GROUP keys at the observed
+        arrival rate, clamped to [MIN_WINDOW_S, MAX_WINDOW_S]."""
+        fixed = self.instance.config.get("BATCH_WINDOW_US")
+        if fixed:
+            return float(fixed) / 1e6
+        if self._inflight < self.MIN_INFLIGHT:
+            return 0.0
+        return min(max(self.TARGET_GROUP * self._interval_ewma,
+                       self.MIN_WINDOW_S), self.MAX_WINDOW_S)
+
+    def current_window_us(self) -> float:
+        with self._lock:
+            return self._window_s() * 1e6
+
+    # -- submit/wait -----------------------------------------------------------
+
+    def submit(self, gkey: Tuple, pp: dict, lane_val,
+               pinned_ts: Optional[int], prof=None) -> Optional[BatchRequest]:
+        """Join or open the statement's batch group; block until the group
+        flushes.  Returns the caller's filled BatchRequest, or None when the
+        caller must run the sequential path itself (window closed, singleton
+        group, or group-scope fallback)."""
+        now = time.perf_counter()
+        # arrival-gap EWMA OUTSIDE the lock: benign read/write races on a
+        # heuristic are a fair trade for the shortest possible critical
+        # section on the single most contended lock in the serving loop.
+        # (clamp idle gaps so one quiet second doesn't need hundreds of
+        # arrivals to re-open the window when a burst lands)
+        gap = now - self._last_arrival
+        self._last_arrival = now
+        self._interval_ewma += 0.2 * (min(gap, 0.05) - self._interval_ewma)
+        cap = self._max_group()
+        with self._lock:
+            g = self._groups.get(gkey)
+            if g is not None and not g.sealed:
+                req = BatchRequest(lane_val, now, prof)
+                g.requests.append(req)
+                if len(g.requests) >= cap or (
+                        g.target is not None and
+                        len(g.requests) >= g.target):
+                    g.sealed = True
+                    g.full.set()
+                leader = False
+            else:
+                window = self._window_s()
+                if window <= 0.0:
+                    return None
+                fixed = bool(self.instance.config.get("BATCH_WINDOW_US"))
+                # adaptive: all in-flight point queries are potential members
+                target = None if fixed else min(max(self._inflight, 2), cap)
+                g = _Group(gkey, pp, pinned_ts, now, target)
+                req = BatchRequest(lane_val, now, prof)
+                g.requests.append(req)
+                self._groups[gkey] = g
+                prev_done = self._flush_done.get(gkey)
+                leader = True
+        if not leader:
+            if not req.event.wait(timeout=5.0):
+                with self._lock:
+                    if not g.sealed:
+                        # leader vanished pre-seal (should not happen): the
+                        # sequential path is always correct — WITHDRAW so the
+                        # leader, were it to wake, never double-finishes our
+                        # profile after the sequential path records it; retire
+                        # the zombie group so new arrivals elect a fresh
+                        # leader instead of parking behind the dead one (a
+                        # woken old leader's `is g` guard tolerates the pop).
+                        # Keyed on g.sealed, NOT dict identity: a peer
+                        # follower's withdrawal may already have popped the
+                        # group, and the second timed-out member must still
+                        # withdraw rather than fall into the untimed wait
+                        try:
+                            g.requests.remove(req)
+                        except ValueError:  # pragma: no cover
+                            pass
+                        if self._groups.get(gkey) is g:
+                            self._groups.pop(gkey)
+                        return None
+                # sealed: the leader owns this request and its finally-block
+                # guarantees scatter + wake — a first flush of a new bucket
+                # shape can sit in XLA compile past the safety-net timeout
+                req.event.wait()
+            return None if req.fallback else req
+        # -- leader: collect, seal, execute, scatter ---------------------------
+        # Group-commit pacing: while the statement's PREVIOUS flush drains,
+        # this group just collects (members join under the lock above) — the
+        # classic group-commit shape, batch size ~ arrivals per flush.  Then
+        # pinned mode waits the window out; adaptive mode waits in window
+        # quanta and keeps collecting WHILE members arrive (their wake-ups
+        # are interpreter-serialized), sealing early once the open-time
+        # in-flight demand has all joined, hard-capped at MAX_COLLECT_S.
+        deadline = g.t0 + (window if g.target is None else self.MAX_COLLECT_S)
+        if prev_done is not None and g.target is not None:
+            prev_done.wait(self.MAX_COLLECT_S)
+        joined = 1
+        while not g.full.wait(window):
+            n_now = len(g.requests)  # racy read; the seal below is exact
+            if g.target is None or n_now <= joined or \
+                    time.perf_counter() >= deadline:
+                break  # pinned window spent, arrivals stalled, or hard cap
+            joined = n_now
+        flush_t = time.perf_counter()
+        done = threading.Event()
+        with self._lock:
+            g.sealed = True
+            if self._groups.get(gkey) is g:
+                self._groups.pop(gkey)
+            reqs = list(g.requests)
+            self._window_open_s += flush_t - g.t0
+            if len(reqs) > 1:
+                self._flush_done[gkey] = done
+        try:
+            if len(reqs) == 1:
+                self.singletons.inc()
+                req.fallback = True
+            else:
+                self._execute(gkey, pp, pinned_ts, reqs)
+                self._bulk_finish(pp, reqs, flush_t)
+        except Exception:
+            # group-scope failure: every member re-executes sequentially and
+            # gets its own error attribution there
+            for r in reqs:
+                r.fallback = True
+            self.fallbacks.inc(len(reqs))
+        finally:
+            # unpark the NEXT group's leader before the followers: it starts
+            # its stall-loop collecting while this group's members drain
+            done.set()
+            with self._lock:
+                if self._flush_done.get(gkey) is done:
+                    del self._flush_done[gkey]
+            for r in reqs:
+                if r is not req:
+                    r.event.set()
+        return None if req.fallback else req
+
+    def _bulk_finish(self, pp: dict, reqs: List[BatchRequest], flush_t: float):
+        """Leader-side group finish: profile fields, ring append, counters,
+        latency/wait histograms — for EVERY served member, in bulk C-level
+        operations.  Conserving total Python work is not enough at 1k+
+        sessions; what matters is that the woken follower's serialized path
+        is as short as possible (build ResultSet, return), so all per-query
+        bookkeeping happens here, once per FLUSH instead of once per query.
+        Members that fall back or error keep full session-side handling
+        (their error ramp records the profile exactly once)."""
+        from galaxysql_tpu.utils.metrics import BATCH_GROUP_SIZE, BATCH_WAIT_MS
+        from galaxysql_tpu.utils.tracing import GLOBAL_STATS
+        BATCH_GROUP_SIZE.observe(len(reqs))
+        self.flushes.inc()
+        # serving time = submit -> scatter: collection wait (flush_t - t0)
+        # PLUS the vectorized execution that just finished — only the
+        # member's own wake-up/return is excluded (it cannot observe that
+        # before returning).  wait_us keeps the pure collection wait for the
+        # batch_wait_ms histogram (window tuning signal).
+        end_t = time.perf_counter()
+        exec_us = (end_t - flush_t) * 1e6
+        nfall = 0
+        waits = []
+        served = []
+        serve_ms = []
+        table = pp["table"]
+        key_col = pp["key_col"]
+        for r in reqs:
+            n = len(reqs)
+            r.group_size = n
+            wait_us = (flush_t - r.t0) * 1e6
+            r.wait_us = wait_us
+            waits.append(wait_us / 1000.0)
+            if r.fallback:
+                nfall += 1
+                continue
+            if r.error is not None or r.prof is None:
+                continue
+            p = r.prof
+            p.workload = "TP"
+            p.engine = "batch"
+            p.rows = len(r.rows)
+            total_us = wait_us + exec_us
+            p.elapsed_ms = round(total_us / 1000.0, 3)
+            p.trace = [f"trace-id {p.trace_id}",
+                       f"point-plan {table}.{key_col} "
+                       f"[batched group={n} wait={wait_us:.0f}us "
+                       f"exec={exec_us:.0f}us]",
+                       f"elapsed={total_us / 1e6:.3f}s workload=TP"]
+            served.append(p)
+            serve_ms.append(total_us / 1000.0)
+        BATCH_WAIT_MS.observe_many(waits)
+        if nfall:
+            self.fallbacks.inc(nfall)
+        if served:
+            inst = self.instance
+            inst.profiles.record_many(served)
+            lat_h, q_total, q_wl, q_eng = inst.finish_handles("TP", "batch")
+            lat_h.observe_many(serve_ms)
+            q_total.inc(len(served))
+            q_wl.inc(len(served))
+            q_eng.inc(len(served))
+            GLOBAL_STATS.bump("queries", len(served))
+            inst.counters.inc("batched_point_queries", len(served))
+            self.batched.inc(len(served))
+
+    # -- group execution -------------------------------------------------------
+
+    def _execute(self, gkey: Tuple, pp: dict, pinned_ts: Optional[int],
+                 reqs: List[BatchRequest]):
+        """One vectorized flush: stack unique keys, route to partitions, run
+        one jitted lookup per touched partition, gather each output column
+        ONCE across all matches, slice rows back per key."""
+        from galaxysql_tpu.chunk.batch import Column
+        from galaxysql_tpu.exec.device_cache import GLOBAL_DEVICE_CACHE
+        from galaxysql_tpu.exec.memory import MemoryLimitExceeded
+        from galaxysql_tpu.exec.operators import (BATCH_MAXDUP,
+                                                  batched_point_lookup)
+
+        inst = self.instance
+        if inst.catalog.schema_version != pp["schema_version"]:
+            raise RuntimeError("schema changed under the group")  # -> fallback
+        tm = inst.catalog.table(pp["schema"], pp["table"])
+        store = inst.store(pp["schema"], pp["table"])
+        inst_key = f"{tm.schema.lower()}.{tm.name.lower()}"
+        if inst.archive.files_for(inst_key, None):
+            raise RuntimeError("archive-backed table")  # cold rows: fallback
+        snap = pinned_ts if pinned_ts is not None else \
+            inst.tso.next_timestamp()
+        key_col = pp["key_col"]
+        out_cols = pp["out_cols"]
+
+        uniq: Dict[Any, int] = {}
+        for r in reqs:
+            uniq.setdefault(r.lane_val, len(uniq))
+        uvals = list(uniq)
+        results: List[List[tuple]] = [[] for _ in uvals]
+        errors: List[Optional[BaseException]] = [None] * len(uvals)
+
+        # flush scratch accounting through the memory pool (conservative:
+        # keys + up to MAXDUP gathered rows per key per output column)
+        est = len(uvals) * (16 + BATCH_MAXDUP * 16 * (len(out_cols) + 2))
+        try:
+            self.pool.reserve(est)
+        except MemoryLimitExceeded:
+            raise RuntimeError("batch scratch pool exhausted")
+        try:
+            by_pid = self._route(tm, key_col, uvals, errors,
+                                 len(store.partitions))
+            with inst.mdl.shared({inst_key}):
+                for pid in sorted(by_pid):
+                    part = store.partitions[pid]
+                    if part.num_rows == 0:
+                        continue
+                    sub = by_pid[pid]
+                    sub_vals = [uvals[i] for i in sub]
+                    ids, offs = batched_point_lookup(
+                        store, pid, part, key_col, tm.version, sub_vals,
+                        snap, 0, device_cache=GLOBAL_DEVICE_CACHE)
+                    if ids.size == 0:
+                        continue
+                    with part.lock:
+                        lists = []
+                        for cname, typ in zip(out_cols, pp["types"]):
+                            c = Column(part.lanes[cname][ids],
+                                       part.valid[cname][ids],
+                                       tm.column(cname).dtype,
+                                       tm.dictionaries.get(cname.lower()))
+                            lists.append(c.to_pylist())
+                    flat = list(zip(*lists))
+                    for j, u in enumerate(sub):
+                        seg = flat[offs[j]:offs[j + 1]]
+                        if seg:
+                            results[u].extend(seg)
+        finally:
+            self.pool.release(est)
+
+        poison = FAIL_POINTS.value(FP_BATCH_POISON_KEY)
+        if poison is not None:
+            for u, v in enumerate(uvals):
+                if v == poison:
+                    errors[u] = FailPointError(
+                        f"failpoint {FP_BATCH_POISON_KEY} fired (key {v!r})")
+
+        handed = [False] * len(uvals)
+        for r in reqs:
+            u = uniq[r.lane_val]
+            if errors[u] is not None:
+                r.error = errors[u]
+            else:
+                # each session's ResultSet takes ownership of its rows list;
+                # duplicate-key members get their own copy
+                r.rows = list(results[u]) if handed[u] else results[u]
+                handed[u] = True
+
+    def _route(self, tm, key_col: str, uvals, errors,
+               nparts: int) -> Dict[int, List[int]]:
+        """pid -> [unique-key index] routing, mirroring the sequential path's
+        `PartitionRouter.prune_eq(key_col, int(lane_val))` (vectorized for
+        the single-column hash/key case).  A per-key routing error — e.g. a
+        LIST value with no partition — is isolated to that key's sessions."""
+        from galaxysql_tpu.meta.catalog import PartitionRouter
+        router = PartitionRouter(tm)
+        info = tm.partition
+        by_pid: Dict[int, List[int]] = {}
+        if info.method in ("single", "broadcast"):
+            by_pid[0] = list(range(len(uvals)))
+            return by_pid
+        if info.method in ("hash", "key") and len(info.columns) == 1 and \
+                info.columns[0].lower() == key_col.lower():
+            # int() matches prune_eq's route_literal([int(v)]) lane truncation
+            arr = np.asarray([int(v) for v in uvals], dtype=np.int64)
+            for u, pid in enumerate(router.route_rows([arr])):
+                by_pid.setdefault(int(pid), []).append(u)
+            return by_pid
+        for u, v in enumerate(uvals):
+            try:
+                pids = router.prune_eq(key_col, int(v))
+            except Exception as e:
+                errors[u] = e
+                continue
+            if pids is None:
+                pids = range(nparts)
+            for pid in pids:
+                by_pid.setdefault(int(pid), []).append(u)
+        return by_pid
+
+    # -- observability (SHOW BATCH STATS / information_schema.batch_stats) -----
+
+    def stats_rows(self) -> List[Tuple[str, float]]:
+        """(stat_name, value) rows: group-size/wait quantiles, hit ratio over
+        all point-plan executions, window occupancy, live window state."""
+        from galaxysql_tpu.utils.metrics import BATCH_GROUP_SIZE, BATCH_WAIT_MS
+        gs = BATCH_GROUP_SIZE.quantiles()
+        ws = BATCH_WAIT_MS.quantiles()
+        batched = self.batched.value
+        sequential = self.instance.counters.get("point_plan_queries", 0)
+        uptime = max(time.perf_counter() - self._born, 1e-9)
+        mean_group = (BATCH_GROUP_SIZE.sum / BATCH_GROUP_SIZE.count) \
+            if BATCH_GROUP_SIZE.count else 0.0
+        with self._lock:
+            open_groups = len(self._groups)
+            window_us = self._window_s() * 1e6
+        return [
+            ("batched_queries", float(batched)),
+            ("batch_flushes", float(self.flushes.value)),
+            ("batch_fallbacks", float(self.fallbacks.value)),
+            ("batch_singletons", float(self.singletons.value)),
+            ("group_size_mean", round(mean_group, 3)),
+            ("group_size_p50", float(gs[0.5])),
+            ("group_size_p95", float(gs[0.95])),
+            ("group_size_p99", float(gs[0.99])),
+            ("wait_ms_p50", float(ws[0.5])),
+            ("wait_ms_p95", float(ws[0.95])),
+            ("hit_ratio", round(batched / max(batched + sequential, 1), 4)),
+            ("window_occupancy",
+             round(min(self._window_open_s / uptime, 1.0), 4)),
+            ("window_us", round(window_us, 1)),
+            ("open_groups", float(open_groups)),
+            ("point_inflight", float(self._inflight)),
+        ]

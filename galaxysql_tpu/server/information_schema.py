@@ -62,6 +62,9 @@ _DEFS: Dict[str, List] = {
     # cross-query fragment cache entries (exec/fragment_cache.py)
     "fragment_cache": [("entry_kind", _V), ("tables", _V), ("rows_cached", _I),
                        ("bytes", _I), ("hits", _I)],
+    # cross-session point-query batching (server/batch_scheduler.py):
+    # group sizes, waits, hit ratio, window occupancy — SHOW BATCH STATS twin
+    "batch_stats": [("stat_name", _V), ("value", _D)],
 }
 
 
@@ -174,3 +177,6 @@ def refresh(instance, session=None):
     fcache = getattr(instance, "frag_cache", None)
     fill("fragment_cache", ([k, t, r, b, h] for k, t, r, b, h in
                             (fcache.rows() if fcache is not None else [])))
+    sched = getattr(instance, "batch_scheduler", None)
+    fill("batch_stats", ([n, float(v)] for n, v in
+                         (sched.stats_rows() if sched is not None else [])))

@@ -62,7 +62,9 @@ class Instance:
         self.sync_bus = SyncBus()
         from galaxysql_tpu.meta.ha import HaManager
         self.ha = HaManager(self)
-        from galaxysql_tpu.utils.metrics import (MetricsRegistry, RPC_RTT_MS,
+        from galaxysql_tpu.utils.metrics import (BATCH_GROUP_SIZE,
+                                                 BATCH_WAIT_MS,
+                                                 MetricsRegistry, RPC_RTT_MS,
                                                  SEGMENT_WALL_MS)
         from galaxysql_tpu.utils.tracing import ProfileRing, TraceIdAllocator
         # typed counter/gauge registry: SQL (information_schema.metrics,
@@ -74,6 +76,8 @@ class Instance:
         # is per-instance and observed in Session._finish_query
         self.metrics.adopt(SEGMENT_WALL_MS)
         self.metrics.adopt(RPC_RTT_MS)
+        self.metrics.adopt(BATCH_GROUP_SIZE)
+        self.metrics.adopt(BATCH_WAIT_MS)
         self.metrics.histogram("query_latency_ms",
                                "end-to-end query latency (ms)")
         # node-prefixed trace-id mint: peer coordinators (sync_peer setups)
@@ -98,6 +102,15 @@ class Instance:
         # (schema, parameterized-sql) -> PointPlan: binder-free execution of
         # archetypal point SELECTs (DirectShardingKeyTableOperation analog)
         self.point_plans: Dict[tuple, object] = {}
+        # (workload, engine) -> bound metric handles for Session._finish_query
+        # (registry name-sanitize + lookup x4 per query is measurable at TP
+        # serving rates; the handle tuple is immutable so plain dict is safe)
+        self.finish_metrics: Dict[tuple, tuple] = {}
+        # cross-session point-query batching (server/batch_scheduler.py):
+        # plan-cache-identical point reads arriving within the collection
+        # window coalesce into one vectorized dispatch per partition
+        from galaxysql_tpu.server.batch_scheduler import BatchScheduler
+        self.batch_scheduler = BatchScheduler(self)
         from galaxysql_tpu.server.maintain import RecycleBin
         self.recycle = RecycleBin(self)
         self.lock = threading.RLock()
@@ -106,6 +119,23 @@ class Instance:
         self.catalog.create_schema("information_schema", if_not_exists=True)
         if boot:
             self.boot()
+
+    def finish_handles(self, workload: str, engine: str) -> tuple:
+        """(latency histogram, total/workload/engine counters) bound once per
+        (workload, engine) — shared by Session._finish_query and the batch
+        scheduler's bulk group finish."""
+        handles = self.finish_metrics.get((workload, engine))
+        if handles is None:
+            m = self.metrics
+            handles = (m.histogram("query_latency_ms",
+                                   "end-to-end query latency (ms)"),
+                       m.counter("queries_total", "queries executed"),
+                       m.counter(f"queries_{workload.lower()}",
+                                 f"{workload} workload queries"),
+                       m.counter(f"engine_exec_{engine}",
+                                 f"queries served by the {engine} engine"))
+            self.finish_metrics[(workload, engine)] = handles
+        return handles
 
     # -- boot ------------------------------------------------------------------
 
@@ -461,6 +491,9 @@ class Instance:
             return {"ok": True, "action": action, "node": self.node_id}
         if action == "invalidate_plan_cache":
             self.planner.cache.invalidate_all()
+            return {"ok": True, "action": action, "node": self.node_id}
+        if action == "invalidate_privilege_cache":
+            self.privileges.invalidate_cache()
             return {"ok": True, "action": action, "node": self.node_id}
         return {"ok": False, "error": f"unknown sync action {action!r}"}
 

@@ -26,10 +26,12 @@ from galaxysql_tpu.plan.physical import ExecContext, build_operator
 from galaxysql_tpu.server.instance import Instance
 from galaxysql_tpu.sql import ast
 from galaxysql_tpu.sql.lexer import split_statements
+from galaxysql_tpu.sql.parameterize import DecimalParam, parameterize
 from galaxysql_tpu.sql.parser import parse
 from galaxysql_tpu.storage.table_store import INFINITY_TS
 from galaxysql_tpu.types import datatype as dt
-from galaxysql_tpu.utils import errors
+from galaxysql_tpu.utils import errors, tracing
+from galaxysql_tpu.utils.ccl import GLOBAL_CCL
 
 
 @dataclasses.dataclass
@@ -298,20 +300,20 @@ class Session:
         if isinstance(stmt, ast.CreateUser):
             self.instance.privileges.create_user(stmt.user, stmt.password,
                                                  if_not_exists=stmt.if_not_exists)
-            return ok()
+            return self._sync_privileges()
         if isinstance(stmt, ast.DropUser):
             self.instance.privileges.drop_user(stmt.user, stmt.if_exists)
-            return ok()
+            return self._sync_privileges()
         if isinstance(stmt, ast.GrantStmt):
             schema = self._require_schema() if stmt.schema == "" else stmt.schema
             self.instance.privileges.grant(stmt.user, stmt.privileges, schema,
                                            stmt.table)
-            return ok()
+            return self._sync_privileges()
         if isinstance(stmt, ast.RevokeStmt):
             schema = self._require_schema() if stmt.schema == "" else stmt.schema
             self.instance.privileges.revoke(stmt.user, stmt.privileges, schema,
                                             stmt.table)
-            return ok()
+            return self._sync_privileges()
         if isinstance(stmt, ast.AlterTable):
             return self._run_alter(stmt, sql)
         if isinstance(stmt, (ast.CreateIndex, ast.DropIndex)):
@@ -513,7 +515,6 @@ class Session:
         bump the metrics registry, and apply the slow-SQL gate (the one home
         for the SLOW_SQL_MS check — point, local, and MPP paths all land
         here)."""
-        from galaxysql_tpu.utils.tracing import GLOBAL_STATS, SLOW_LOG
         prof.workload = workload
         prof.engine = engine
         prof.rows = rows
@@ -523,35 +524,37 @@ class Session:
             if prof.profiled:
                 prof.op_stats = list(ctx.op_stats)
             prof.trace = list(ctx.trace)
-        try:
-            import resource
-            prof.peak_rss_kb = resource.getrusage(
-                resource.RUSAGE_SELF).ru_maxrss
-        except Exception:
-            pass  # non-POSIX host: profile simply lacks the memory datapoint
+        if prof.profiled or prof.spans:
+            # the RSS high-water syscall is ~70us on virtualized kernels —
+            # worth it only when someone asked for the profile/trace detail
+            try:
+                import resource
+                prof.peak_rss_kb = resource.getrusage(
+                    resource.RUSAGE_SELF).ru_maxrss
+            except Exception:
+                pass  # non-POSIX host: profile lacks the memory datapoint
         inst = self.instance
         inst.profiles.record(prof)
         m = inst.metrics
-        m.histogram("query_latency_ms",
-                    "end-to-end query latency (ms)").observe(elapsed * 1000)
-        m.counter("queries_total", "queries executed").inc()
-        m.counter(f"queries_{workload.lower()}",
-                  f"{workload} workload queries").inc()
-        m.counter(f"engine_exec_{engine}",
-                  f"queries served by the {engine} engine").inc()
-        GLOBAL_STATS.bump("queries")
+        # bound metric handles are cached per (workload, engine): name
+        # sanitize + registry lookups x4 are measurable at TP serving rates
+        lat_h, q_total, q_wl, q_eng = inst.finish_handles(workload, engine)
+        lat_h.observe(elapsed * 1000)
+        q_total.inc()
+        q_wl.inc()
+        q_eng.inc()
+        tracing.GLOBAL_STATS.bump("queries")
         slow_ms = inst.config.get("SLOW_SQL_MS", self.vars)
         # 0 logs every query (MySQL long_query_time=0); negative disables
         if slow_ms is not None and slow_ms >= 0 and elapsed * 1000 >= slow_ms:
-            SLOW_LOG.record(sql or "<stmt>", elapsed, self.conn_id,
+            tracing.SLOW_LOG.record(sql or "<stmt>", elapsed, self.conn_id,
                             trace_id=prof.trace_id, workload=workload)
-            GLOBAL_STATS.bump("slow")
+            tracing.GLOBAL_STATS.bump("slow")
             m.counter("slow_queries", "queries over SLOW_SQL_MS").inc()
 
     def _run_query(self, stmt, sql: str, params: Optional[list]) -> ResultSet:
         schema = self._require_schema()
         t0 = time.time()
-        from galaxysql_tpu.utils import tracing
         prof = tracing.QueryProfile(trace_id=self.instance.trace_ids.next(),
                                     sql=(sql or "<stmt>")[:512], schema=schema,
                                     conn_id=self.conn_id, started_at=t0)
@@ -559,7 +562,6 @@ class Session:
                 schema.lower() == "information_schema":
             from galaxysql_tpu.server import information_schema
             information_schema.refresh(self.instance, self)
-        from galaxysql_tpu.utils.ccl import GLOBAL_CCL
         admission = GLOBAL_CCL.admit(self, sql or "")
         tc = None
         if self._tracing_enabled():
@@ -603,7 +605,6 @@ class Session:
         explain slow FAILURES, not just slow successes (utils/errors.py
         supplies the errno/sqlstate attributes)."""
         from galaxysql_tpu.utils import errors as _err
-        from galaxysql_tpu.utils.tracing import GLOBAL_STATS, SLOW_LOG
         elapsed = time.time() - t0
         prof.elapsed_ms = round(elapsed * 1000, 3)
         prof.error = f"{type(exc).__name__}: {exc}"[:512]
@@ -615,7 +616,7 @@ class Session:
                    **_err.span_attrs(exc))
             self._finish_trace(tc)
         inst.profiles.record(prof)
-        GLOBAL_STATS.bump("errors")
+        tracing.GLOBAL_STATS.bump("errors")
         inst.metrics.counter("query_errors",
                              "queries failed mid-execution").inc()
         self.last_trace = [f"trace-id {prof.trace_id}",
@@ -623,10 +624,10 @@ class Session:
                            f"elapsed={elapsed:.3f}s"]
         slow_ms = inst.config.get("SLOW_SQL_MS", self.vars)
         if slow_ms is not None and slow_ms >= 0 and elapsed * 1000 >= slow_ms:
-            SLOW_LOG.record(sql or "<stmt>", elapsed, self.conn_id,
+            tracing.SLOW_LOG.record(sql or "<stmt>", elapsed, self.conn_id,
                             trace_id=prof.trace_id, workload=prof.workload,
                             error=type(exc).__name__)
-            GLOBAL_STATS.bump("slow")
+            tracing.GLOBAL_STATS.bump("slow")
             inst.metrics.counter("slow_queries",
                                  "queries over SLOW_SQL_MS").inc()
 
@@ -710,7 +711,6 @@ class Session:
         if id_to_col.get(col.name, "").lower() != scan.point_eq[0].lower():
             return
         bound = getattr(plan, "bound_params", None)
-        from galaxysql_tpu.sql.parameterize import DecimalParam
         b0 = bound[0] if bound else None
         if isinstance(b0, DecimalParam):
             b0 = b0.value
@@ -738,7 +738,6 @@ class Session:
         self.instance.point_plans[plan.spm_key] = pp
 
     def _try_point_exec(self, sql, params, schema, t0, prof):
-        from galaxysql_tpu.sql.parameterize import parameterize, DecimalParam
         p = parameterize(sql)
         pp = self.instance.point_plans.get((schema.lower(), p.cache_key))
         if pp is None:
@@ -746,6 +745,18 @@ class Session:
         if pp["schema_version"] != self.instance.catalog.schema_version:
             self.instance.point_plans.pop((schema.lower(), p.cache_key), None)
             return None
+        sched = getattr(self.instance, "batch_scheduler", None)
+        if sched is None:
+            return self._point_exec(pp, p, sql, params, schema, t0, prof)
+        # bracket the WHOLE point path (batched or sequential): the batch
+        # scheduler's adaptive window keys off live point-query concurrency
+        sched.point_begin()
+        try:
+            return self._point_exec(pp, p, sql, params, schema, t0, prof)
+        finally:
+            sched.point_end()
+
+    def _point_exec(self, pp, p, sql, params, schema, t0, prof):
         vals = p.resolve(params or [])
         if len(vals) != 1:
             return None
@@ -771,6 +782,12 @@ class Session:
             lane_val = _lane_encode(tm, key_col, value)
             if lane_val is None:
                 return None
+            # cross-session batching: coalesce with other sessions executing
+            # this same parameterized statement (returns None -> run solo)
+            brs = self._try_batched_point(pp, p, lane_val, sql, t0, prof,
+                                          schema)
+            if brs is not None:
+                return brs
             from galaxysql_tpu.meta.catalog import PartitionRouter
             # route in LANE domain: hash routing on insert keys off the lane
             # values (dictionary codes for strings, scaled ints for decimals).
@@ -814,6 +831,50 @@ class Session:
         self._finish_query(sql, elapsed, prof, "TP", "point", len(rows))
         self.instance.counters.inc("point_plan_queries")
         return ResultSet(pp["names"], pp["types"], rows)
+
+    def _try_batched_point(self, pp, psql, lane_val, sql, t0,
+                           prof, schema) -> Optional[ResultSet]:
+        """Submit this point read to the cross-session batch scheduler
+        (server/batch_scheduler.py).  Returns the scattered ResultSet, or
+        None when the session must run the sequential path itself: batching
+        disabled, arrival rate too low (window 0), singleton group, or a
+        group-scope fallback.
+
+        Snapshot semantics: a transaction holding ANY writes bypasses —
+        its provisional (-txn_id) stamps need own-txn visibility the shared
+        group program must not apply to other members.  A read-only
+        transaction groups only with sessions pinned to the SAME snapshot
+        (pinned_ts rides the group key); autocommit sessions share one
+        flush-time TSO."""
+        sched = getattr(self.instance, "batch_scheduler", None)
+        if sched is None or not sched.enabled(self):
+            return None
+        pinned = None
+        if self.txn is not None:
+            if self.txn.inserted or self.txn.deleted or self.txn.remote:
+                return None  # own-txn writes: sequential own-visibility path
+            pinned = self.txn.snapshot_ts
+        gkey = (schema.lower(), psql.cache_key, pinned, pp["schema_version"])
+        req = sched.submit(gkey, pp, lane_val, pinned, prof)
+        if req is None:
+            return None
+        if req.error is not None:
+            raise req.error  # isolated to this session; group members proceed
+        # the leader bulk-finished profile/ring/metrics at scatter
+        # (BatchScheduler._bulk_finish): the woken member's serialized tail
+        # is only SHOW TRACE state, the per-session slow-SQL gate, and the
+        # ResultSet handover (req.rows is this request's own scatter slice)
+        self.last_trace = prof.trace
+        slow_ms = self.instance.config.get("SLOW_SQL_MS", self.vars)
+        if slow_ms is not None and slow_ms >= 0:
+            elapsed = time.time() - t0
+            if elapsed * 1000 >= slow_ms:
+                tracing.SLOW_LOG.record(sql, elapsed, self.conn_id,
+                                        trace_id=prof.trace_id, workload="TP")
+                tracing.GLOBAL_STATS.bump("slow")
+                self.instance.metrics.counter(
+                    "slow_queries", "queries over SLOW_SQL_MS").inc()
+        return ResultSet(pp["names"], pp["types"], req.rows)
 
     def _run_query_locked(self, plan, ctx, sql, t0, prof) -> ResultSet:
         from galaxysql_tpu.utils.tracing import SEGMENT_TRACER
@@ -1099,6 +1160,13 @@ class Session:
         if auto:
             self._commit()
         return ok(affected=affected)
+
+    def _sync_privileges(self) -> ResultSet:
+        """After any user/grant mutation: peer coordinators share the metadb
+        but keep their own privilege decision caches — broadcast the drop
+        (workers ignore the action; best-effort, like fragment-cache sync)."""
+        self.instance.sync_bus.broadcast("invalidate_privilege_cache", {})
+        return ok()
 
     def _note_remote_write(self, schema: str, table: str):
         fcache = getattr(self.instance, "frag_cache", None)

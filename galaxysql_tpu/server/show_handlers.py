@@ -163,6 +163,14 @@ def handle(session, stmt: ast.Show):
         return ResultSet(["Kind", "Tables", "Rows", "Bytes", "Hits"],
                          [dt.VARCHAR, dt.VARCHAR, dt.BIGINT, dt.BIGINT,
                           dt.BIGINT], rows)
+    if kind == "batch" and (stmt.target or "").lower() == "stats":
+        # SHOW BATCH STATS: the cross-session point-query batching scheduler
+        # (group sizes, waits, hit ratio, window occupancy) — the
+        # information_schema.batch_stats twin
+        sched = getattr(inst, "batch_scheduler", None)
+        rows = sched.stats_rows() if sched is not None else []
+        return ResultSet(["Stat", "Value"], [dt.VARCHAR, dt.DOUBLE],
+                         [(n, float(v)) for n, v in rows])
     if kind == "metrics":
         # the typed counter/gauge registry (information_schema.metrics twin)
         rows = [(n, k, float(v), h) for n, k, v, h in inst.metrics.rows()]

@@ -18,6 +18,10 @@ behind them:
 - NO_FUSE                  disable pipeline segment fusion for the statement
 - FRAGMENT_CACHE(OFF|ON)   per-statement control of the cross-query fragment
   cache (exec/fragment_cache.py): OFF bypasses build/subplan/filter reuse
+- BATCH(OFF|ON)            per-statement control of cross-session point-query
+  batching (server/batch_scheduler.py).  Hinted statements never register
+  PointPlans, so BATCH(OFF) structurally pins the statement to the planned
+  (unbatched) path; the directive still parses so tools can round-trip it.
 - BASELINE_OFF             bypass SPM for the statement (plan as costed)
 
 Unknown directives are ignored (hints must never break a query), matching the
@@ -64,6 +68,10 @@ def parse_hints(comment: Optional[str]) -> Dict[str, object]:
             mode = arglist[0].lower()
             if mode in ("off", "on"):
                 out["fragment_cache"] = mode
+        elif name == "BATCH" and arglist:
+            mode = arglist[0].lower()
+            if mode in ("off", "on"):
+                out["batch"] = mode
         elif name == "BASELINE_OFF":
             out["baseline_off"] = True
     return out

@@ -65,6 +65,31 @@ _KEEP_STMT_PREFIX = {"CREATE", "ALTER", "DROP", "SET", "SHOW", "USE", "KILL", "A
 
 
 def parameterize(sql: str) -> ParameterizedSql:
+    """Memoized by exact SQL text: OLTP traffic repeats statements (and the
+    batch scheduler's whole premise is plan-cache-identical repetition), so
+    the token sweep runs once per distinct text.  Safe because
+    ParameterizedSql is never mutated after construction — resolve() returns
+    a fresh list."""
+    hit = _PARAM_CACHE.get(sql)
+    if hit is not None:
+        return hit
+    p = _parameterize(sql)
+    if len(sql) <= _PARAM_CACHE_MAX_SQL:
+        # don't retain bulk-load texts: a distinct multi-megabyte INSERT is
+        # held ~3x per entry (key + raw + parameterized) and never repeats —
+        # the repeated-statement win lives entirely in short OLTP texts
+        if len(_PARAM_CACHE) >= _PARAM_CACHE_CAP:
+            _PARAM_CACHE.clear()  # epoch reset: bounded, no LRU bookkeeping
+        _PARAM_CACHE[sql] = p
+    return p
+
+
+_PARAM_CACHE: dict = {}
+_PARAM_CACHE_CAP = 8192
+_PARAM_CACHE_MAX_SQL = 4096
+
+
+def _parameterize(sql: str) -> ParameterizedSql:
     toks = tokenize(sql)
     first = next((t for t in toks if t.kind != T.OP or not t.text.startswith("/*")), toks[-1])
     if first.kind == T.IDENT and first.upper in _KEEP_STMT_PREFIX:

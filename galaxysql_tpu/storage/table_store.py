@@ -59,6 +59,22 @@ class Partition:
         self.lane_gen += 1
         self._key_indexes.clear()
 
+    def key_index(self, col: str):
+        """(n0, perm, sorted_keys) of the append-aware sorted index over
+        `col` (building it if stale).  `perm` stable-sorts rows [0, n0), so
+        perm[lo:hi] enumerates equal-key rows in ascending row-id order; rows
+        [n0, num_rows) are the unsorted appended tail the caller must probe
+        separately.  Caller must hold `self.lock`."""
+        n = self.num_rows
+        lane = self.lanes[col]
+        entry = self._key_indexes.get(col)
+        if entry is None or entry[0] != self.lane_gen or \
+                n - entry[1] > self._INDEX_TAIL:
+            perm = np.argsort(lane[:n], kind="stable")
+            entry = (self.lane_gen, n, perm, lane[:n][perm])
+            self._key_indexes[col] = entry
+        return entry[1], entry[2], entry[3]
+
     def key_candidates(self, col: str, lane_value) -> np.ndarray:
         """Row ids whose `col` lane equals the (lane-encoded) value.
 
@@ -69,13 +85,7 @@ class Partition:
         with self.lock:
             n = self.num_rows
             lane = self.lanes[col]
-            entry = self._key_indexes.get(col)
-            if entry is None or entry[0] != self.lane_gen or \
-                    n - entry[1] > self._INDEX_TAIL:
-                perm = np.argsort(lane[:n], kind="stable")
-                entry = (self.lane_gen, n, perm, lane[:n][perm])
-                self._key_indexes[col] = entry
-            _gen, n0, perm, skeys = entry
+            n0, perm, skeys = self.key_index(col)
             lo = np.searchsorted(skeys, lane_value, side="left")
             hi = np.searchsorted(skeys, lane_value, side="right")
             ids = perm[lo:hi]

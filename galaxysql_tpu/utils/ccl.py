@@ -85,6 +85,13 @@ class CclManager:
     def admit(self, session, sql: str) -> _Admission:
         """Block (bounded) until the query may run; raise CclRejectError on overflow
         or timeout.  Returns a handle whose release() must be called when done."""
+        if not self._rules:
+            # rule-free fast path: no lock on the per-query hot path — the
+            # batched TP serving loop calls admit() at millions/sec and a
+            # contended lock here would serialize the whole admission plane
+            # (dict truthiness is a single atomic read; a rule added
+            # concurrently applies from the next statement on)
+            return _NO_ADMISSION
         with self._lock:
             states = list(self._rules.values())
         for st in states:

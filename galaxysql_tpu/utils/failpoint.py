@@ -15,6 +15,9 @@ FP_BEFORE_DDL_TASK = "FP_BEFORE_DDL_TASK"
 FP_AFTER_DDL_TASK = "FP_AFTER_DDL_TASK"
 FP_BEFORE_COMMIT = "FP_BEFORE_COMMIT"
 FP_BACKFILL_PAUSE = "FP_BACKFILL_PAUSE"
+# armed with a key VALUE: the batch scheduler fails exactly that key's
+# sessions inside a flush (error-isolation testing, server/batch_scheduler.py)
+FP_BATCH_POISON_KEY = "FP_BATCH_POISON_KEY"
 
 
 class FailPointError(RuntimeError):

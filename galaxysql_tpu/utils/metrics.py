@@ -101,17 +101,36 @@ class Histogram:
         self._sum = 0.0
         self._lock = threading.Lock()
 
+    def _observe_locked(self, v: float):
+        self._count += 1
+        self._sum += v
+        if len(self._buf) < self._cap:
+            self._buf.append(v)
+        else:
+            j = random.randrange(self._count)
+            if j < self._cap:
+                self._buf[j] = v
+
     def observe(self, v: float):
-        v = float(v)
         with self._lock:
-            self._count += 1
-            self._sum += v
-            if len(self._buf) < self._cap:
-                self._buf.append(v)
-            else:
-                j = random.randrange(self._count)
-                if j < self._cap:
-                    self._buf[j] = v
+            self._observe_locked(float(v))
+
+    def reset(self):
+        """Clear count/sum/reservoir — scopes quantiles to a measurement
+        window (the serving bench resets per level so each level's group-size
+        p50 isn't blended with warmup and earlier levels)."""
+        with self._lock:
+            self._buf = []
+            self._count = 0
+            self._sum = 0.0
+
+    def observe_many(self, vals):
+        """One lock acquisition for a whole batch of observations (the batch
+        scheduler records per-member waits once per flush — at group sizes in
+        the hundreds, per-observation locking would tax the flush path)."""
+        with self._lock:
+            for v in vals:
+                self._observe_locked(float(v))
 
     @property
     def count(self) -> int:
@@ -150,6 +169,12 @@ SEGMENT_WALL_MS = Histogram(
     "segment_wall_ms", "fused-segment dispatch wall time (ms)")
 RPC_RTT_MS = Histogram(
     "rpc_rtt_ms", "coordinator->worker RPC round-trip (ms)")
+# batched TP serving (server/batch_scheduler.py): coalesced group sizes per
+# vectorized flush and per-request collection-window wait
+BATCH_GROUP_SIZE = Histogram(
+    "batch_group_size", "coalesced point-query group size (requests/flush)")
+BATCH_WAIT_MS = Histogram(
+    "batch_wait_ms", "batched point-query collection wait (ms)")
 
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")

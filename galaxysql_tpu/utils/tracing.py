@@ -47,11 +47,12 @@ class TraceIdAllocator:
         self.node_id = node_id
         self._prefix = (zlib.crc32(node_id.encode()) & 0x3FFFFF) << _NODE_BITS
         self._count = itertools.count(1)
-        self._lock = threading.Lock()
 
     def next(self) -> int:
-        with self._lock:
-            return self._prefix | next(self._count)
+        # itertools.count.__next__ is a single C call (GIL-atomic): every
+        # query mints an id, and a lock here is a measurable convoy at
+        # batched-TP serving rates
+        return self._prefix | next(self._count)
 
 
 def trace_node_hash(trace_id: int) -> int:
@@ -414,15 +415,21 @@ class ProfileRing:
 
     def __init__(self, capacity: int = 256):
         self._ring: Deque[QueryProfile] = collections.deque(maxlen=capacity)
-        self._lock = threading.Lock()
 
     def record(self, profile: QueryProfile):
-        with self._lock:
-            self._ring.append(profile)
+        # deque(maxlen).append is one C call (GIL-atomic); EVERY query lands
+        # here, and a lock convoys at batched-TP serving rates.  Readers
+        # snapshot with list(ring) — also a single C call — and iterate the
+        # snapshot, so they never see a deque mutating under them.
+        self._ring.append(profile)
+
+    def record_many(self, profiles):
+        """Bulk append (one C call) — the batch scheduler records a whole
+        group's profiles at scatter time."""
+        self._ring.extend(profiles)
 
     def entries(self) -> List[QueryProfile]:
-        with self._lock:
-            return list(self._ring)
+        return list(self._ring)
 
     def get(self, trace_id) -> Optional[QueryProfile]:
         """Exact-id lookup.  Ids are node-prefixed (TraceIdAllocator), so a
@@ -433,15 +440,13 @@ class ProfileRing:
             tid = int(trace_id)
         except (TypeError, ValueError):
             return None
-        with self._lock:
-            for p in self._ring:
-                if p.trace_id == tid:
-                    return p
+        for p in list(self._ring):
+            if p.trace_id == tid:
+                return p
         return None
 
     def clear(self):
-        with self._lock:
-            self._ring.clear()
+        self._ring.clear()
 
 
 class MatrixStatistics:

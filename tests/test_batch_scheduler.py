@@ -1,0 +1,333 @@
+"""Cross-session point-query batching (server/batch_scheduler.py).
+
+Guards the mega-batched TP serving path: batched results must be
+bit-identical to sequential execution (rows AND order) under heavy
+concurrency, a poisoned key fails only its own session, transactional
+sessions keep exact snapshot semantics, and the static batch buckets never
+retrace in steady state.  Fast target: `make batch-smoke`.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+
+pytestmark = pytest.mark.batching
+
+
+@pytest.fixture()
+def sess():
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE bsx")
+    s.execute("USE bsx")
+    s.execute("""
+        CREATE TABLE t (
+            id BIGINT NOT NULL PRIMARY KEY,
+            k  INT NOT NULL,
+            v  VARCHAR(20),
+            amt DECIMAL(12,2)
+        ) PARTITION BY HASH(id) PARTITIONS 4
+    """)
+    rows = ", ".join(f"({i}, {i % 41}, 'v{i % 13}', {i}.25)"
+                     for i in range(1, 2001))
+    s.execute(f"INSERT INTO t (id, k, v, amt) VALUES {rows}")
+    return inst, s
+
+
+def _register(s, sql_tpl, key):
+    """Two executions register + warm the PointPlan for the template."""
+    s.execute(sql_tpl % key)
+    s.execute(sql_tpl % key)
+
+
+def _run_threads(n, fn):
+    errors = []
+    barrier = threading.Barrier(n)
+
+    def runner(i):
+        try:
+            barrier.wait(timeout=30)
+            fn(i)
+        except Exception as e:  # pragma: no cover - assertion carrier
+            errors.append(e)
+
+    threads = [threading.Thread(target=runner, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return errors
+
+
+def test_batched_bit_identical_100_sessions(sess):
+    """100+ concurrent sessions: every batched result equals the sequential
+    (batching-off) execution of the same statement, and groups actually
+    formed (the run was not a fallback parade)."""
+    inst, s = sess
+    tpl = "SELECT v, amt FROM t WHERE id = %d"
+    _register(s, tpl, 1)
+    keys = list(range(1, 2001, 7)) + [999999, 1000001]
+    inst.config.set_instance("ENABLE_BATCH_SCHEDULER", 0)
+    expected = {k: s.execute(tpl % k).rows for k in keys}
+    inst.config.set_instance("ENABLE_BATCH_SCHEDULER", 1)
+    inst.config.set_instance("BATCH_WINDOW_US", 3000)
+
+    def worker(i):
+        sx = Session(inst, schema="bsx")
+        for j in range(8):
+            k = keys[(i * 13 + j * 29) % len(keys)]
+            got = sx.execute(tpl % k).rows
+            assert got == expected[k], (k, got, expected[k])
+        sx.close()
+
+    errors = _run_threads(104, worker)
+    assert not errors, errors[:3]
+    assert inst.metrics.counter("batched_queries").value > 0
+    assert inst.metrics.counter("batch_flushes").value > 0
+
+
+def test_multi_row_non_unique_key_row_order(sess):
+    """A non-unique indexed key returns MULTIPLE rows; the batched gather
+    must reproduce the sequential path's row ORDER exactly (partition order,
+    then ascending row ids)."""
+    inst, s = sess
+    s.execute("CREATE INDEX i_k ON t (k)")
+    s.execute("ANALYZE TABLE t")
+    tpl = "SELECT id, amt FROM t WHERE k = %d"
+    _register(s, tpl, 5)
+    keys = list(range(41))
+    inst.config.set_instance("ENABLE_BATCH_SCHEDULER", 0)
+    expected = {k: s.execute(tpl % k).rows for k in keys}
+    assert any(len(r) > 10 for r in expected.values())
+    inst.config.set_instance("ENABLE_BATCH_SCHEDULER", 1)
+    inst.config.set_instance("BATCH_WINDOW_US", 3000)
+
+    def worker(i):
+        sx = Session(inst, schema="bsx")
+        for j in range(4):
+            k = keys[(i * 7 + j) % len(keys)]
+            got = sx.execute(tpl % k).rows
+            assert got == expected[k], (k, len(got), len(expected[k]))
+        sx.close()
+
+    errors = _run_threads(24, worker)
+    assert not errors, errors[:3]
+
+
+def test_error_isolation_poisoned_key(sess):
+    """A poisoned key inside a group fails ONLY its own session; every other
+    member of the same flush gets its correct rows."""
+    from galaxysql_tpu.utils.failpoint import (FAIL_POINTS,
+                                               FP_BATCH_POISON_KEY,
+                                               FailPointError)
+    inst, s = sess
+    tpl = "SELECT amt FROM t WHERE id = %d"
+    _register(s, tpl, 1)
+    inst.config.set_instance("BATCH_WINDOW_US", 20000)
+    poisoned_key = 777
+    FAIL_POINTS.arm(FP_BATCH_POISON_KEY, poisoned_key)
+    outcomes = {}
+    lock = threading.Lock()
+    try:
+        def worker(i):
+            sx = Session(inst, schema="bsx")
+            key = poisoned_key if i == 3 else 100 + i
+            try:
+                rows = sx.execute(tpl % key).rows
+                with lock:
+                    outcomes[i] = rows
+            except FailPointError:
+                with lock:
+                    outcomes[i] = "poisoned"
+            finally:
+                sx.close()
+
+        errors = _run_threads(8, worker)
+        assert not errors, errors[:3]
+    finally:
+        FAIL_POINTS.disarm(FP_BATCH_POISON_KEY)
+    assert outcomes[3] == "poisoned"
+    for i in range(8):
+        if i == 3:
+            continue
+        assert outcomes[i] == [(100 + i + 0.25,)], (i, outcomes[i])
+    # the error surfaced through the normal error ramp (profile + counter)
+    assert inst.metrics.counter("query_errors").value >= 1
+
+
+def test_txn_write_bypass_and_snapshot_semantics(sess):
+    """Sessions inside a writing transaction bypass batching (own provisional
+    stamps stay own-visible); read-only transactions keep their pinned
+    snapshot; autocommit sessions see committed writes through the batched
+    path."""
+    inst, s = sess
+    tpl = "SELECT amt FROM t WHERE id = %d"
+    _register(s, tpl, 42)
+    inst.config.set_instance("BATCH_WINDOW_US", 2000)
+    # writing txn: sees its own uncommitted write, bypassing the group path
+    s.execute("BEGIN")
+    s.execute("UPDATE t SET amt = 777.77 WHERE id = 42")
+    assert s.execute(tpl % 42).rows == [(777.77,)]
+    prof = inst.profiles.entries()[-1]
+    assert prof.engine != "batch"
+    # a concurrent autocommit session must NOT see it (even batched)
+    s2 = Session(inst, schema="bsx")
+    assert s2.execute(tpl % 42).rows == [(42.25,)]
+    s.execute("COMMIT")
+    # read-only txn pinned BEFORE an update commits keeps the old snapshot
+    s3 = Session(inst, schema="bsx")
+    s3.execute("BEGIN")
+    assert s3.execute(tpl % 42).rows == [(777.77,)]  # pin snapshot
+    s2.execute("UPDATE t SET amt = 888.88 WHERE id = 42")
+    assert s3.execute(tpl % 42).rows == [(777.77,)]
+    s3.execute("ROLLBACK")
+    # autocommit group sees the committed value: run a real batched group
+    results = {}
+    lock = threading.Lock()
+
+    def worker(i):
+        sx = Session(inst, schema="bsx")
+        with lock:
+            results[i] = sx.execute(tpl % 42).rows
+        sx.close()
+
+    errors = _run_threads(8, worker)
+    assert not errors, errors[:3]
+    for i, rows in results.items():
+        assert rows == [(888.88,)], (i, rows)
+    s2.close()
+    s3.close()
+
+
+def test_append_tail_visible_in_batched_lookup(sess):
+    """Rows appended after the sorted index was built (the unsorted tail)
+    must surface through the batched path's host-side tail probe."""
+    inst, s = sess
+    tpl = "SELECT amt FROM t WHERE id = %d"
+    _register(s, tpl, 1)  # builds the sorted key index
+    s.execute("INSERT INTO t (id, k, v, amt) VALUES (5001, 1, 'x', 9.99)")
+    inst.config.set_instance("BATCH_WINDOW_US", 3000)
+    results = {}
+    lock = threading.Lock()
+
+    def worker(i):
+        sx = Session(inst, schema="bsx")
+        key = 5001 if i % 2 == 0 else 1 + i
+        with lock:
+            results[i] = (key, sx.execute(tpl % key).rows)
+        sx.close()
+
+    errors = _run_threads(8, worker)
+    assert not errors, errors[:3]
+    for i, (key, rows) in results.items():
+        want = [(9.99,)] if key == 5001 else [(key + 0.25,)]
+        assert rows == want, (key, rows)
+
+
+def test_batch_buckets_never_retrace_in_steady_state(sess):
+    """The vectorized lookup keys on static (bucket, capacity) shapes: after
+    one warm pass over the bucket ladder, re-running every shape — including
+    different key counts within one bucket — compiles NOTHING."""
+    from galaxysql_tpu.exec import operators as ops
+    inst, s = sess
+    store = inst.store("bsx", "t")
+    part = next(p for p in store.partitions if p.num_rows > 0)
+    snap = inst.tso.next_timestamp()
+    tm = inst.catalog.table("bsx", "t")
+
+    def sweep(force_device):
+        out = []
+        for nkeys in (1, 3, 4, 9, 16, 40, 64):
+            vals = [1 + 3 * i for i in range(nkeys)]
+            ids, offs = ops.batched_point_lookup(
+                store, part.pid, part, "id", tm.version, vals, snap, 0,
+                force_device=force_device)
+            out.append((ids.tolist(), offs.tolist()))
+        return out
+
+    first = sweep(True)
+    ops.reset_compile_stats()
+    second = sweep(True)
+    assert ops.COMPILE_STATS["retraces"] == 0, ops.COMPILE_STATS
+    assert first == second
+    # the backend-adaptive host formulation (XLA:CPU) is bit-identical to
+    # the device program path
+    assert sweep(False) == first
+    # and the results agree with the sequential per-key probe
+    from galaxysql_tpu import native
+    vals = [1 + 3 * i for i in range(40)]
+    ids, offs = ops.batched_point_lookup(
+        store, part.pid, part, "id", tm.version, vals, snap, 0)
+    for j, v in enumerate(vals):
+        ref = part.key_candidates("id", v)
+        keep = part.valid["id"][ref] & native.visible_mask(
+            part.begin_ts[ref], part.end_ts[ref], snap, 0)
+        assert ids[offs[j]:offs[j + 1]].tolist() == ref[keep].tolist()
+
+
+def test_surfaces_and_metrics(sess):
+    """SHOW BATCH STATS, information_schema.batch_stats, and the metrics
+    registry all expose the batching counters/histograms."""
+    inst, s = sess
+    tpl = "SELECT amt FROM t WHERE id = %d"
+    _register(s, tpl, 1)
+    inst.config.set_instance("BATCH_WINDOW_US", 3000)
+
+    def worker(i):
+        sx = Session(inst, schema="bsx")
+        for j in range(4):
+            sx.execute(tpl % (1 + (i * 5 + j) % 2000))
+        sx.close()
+
+    errors = _run_threads(16, worker)
+    assert not errors, errors[:3]
+    stats = dict(s.execute("SHOW BATCH STATS").rows)
+    assert stats["batched_queries"] > 0
+    assert stats["batch_flushes"] > 0
+    assert stats["group_size_p50"] >= 1
+    assert 0.0 <= stats["hit_ratio"] <= 1.0
+    r = s.execute("SELECT stat_name, value FROM information_schema.batch_stats")
+    names = {n for n, _ in r.rows}
+    assert {"batched_queries", "batch_flushes", "group_size_p50",
+            "window_occupancy"} <= names
+    metric_names = {n for n, _k, _v, _h in inst.metrics.rows()}
+    assert "batched_queries" in metric_names
+    assert "batch_group_size_p50" in metric_names
+    assert "batch_wait_ms_p95" in metric_names
+    # Prometheus exposition carries the summaries
+    text = inst.metrics.prometheus_text()
+    assert "galaxysql_batch_group_size" in text
+
+
+def test_escape_hatches(sess):
+    """ENABLE_BATCH_SCHEDULER=0 keeps every query on the sequential path;
+    the BATCH(OFF) hint parses and structurally avoids the batched plan."""
+    inst, s = sess
+    tpl = "SELECT amt FROM t WHERE id = %d"
+    _register(s, tpl, 7)
+    inst.config.set_instance("ENABLE_BATCH_SCHEDULER", 0)
+    inst.config.set_instance("BATCH_WINDOW_US", 3000)
+    before = inst.metrics.counter("batched_queries").value
+
+    def worker(i):
+        sx = Session(inst, schema="bsx")
+        assert sx.execute(tpl % (10 + i)).rows == [(10 + i + 0.25,)]
+        sx.close()
+
+    errors = _run_threads(8, worker)
+    assert not errors, errors[:3]
+    assert inst.metrics.counter("batched_queries").value == before
+    inst.config.set_instance("ENABLE_BATCH_SCHEDULER", 1)
+    # the hint parses...
+    from galaxysql_tpu.sql.hints import parse_hints
+    assert parse_hints("/*+TDDL: BATCH(OFF)*/")["batch"] == "off"
+    # ...and a hinted statement stays correct on the planned path
+    r = s.execute("/*+TDDL: BATCH(OFF)*/ SELECT amt FROM t WHERE id = 7")
+    assert r.rows == [(7.25,)]
+    prof = inst.profiles.entries()[-1]
+    assert prof.engine != "batch"

@@ -55,6 +55,27 @@ class TestTwoCoordinators:
         assert leader.scheduler.run_due() == []
         assert follower.scheduler.run_due() == []
 
+    def test_peer_grant_revoke_invalidates_decision_cache(self, gms_dir):
+        # privilege decision caches (meta/privileges.py) are per-Instance;
+        # peers share only the metadb, so mutations must broadcast the
+        # invalidate_privilege_cache sync action or a peer serves stale auth
+        a = Instance(data_dir=gms_dir)
+        b = Instance(data_dir=gms_dir)
+        a.sync_bus.attach(b.sync_peer())
+        b.sync_bus.attach(a.sync_peer())
+        sa = Session(a)
+        sa.execute("CREATE DATABASE p")
+        sa.execute("CREATE USER 'u' IDENTIFIED BY 'pw'")
+        # warm B's cache with the DENIED decision, then grant on A
+        assert not b.privileges.has_privilege("u", "SELECT", "p", "t")
+        sa.execute("USE p")
+        sa.execute("GRANT SELECT ON p.t TO 'u'")
+        assert b.privileges.has_privilege("u", "SELECT", "p", "t")
+        # warm the ALLOWED decision, revoke on A: B must deny again
+        sa.execute("REVOKE SELECT ON p.t FROM 'u'")
+        assert not b.privileges.has_privilege("u", "SELECT", "p", "t")
+        sa.close()
+
     def test_config_listener_propagates(self, gms_dir):
         a = Instance(data_dir=gms_dir)
         b = Instance(data_dir=gms_dir)
