@@ -52,5 +52,14 @@ batch-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m batching -p no:cacheprovider
 	JAX_PLATFORMS=cpu BENCH_BATCH_SESSIONS=100,1000 $(PY) bench.py --batch-only
 
+# chaos smoke: the fault-injection suite over a real worker subprocess —
+# retry transparency + dedupe-window exactly-once (reply-leg drop), circuit
+# breaker open/half-open/closed, MAX_EXECUTION_TIME deadline kills, sync-epoch
+# cache healing, XA crash-restart recovery, replica read failover, and the
+# fixed-seed fault-schedule matrix driving TPC-H Q5 + concurrent point DML
+# (bit-identical-or-typed-error, zero hangs, zero double-applies)
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos -p no:cacheprovider
+
 .PHONY: tier1 fusion-smoke obs-smoke rf-smoke cache-smoke trace-smoke bench \
-	batch-smoke
+	batch-smoke chaos-smoke

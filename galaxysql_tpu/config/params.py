@@ -84,6 +84,28 @@ CCL_MAX_CONCURRENCY = _p("CCL_MAX_CONCURRENCY", 0, "0 = unlimited")
 CCL_WAIT_QUEUE_SIZE = _p("CCL_WAIT_QUEUE_SIZE", 64, "")
 CCL_WAIT_TIMEOUT = _p("CCL_WAIT_TIMEOUT", 10_000, "ms")
 
+# --- fault tolerance ----------------------------------------------------------
+MAX_EXECUTION_TIME = _p(
+    "MAX_EXECUTION_TIME", 0,
+    "per-query deadline in ms (0 = unlimited): checked at operator drain / "
+    "fused-segment / MPP-stage boundaries, propagated in worker RPC headers; "
+    "past-deadline queries die with a typed QueryTimeoutError")
+RPC_MAX_RETRIES = _p(
+    "RPC_MAX_RETRIES", 2,
+    "extra attempts after a transport failure on retry-safe worker RPCs "
+    "(reads, idempotent control ops, uid-stamped DML)")
+RPC_RETRY_BACKOFF_MS = _p(
+    "RPC_RETRY_BACKOFF_MS", 20,
+    "base for the capped exponential retry backoff (full jitter; the first "
+    "retry reconnects immediately — the worker may simply have restarted)")
+BREAKER_FAILURE_THRESHOLD = _p(
+    "BREAKER_FAILURE_THRESHOLD", 3,
+    "consecutive transport failures before a worker's circuit breaker opens")
+BREAKER_COOLDOWN_MS = _p(
+    "BREAKER_COOLDOWN_MS", 1000,
+    "open-state hold before the breaker half-opens (one ping probe decides "
+    "closed vs re-open); while open, requests fast-fail typed")
+
 # --- misc ---------------------------------------------------------------------
 SQL_SELECT_LIMIT = _p("SQL_SELECT_LIMIT", -1, "-1 = unlimited")
 SLOW_SQL_MS = _p("SLOW_SQL_MS", 1000, "slow query log threshold")

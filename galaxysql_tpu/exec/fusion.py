@@ -406,9 +406,16 @@ class FusedPipelineOp(ops.Operator):
     """Streaming operator applying one FusedSegment per batch — replaces a
     stack of FilterOp/ProjectOp instances with a single program dispatch."""
 
-    def __init__(self, child: ops.Operator, segment: FusedSegment):
+    def __init__(self, child: ops.Operator, segment: FusedSegment, ctx=None):
         self.child = child
         self.segment = segment
+        self.ctx = ctx  # ExecContext (deadline checks); None in unit tests
+
+    def _gate(self):
+        # fused-segment dispatch boundary: a MAX_EXECUTION_TIME deadline
+        # aborts typed BEFORE the next program dispatch (None = one attr read)
+        if self.ctx is not None:
+            self.ctx.check_deadline()
 
     def batches(self):
         it = self.child.batches()
@@ -422,8 +429,10 @@ class FusedPipelineOp(ops.Operator):
             yield first
             yield from it
             return
+        self._gate()
         yield self.segment.run_batch(first)
         for b in it:
+            self._gate()
             yield self.segment.run_batch(b)
 
 

@@ -19,18 +19,33 @@ bound port on stdout so a parent can attach).
 from __future__ import annotations
 
 import argparse
+import collections
+import os as _os
 import socket
 import sys
 import threading
+import time as _time
 import traceback
-from typing import Dict
+from typing import Dict, Optional
 
 import numpy as np
 
 from galaxysql_tpu.net.dn import recv_msg, send_msg
+from galaxysql_tpu.utils.failpoint import FAIL_POINTS, FP_WORKER_CRASH
 
 
 class Worker:
+    # bounded exactly-once window: uid -> recorded response.  Sized so a
+    # coordinator's retry horizon (seconds) fits comfortably; an evicted uid
+    # re-applying would need a retry delayed past 1024 newer writes.
+    # In-process by design: the exactly-once guarantee is scoped to a worker
+    # process lifetime — transactional DML that must survive a crash rides
+    # the XA branch protocol (an uncommitted branch dies with the process),
+    # and autocommit uid writes retry within milliseconds while a worker
+    # restart takes seconds, so a crash lands those retries on a closed
+    # port (typed failure), not on a fresh window.
+    DEDUPE_WINDOW = 1024
+
     def __init__(self, data_dir=None):
         from galaxysql_tpu.server.instance import Instance
         self.instance = Instance(data_dir=data_dir)
@@ -38,14 +53,170 @@ class Worker:
         self._lock = threading.Lock()
         # open distributed-txn branches: xid -> Session with an open local txn
         self._branches: Dict[str, object] = {}
+        # per-branch execution locks: a deadline-killed coordinator may send
+        # xa_rollback on a fresh connection while the branch's DML is STILL
+        # executing on another thread — the rollback must wait for the
+        # in-flight statement, not tear the session out from under it
+        self._branch_locks: Dict[str, threading.RLock] = {}
+        # resolved-branch tombstones: a late DML that lost the lock race to
+        # its own txn's rollback must NOT auto-recreate the branch (an
+        # orphaned open txn invisible to xa_recover); bounded like the
+        # dedupe window — xids are unique per txn, never legitimately reused
+        self._resolved_xids: "collections.OrderedDict[str, bool]" = \
+            collections.OrderedDict()
+        # idempotency dedupe window: uid-stamped writes record their response
+        # so a reconnect replay returns the recorded result instead of
+        # double-applying (the coordinator's retry policy relies on this)
+        self._dedupe: "collections.OrderedDict[str, tuple]" = \
+            collections.OrderedDict()
+        self.dedupe_hits = 0
+        # sync-epoch plane: origin node -> last-applied broadcast epoch
+        # (persisted in the metadb so a restart keeps the gap detector armed)
+        self._sync_epochs: Dict[str, int] = {}
+        self.heals = 0
 
     # -- request handlers ----------------------------------------------------
 
     def handle(self, header: dict, arrays: Dict[str, np.ndarray]):
+        if FAIL_POINTS.active and FAIL_POINTS.rpc_spec(
+                FP_WORKER_CRASH, header.get("op")) is not None:
+            print(f"FP_WORKER_CRASH fired on {header.get('op')}",
+                  file=sys.stderr, flush=True)
+            _os._exit(137)  # hard crash: no atexit, no flush — chaos realism
+        origin, se = header.get("origin"), header.get("se")
+        be = header.get("bcast_epoch")
+        want_heal = bool(header.get("heal"))  # coordinator-tracked miss
+        epoch = None
+        if origin and (se is not None or be is not None):
+            origin = str(origin)
+            epoch = int(be if be is not None else se)
+            want_heal |= self._sync_epoch_gap(origin, epoch,
+                                              is_bcast=be is not None)
+        if want_heal:
+            # heal BEFORE the epoch advances: a failed invalidation raises,
+            # the request fails, nothing is recorded — the coordinator keeps
+            # its needs_heal flag and the next request retries the heal
+            self._heal_caches()
+        if epoch is not None:
+            self._note_sync_epoch(origin, epoch)
+        dl = header.get("deadline_ms")
+        if dl is not None:
+            # remaining-budget form survives clock skew between processes;
+            # handlers check the absolute worker-local deadline
+            header["_deadline"] = _time.time() + max(0, int(dl)) / 1000.0
         tr = header.get("trace")
         if tr:
             return self._handle_traced(header, arrays, tr)
         return self._handle(header, arrays)
+
+    # -- sync-epoch healing --------------------------------------------------
+
+    def _last_sync_epoch(self, origin: str) -> Optional[int]:
+        """Caller holds self._lock."""
+        last = self._sync_epochs.get(origin)
+        if last is None:
+            v = self.instance.metadb.kv_get(f"sync.epoch.{origin}")
+            last = int(v) if v is not None else None
+        return last
+
+    def _sync_epoch_gap(self, origin: str, se: int, is_bcast: bool) -> bool:
+        """Detect missed SyncBus broadcasts; returns True when a heal is due
+        (does NOT advance the stored mark — that happens only after a due
+        heal succeeded, or a partially-failed heal would be recorded as
+        done and the stale-cache hole would silently reopen).
+
+        Only NON-broadcast requests drive the gap check: they carry the
+        coordinator's SETTLED epoch (every broadcast through it has
+        completed delivery), so anything beyond this worker's last-applied
+        mark means an invalidation never arrived.  Broadcast deliveries
+        merely advance the mark — concurrent broadcasts race each other's
+        client-lock acquisition, so out-of-order arrival is normal, not a
+        gap (a genuinely FAILED delivery is covered by the coordinator's
+        needs_heal flag)."""
+        with self._lock:
+            last = self._last_sync_epoch(origin)
+            return not is_bcast and last is not None and se > last
+
+    def _note_sync_epoch(self, origin: str, se: int):
+        with self._lock:
+            last = self._last_sync_epoch(origin)
+            if last is None or se > last:
+                self._sync_epochs[origin] = se
+                self.instance.metadb.kv_put(f"sync.epoch.{origin}", str(se))
+
+    def _heal_caches(self):
+        """Wholesale invalidation (missed-broadcast repair).  Failures
+        PROPAGATE: the request must fail rather than record a half-done
+        heal as success."""
+        from galaxysql_tpu.utils.metrics import SYNC_HEALS
+        inst = self.instance
+        inst.planner.cache.invalidate_all()
+        inst.frag_cache.clear()
+        inst.privileges.invalidate_cache()
+        with self._lock:
+            self.heals += 1
+        SYNC_HEALS.inc()
+
+    # -- idempotency dedupe window -------------------------------------------
+
+    def _dedupe_execute(self, uid: Optional[str], fn):
+        """Exactly-once execution for uid-stamped writes, including the
+        CONCURRENT-replay race: a reconnect retry can arrive on a fresh
+        connection while the original request is still executing (reply-leg
+        loss + immediate retry), so the window holds an in-flight marker —
+        the racer parks on the owner's event and replays the recorded
+        outcome instead of running the statement a second time."""
+        if not uid:
+            return fn()
+        while True:
+            with self._lock:
+                ent = self._dedupe.get(uid)
+                if ent is None:
+                    ev = threading.Event()
+                    self._dedupe[uid] = ("pending", ev, None)
+                    break  # this request owns the execution
+            if ent[0] == "done":
+                with self._lock:
+                    self.dedupe_hits += 1
+                resp = dict(ent[1])
+                resp["dedup"] = True
+                return resp, ent[2]
+            # in flight: wait for the owner to settle, then re-check (a
+            # FAILED owner removes the entry and the racer executes fresh)
+            if not ent[1].wait(timeout=120.0):
+                # the original is STILL running: its outcome is unknown to
+                # this replay — flag ambiguity so a write caller takes the
+                # unknown-outcome path instead of "statement failed, nothing
+                # applied" (the original may yet commit)
+                return {"error": f"duplicate of uid {uid} still executing",
+                        "ambiguous": True}, {}
+        try:
+            resp, out = fn()
+        except Exception:
+            with self._lock:
+                self._dedupe.pop(uid, None)
+            ev.set()
+            raise
+        with self._lock:
+            if resp.get("error"):
+                # failures are not recorded: nothing applied, a retry may
+                # legitimately re-execute
+                self._dedupe.pop(uid, None)
+            else:
+                self._dedupe[uid] = ("done", dict(resp), out)
+                self._dedupe.move_to_end(uid)
+                while len(self._dedupe) > self.DEDUPE_WINDOW:
+                    # evict the oldest SETTLED entry; in-flight markers are
+                    # skipped (never evicted) but must not dam the window —
+                    # a hung statement at the head would otherwise let it
+                    # grow without bound
+                    victim = next((k for k, v in self._dedupe.items()
+                                   if v[0] != "pending"), None)
+                    if victim is None:
+                        break  # only in-flight markers remain
+                    del self._dedupe[victim]
+        ev.set()
+        return resp, out
 
     def _handle_traced(self, header: dict, arrays: Dict[str, np.ndarray],
                        tr: dict):
@@ -69,6 +240,30 @@ class Worker:
         op = header.get("op")
         if op == "ping":
             return {"ok": True, "node": self.instance.node_id}, {}
+        def _deadline_gate():
+            dl = header.get("_deadline")
+            if dl is not None and _time.time() > dl:
+                from galaxysql_tpu.utils import errors
+                # the propagated deadline passed: abort BEFORE doing work.
+                # `unapplied` tells the coordinator nothing executed, so a
+                # write caller keeps statement-scoped semantics.
+                return {"error": f"deadline exceeded before {op}",
+                        "errno": errors.QueryTimeoutError.errno,
+                        "unapplied": True}, {}
+            return None
+
+        uid = header.get("uid") if op in ("dml", "exec_sql") else None
+        if uid:
+            # dedupe replay outranks the deadline check: a retry of an
+            # already-applied write must report the recorded SUCCESS — a
+            # timeout answer would tell the client a write failed that its
+            # branch will commit (replay costs nothing anyway)
+            handler = self._exec_sql if op == "exec_sql" else self._dml
+            return self._dedupe_execute(
+                uid, lambda: _deadline_gate() or handler(header))
+        gated = _deadline_gate()
+        if gated is not None:
+            return gated
         if op == "exec_sql":
             return self._exec_sql(header)
         if op == "sync":
@@ -90,27 +285,79 @@ class Worker:
     # -- distributed-txn branch ops (the DN side of TsoTransaction 2PC,
     # TsoTransaction.java:166-216: per-shard XA PREPARE/COMMIT) --------------
 
+    def _branch_lock(self, xid: str) -> threading.RLock:
+        with self._lock:
+            lk = self._branch_locks.get(xid)
+            if lk is None:
+                lk = self._branch_locks[xid] = threading.RLock()
+            return lk
+
+    def _tombstone_branch(self, xid: str):
+        """Record a resolved xid (called INSIDE the branch lock so a parked
+        DML observes it the moment it wakes)."""
+        with self._lock:
+            self._resolved_xids[xid] = True
+            while len(self._resolved_xids) > self.DEDUPE_WINDOW * 4:
+                self._resolved_xids.popitem(last=False)
+
     def _dml(self, header: dict):
         """Execute shipped DML inside the branch's open local transaction."""
         from galaxysql_tpu.server.session import Session
         xid = header["xid"]
-        with self._lock:
-            self.queries.append(header["sql"])
-            s = self._branches.get(xid)
-            if s is None:
-                s = Session(self.instance, schema=header.get("schema") or None)
-                s.autocommit = False
-                s._begin()
-                self._branches[xid] = s
-        if header.get("schema"):
-            s.schema = header["schema"]
-        rs = s.execute(header["sql"], header.get("params") or [])
-        return {"ok": True, "affected": rs.affected}, {}
+        with self._branch_lock(xid):
+            with self._lock:
+                self.queries.append(header["sql"])
+                s = self._branches.get(xid)
+                if s is None and xid in self._resolved_xids:
+                    # this branch already committed/rolled back — a late DML
+                    # that lost the lock race must not resurrect it as an
+                    # orphaned open transaction
+                    return {"error":
+                            f"branch {xid!r} already resolved"}, {}
+                if s is None:
+                    s = Session(self.instance,
+                                schema=header.get("schema") or None)
+                    s.autocommit = False
+                    s._begin()
+                    self._branches[xid] = s
+            if header.get("schema"):
+                s.schema = header["schema"]
+            rs = self._with_deadline(
+                s, header.get("_deadline"),
+                lambda: s.execute(header["sql"], header.get("params") or []))
+            return {"ok": True, "affected": rs.affected}, {}
+
+    _UNSET = object()
+
+    @classmethod
+    def _with_deadline(cls, sess, deadline, fn):
+        """Run `fn` with the remaining deadline budget handed to the nested
+        session as its own MAX_EXECUTION_TIME (drain-boundary checks enforce
+        it); shared by the shipped-SQL and branch-DML handlers.  Branch
+        sessions are long-lived, so any pre-existing session value is
+        restored, not dropped."""
+        if deadline is None:
+            return fn()
+        prior = sess.vars.get("MAX_EXECUTION_TIME", cls._UNSET)
+        sess.vars["MAX_EXECUTION_TIME"] = \
+            max(1, int((deadline - _time.time()) * 1000))
+        try:
+            return fn()
+        finally:
+            if prior is cls._UNSET:
+                sess.vars.pop("MAX_EXECUTION_TIME", None)
+            else:
+                sess.vars["MAX_EXECUTION_TIME"] = prior
 
     def _xa_prepare(self, header: dict):
         import json
         from galaxysql_tpu.txn.xa import participants_of
         xid = header["xid"]
+        with self._branch_lock(xid):
+            return self._xa_prepare_locked(header, xid, json,
+                                           participants_of)
+
+    def _xa_prepare_locked(self, header, xid, json, participants_of):
         s = self._branches.get(xid)
         if s is None or s.txn is None:
             return {"ok": False, "error": f"unknown branch {xid!r}"}, {}
@@ -166,6 +413,16 @@ class Worker:
         import json
         from galaxysql_tpu.txn.xa import participants_of
         xid = header["xid"]
+        with self._branch_lock(xid):
+            out = self._xa_commit_locked(header, xid, json, participants_of)
+            self._tombstone_branch(xid)
+        with self._lock:
+            # branch resolved: drop its lock entry (unique xids would
+            # otherwise leak one RLock per distributed txn forever)
+            self._branch_locks.pop(xid, None)
+        return out
+
+    def _xa_commit_locked(self, header, xid, json, participants_of):
         commit_ts = int(header["commit_ts"])
         # the coordinator's TSO is the clock: local snapshots must advance past
         # the commit stamp or the new rows would be invisible to local reads
@@ -197,6 +454,17 @@ class Worker:
         import json
         from galaxysql_tpu.txn.xa import participants_of
         xid = header["xid"]
+        # serialized against an in-flight _dml on the same branch: roll back
+        # only AFTER the statement settles, never mid-execution
+        with self._branch_lock(xid):
+            out = self._xa_rollback_locked(header, xid, json,
+                                           participants_of)
+            self._tombstone_branch(xid)
+        with self._lock:
+            self._branch_locks.pop(xid, None)  # branch resolved
+        return out
+
+    def _xa_rollback_locked(self, header, xid, json, participants_of):
         s = self._branches.pop(xid, None)
         if s is not None and s.txn is not None:
             txn = s.txn
@@ -246,17 +514,19 @@ class Worker:
         # must keep the same txn visibility the fragment path has)
         branch = self._branches.get(header.get("xid")) \
             if header.get("xid") else None
+        dl = header.get("_deadline")
         if branch is not None:
             if header.get("schema"):
                 branch.schema = header["schema"]
             with scope("execute"):
-                rs = branch.execute(sql)
+                rs = self._with_deadline(branch, dl,
+                                         lambda: branch.execute(sql))
             with scope("serialize"):
                 return self._serialize_rs(rs)
         s = Session(self.instance, schema=header.get("schema") or None)
         try:
             with scope("execute"):
-                rs = s.execute(sql)
+                rs = self._with_deadline(s, dl, lambda: s.execute(sql))
             with scope("serialize"):
                 return self._serialize_rs(rs)
         finally:
@@ -386,7 +656,8 @@ class Worker:
         with scan_scope:
             err = self._exec_plan_scan(f, store, snapshot, txn_id, lane_point,
                                        point, sargs, since, del_of, cols_out,
-                                       valid_out, deleted_keys, rf_clock)
+                                       valid_out, deleted_keys, rf_clock,
+                                       deadline=header.get("_deadline"))
         if err is not None:
             return err, {}
         if rf_clock is not None:
@@ -401,9 +672,16 @@ class Worker:
 
     def _exec_plan_scan(self, f, store, snapshot, txn_id, lane_point, point,
                         sargs, since, del_of, cols_out, valid_out,
-                        deleted_keys, rf_clock):
+                        deleted_keys, rf_clock, deadline=None):
         import time as _t
+        from galaxysql_tpu.utils import errors as _err
         for p in store.partitions:
+            if deadline is not None and _t.time() > deadline:
+                # partition boundary = the worker's drain boundary: abort the
+                # fragment typed instead of finishing a doomed scan
+                raise _err.QueryTimeoutError(
+                    f"fragment deadline exceeded scanning "
+                    f"{f['schema']}.{f['table']}")
             if p.num_rows == 0:
                 continue
             with p.lock:
@@ -522,6 +800,24 @@ class Worker:
         if action == "query_log":
             with self._lock:
                 return {"ok": True, "queries": list(self.queries)}, {}
+        if action == "failpoint":
+            # remote fault arming for the chaos harness: the coordinator (or
+            # a test) plants worker-side failpoints (e.g. FP_WORKER_CRASH)
+            if payload.get("clear"):
+                FAIL_POINTS.clear()
+            elif payload.get("disarm"):
+                FAIL_POINTS.disarm(payload["key"])
+            else:
+                FAIL_POINTS.arm(payload["key"], payload.get("value", True))
+            return {"ok": True, "action": action}, {}
+        if action == "worker_stats":
+            # fault-tolerance observability: dedupe window, sync-epoch heals
+            with self._lock:
+                return {"ok": True, "node": inst.node_id,
+                        "dedupe_entries": len(self._dedupe),
+                        "dedupe_hits": self.dedupe_hits,
+                        "heals": self.heals,
+                        "sync_epochs": dict(self._sync_epochs)}, {}
         return {"error": f"unknown sync action {action!r}"}, {}
 
     # -- server loop ---------------------------------------------------------
@@ -539,6 +835,7 @@ class Worker:
                              daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket):
+        from galaxysql_tpu.utils import errors as _err
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
             while True:
@@ -547,10 +844,25 @@ class Worker:
                     resp, out = self.handle(header, arrays)
                 except Exception as e:
                     traceback.print_exc(file=sys.stderr)
-                    resp, out = {"error": f"{type(e).__name__}: {e}"}, {}
-                send_msg(conn, resp, out)
+                    # typed errors keep their errno across the wire so the
+                    # coordinator re-raises the same class (QueryTimeoutError
+                    # must not come back as a generic TddlError)
+                    resp, out = {"error": f"{type(e).__name__}: {e}",
+                                 "errno": int(getattr(e, "errno", 1105)
+                                              or 1105)}, {}
+                try:
+                    send_msg(conn, resp, out)
+                except _err.ProtocolError as pe:
+                    # the RESULT was oversized: encode_msg rejected it before
+                    # any byte shipped, so the stream is still aligned —
+                    # reply typed instead of dropping a healthy connection
+                    # (and triggering coordinator retries of the same query)
+                    send_msg(conn, {"error": str(pe), "errno": pe.errno}, {})
         except (ConnectionError, OSError):
             pass
+        except _err.ProtocolError:
+            # corrupt frame: the stream is unrecoverable — drop the conn
+            traceback.print_exc(file=sys.stderr)
         finally:
             conn.close()
 

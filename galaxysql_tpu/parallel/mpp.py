@@ -149,6 +149,9 @@ class MppExecutor:
 
     def run(self, node: L.RelNode) -> DistBatch:
         from galaxysql_tpu.utils import tracing
+        # MPP stage boundary: a deadline-killed query aborts between stages
+        # with a typed error instead of dispatching the rest of the plan
+        self.ctx.check_deadline()
         tc = tracing.current()
         collecting = getattr(self.ctx, "collect_stats", False)
         if tc is None:

@@ -70,6 +70,19 @@ class ExecContext:
         # store uids this execution's txn has written (session fills it in);
         # None with a live txn means "unknown write set" — the cache bypasses
         self.txn_write_uids = frozenset() if txn_id == 0 else None
+        # MAX_EXECUTION_TIME deadline (absolute time.time() seconds, or None):
+        # checked at operator drain / fused-segment / MPP-stage boundaries and
+        # propagated to workers as the remaining budget in RPC headers
+        self.deadline: Optional[float] = None
+
+    def check_deadline(self):
+        """Raise a typed QueryTimeoutError once the deadline passes.  Called
+        at pipeline boundaries — a None deadline costs one attribute read."""
+        if self.deadline is not None:
+            import time as _t
+            if _t.time() > self.deadline:
+                raise errors.QueryTimeoutError(
+                    "query exceeded MAX_EXECUTION_TIME deadline")
 
 
 # per-(store, version) scan metadata: O(table) host reductions must run once per
@@ -119,6 +132,7 @@ class ScanSource(ops.Operator):
 
     def batches(self) -> Iterator[ColumnBatch]:
         t = self.node.table
+        self.ctx.check_deadline()  # drain boundary: scans feed every pipeline
         if getattr(t, "remote", None) is not None:
             yield from self._remote_batches(t)
             return
@@ -142,6 +156,7 @@ class ScanSource(ops.Operator):
         if cache is None:
             for b in store.scan(storage_cols, self.node.partitions,
                                 snap, txn_id=txn_id):
+                self.ctx.check_deadline()  # per-partition drain boundary
                 # pad to power-of-two buckets: partitions of different sizes must not
                 # each compile their own kernel shapes
                 yield b.pad_to(bucket_capacity(b.capacity)).rename(rename)
@@ -248,7 +263,8 @@ class ScanSource(ops.Operator):
                 f"remote table {t.name} needs an owning instance context")
         # weighted read routing over primary + replicas with fence-triggered
         # failover (TGroupDataSource analog): a request failure fences the
-        # endpoint and retries another until none remain
+        # endpoint and retries another until none remain — WITHIN the same
+        # statement, so a dead replica costs a re-route, not an error
         last_err = None
         for _attempt in range(1 + len(getattr(t, "replicas", []))):
             addr, client = inst.read_endpoint(t)
@@ -258,13 +274,34 @@ class ScanSource(ops.Operator):
                 got = list(self._remote_batches_from(t, inst, addr, client))
                 yield from got
                 return
+            except errors.QueryTimeoutError:
+                raise  # the deadline kills the STATEMENT, not the endpoint
             except (errors.TddlError, ConnectionError, OSError) as e:
                 last_err = e
-                if not client.ping():
+                transport = isinstance(
+                    e, (errors.WorkerUnavailableError, ConnectionError,
+                        OSError))
+                if transport and not client.ping():
+                    # ping-verified dead: fence and re-route — a transient
+                    # blip (worker restarting, half-open probe race) must
+                    # not fence an endpoint the next ping proves alive
+                    from galaxysql_tpu.utils.metrics import WORKER_FAILOVERS
                     inst.ha.fence_worker(addr, True)
-                    continue  # endpoint alive but errored: a real error
+                    WORKER_FAILOVERS.inc()
+                    self.ctx.trace.append(
+                        f"failover {t.name}: fenced {addr[0]}:{addr[1]}")
+                    continue  # endpoint dead: re-route within the statement
+                if transport:
+                    # alive but erroring (breaker mid-recovery): re-route
+                    # this statement without fencing
+                    from galaxysql_tpu.utils.metrics import WORKER_FAILOVERS
+                    WORKER_FAILOVERS.inc()
+                    self.ctx.trace.append(
+                        f"failover {t.name}: rerouted off "
+                        f"{addr[0]}:{addr[1]} (alive)")
+                    continue
                 raise
-        raise errors.TddlError(
+        raise errors.WorkerUnavailableError(
             f"remote table {t.name}: no serving endpoint ({last_err})")
 
     def _remote_batches_from(self, t, inst, addr, client
@@ -295,10 +332,16 @@ class ScanSource(ops.Operator):
         if pe is not None and not t.column(pe[0]).dtype.is_string and \
                 isinstance(pe[1], (int, np.integer)):
             frag["point"] = [pe[0], int(pe[1])]
+        dl = self.ctx.deadline
         try:
-            names, rtypes, data, valid = client.exec_plan(frag)
+            names, rtypes, data, valid = client.exec_plan(frag, deadline=dl)
             self.ctx.trace.append(
                 f"remote-plan {t.name} -> {addr[0]}:{addr[1]}")
+        except (errors.QueryTimeoutError, errors.WorkerUnavailableError):
+            # degrade ladder stops typed: a dead endpoint fails over (the
+            # caller re-routes), a blown deadline kills the statement —
+            # re-shipping as SQL text would help neither
+            raise
         except errors.TddlError:
             sql = (f"SELECT {', '.join(storage_cols)} FROM "
                    f"{t.schema}.{t.name}")
@@ -306,7 +349,8 @@ class ScanSource(ops.Operator):
                 f"remote-scan {t.name} -> {addr[0]}:{addr[1]}")
             # the degrade path keeps the branch xid: txn visibility must not
             # depend on which wire form served the scan
-            names, rtypes, data, valid = client.execute(sql, t.schema, xid=xid)
+            names, rtypes, data, valid = client.execute(sql, t.schema, xid=xid,
+                                                        deadline=dl)
         scaled = {nm for nm, ty in zip(names, rtypes)
                   if isinstance(ty, str) and ty.endswith("#scaled")}
         n = len(next(iter(data.values()))) if data else 0
@@ -615,9 +659,10 @@ def _wrap_scan_rf(src: ops.Operator, node: L.Scan,
         # inner StatsOp keeps the scan's own (pre-filter) actual rows; the
         # SegmentStatsOp wrapper reports per-filter pruned counts
         return SegmentStatsOp(
-            fusion.FusedPipelineOp(StatsOp(src, node, ctx), seg), seg, [],
+            fusion.FusedPipelineOp(StatsOp(src, node, ctx), seg, ctx),
+            seg, [],
             ctx, rf_node=node)
-    return fusion.FusedPipelineOp(src, seg)
+    return fusion.FusedPipelineOp(src, seg, ctx)
 
 
 def _build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
@@ -638,7 +683,8 @@ def _build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
                                            rf=getattr(ctx, "rf", None))
             if seg is not None:
                 ctx.trace.append(f"fuse-segment {seg.chain}")
-                inner = fusion.FusedPipelineOp(build_operator(base, ctx), seg)
+                inner = fusion.FusedPipelineOp(build_operator(base, ctx), seg,
+                                               ctx)
                 if collecting:
                     return SegmentStatsOp(
                         inner, seg, fusion.chain_nodes(node), ctx,

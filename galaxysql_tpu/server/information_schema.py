@@ -65,6 +65,11 @@ _DEFS: Dict[str, List] = {
     # cross-session point-query batching (server/batch_scheduler.py):
     # group sizes, waits, hit ratio, window occupancy — SHOW BATCH STATS twin
     "batch_stats": [("stat_name", _V), ("value", _D)],
+    # attached worker endpoints: fence + circuit-breaker state and lifetime
+    # retry/failure counters (net/dn.WorkerClient; SHOW WORKERS twin)
+    "workers": [("host", _V), ("port", _I), ("breaker_state", _V),
+                ("fenced", _I), ("consec_failures", _I), ("retries", _I),
+                ("failures", _I), ("breaker_opens", _I), ("last_error", _V)],
 }
 
 
@@ -180,3 +185,4 @@ def refresh(instance, session=None):
     sched = getattr(instance, "batch_scheduler", None)
     fill("batch_stats", ([n, float(v)] for n, v in
                          (sched.stats_rows() if sched is not None else [])))
+    fill("workers", (list(r) for r in instance.worker_rows()))

@@ -59,13 +59,18 @@ class Instance:
         self.node_id = f"cn-{uuid.uuid4().hex[:8]}"
         from galaxysql_tpu.net.dn import SyncBus
         self.workers: Dict[tuple, object] = {}  # (host, port) -> WorkerClient
-        self.sync_bus = SyncBus()
+        # origin rides every RPC with the bus epoch: workers key their
+        # last-applied sync epoch per coordinator (net/worker sync healing)
+        self.sync_bus = SyncBus(origin=self.node_id)
         from galaxysql_tpu.meta.ha import HaManager
         self.ha = HaManager(self)
         from galaxysql_tpu.utils.metrics import (BATCH_GROUP_SIZE,
-                                                 BATCH_WAIT_MS,
-                                                 MetricsRegistry, RPC_RTT_MS,
-                                                 SEGMENT_WALL_MS)
+                                                 BATCH_WAIT_MS, BREAKER_OPENS,
+                                                 MetricsRegistry, QUERY_TIMEOUTS,
+                                                 RPC_FAILURES, RPC_RETRIES,
+                                                 RPC_RTT_MS, SEGMENT_WALL_MS,
+                                                 SYNC_FAILURES, SYNC_HEALS,
+                                                 WORKER_FAILOVERS)
         from galaxysql_tpu.utils.tracing import ProfileRing, TraceIdAllocator
         # typed counter/gauge registry: SQL (information_schema.metrics,
         # SHOW METRICS), web (/metrics Prometheus text) and the legacy
@@ -78,6 +83,11 @@ class Instance:
         self.metrics.adopt(RPC_RTT_MS)
         self.metrics.adopt(BATCH_GROUP_SIZE)
         self.metrics.adopt(BATCH_WAIT_MS)
+        # fault-tolerance plane counters (net/dn.py retry/breaker, SyncBus
+        # healing, deadline kills) — process-shared, surfaced per instance
+        for m in (RPC_RETRIES, RPC_FAILURES, BREAKER_OPENS, WORKER_FAILOVERS,
+                  SYNC_FAILURES, SYNC_HEALS, QUERY_TIMEOUTS):
+            self.metrics.adopt(m)
         self.metrics.histogram("query_latency_ms",
                                "end-to-end query latency (ms)")
         # node-prefixed trace-id mint: peer coordinators (sync_peer setups)
@@ -210,20 +220,44 @@ class Instance:
             self.next_conn_id += 1
             return cid
 
+    def worker_client(self, host: str, port: int):
+        """Get-or-create the WorkerClient for an endpoint, configured from
+        instance params (retry budget, breaker thresholds) and wired into the
+        sync bus — the ONE constructor for coordinator->worker connections."""
+        from galaxysql_tpu.net.dn import WorkerClient
+        key = (host, port)
+        client = self.workers.get(key)
+        if client is None:
+            # bind the live config: SET GLOBAL RPC_*/BREAKER_* hatches apply
+            # to already-attached workers, not just future attachments
+            client = WorkerClient(host, port, config=self.config)
+            self.workers[key] = client
+            self.sync_bus.attach(client)
+        return client
+
+    def worker_rows(self):
+        """SHOW WORKERS / information_schema.workers row source: one row per
+        attached worker with fence + circuit-breaker state and lifetime
+        retry/failure counters."""
+        rows = []
+        for (host, port), client in sorted(self.workers.items()):
+            bk = client.breaker_snapshot() if hasattr(client, "breaker_snapshot") \
+                else {"state": "closed", "consec_failures": 0, "opens": 0,
+                      "retries": 0, "failures": 0, "last_error": ""}
+            rows.append((host, port, bk["state"],
+                         1 if self.ha.worker_fenced((host, port)) else 0,
+                         bk["consec_failures"], bk["retries"], bk["failures"],
+                         bk["opens"], bk["last_error"]))
+        return rows
+
     def attach_remote_table(self, schema: str, name: str, host: str,
                             port: int):
         """Register a worker-process table: scans compile to shipped SQL
         (MyJdbcHandler.java:691 plan-shipping seam).  The worker is also wired
         into the sync-action bus and the HA prober."""
-        from galaxysql_tpu.net.dn import WorkerClient
         from galaxysql_tpu.types import datatype as dt
         from galaxysql_tpu.meta.catalog import ColumnMeta, TableMeta, SINGLE
-        key = (host, port)
-        client = self.workers.get(key)
-        if client is None:
-            client = WorkerClient(host, port)
-            self.workers[key] = client
-            self.sync_bus.attach(client)
+        client = self.worker_client(host, port)
         resp = client.sync_action("table_meta", {"schema": schema,
                                                  "table": name})
         # (re)attachment is the reconnect point: resolve any XA branches this
@@ -257,13 +291,8 @@ class Instance:
         table is missing or empty and trusts a pre-seeded identical copy
         otherwise; True forces the copy (rebuilding a STALE replica requires
         it); False trusts the caller unconditionally."""
-        from galaxysql_tpu.net.dn import WorkerClient
         key = (host, port)
-        client = self.workers.get(key)
-        if client is None:
-            client = WorkerClient(host, port)
-            self.workers[key] = client
-            self.sync_bus.attach(client)
+        client = self.worker_client(host, port)
         tm = self.catalog.table(schema, name)
         if getattr(tm, "remote", None) is None:
             raise ValueError(f"{schema}.{name} is not a remote table")
@@ -311,9 +340,12 @@ class Instance:
             for c in tm.columns)
         pk_sql = (f", PRIMARY KEY ({', '.join(tm.primary_key)})"
                   if tm.primary_key else "")
-        client.execute(f"CREATE DATABASE IF NOT EXISTS {schema}", "")
+        # IF NOT EXISTS makes these textually idempotent -> retry-safe
+        client.execute(f"CREATE DATABASE IF NOT EXISTS {schema}", "",
+                       idem=True)
         client.execute(
-            f"CREATE TABLE IF NOT EXISTS {name} ({cols_sql}{pk_sql})", schema)
+            f"CREATE TABLE IF NOT EXISTS {name} ({cols_sql}{pk_sql})", schema,
+            idem=True)
         cols = tm.column_names()
         # caller (attach_replica) holds the exclusive MDL: no concurrent DML
         names, types, data, valid = src.exec_plan(
@@ -350,8 +382,12 @@ class Instance:
                     ok_ = bool(valid[c][i]) if c in valid else True
                     vals.append(self._sql_literal(ty, data[c][i], ok_))
                 rows.append("(" + ", ".join(vals) + ")")
+            # uid-stamped: a reconnect retry of a backfill batch replays the
+            # recorded result (worker dedupe window) instead of double-
+            # inserting rows into the replica
             client.execute(f"INSERT INTO {table} ({', '.join(names)}) "
-                           f"VALUES {', '.join(rows)}", schema)
+                           f"VALUES {', '.join(rows)}", schema,
+                           uid=f"{self.node_id}:{self.trace_ids.next()}")
 
     def move_remote_table(self, schema: str, name: str, host: str, port: int):
         """Relocate a worker-resident table to another worker online.
@@ -364,26 +400,20 @@ class Instance:
         2. delta catchup + cutover under EXCLUSIVE MDL: rows inserted/deleted
            since the snapshot are replayed onto the target, then the table's
            primary endpoint swaps."""
-        from galaxysql_tpu.net.dn import WorkerClient
         tm = self.catalog.table(schema, name)
         if getattr(tm, "remote", None) is None:
             raise ValueError(f"{schema}.{name} is not a remote table")
         src = self.workers[(tm.remote["host"], tm.remote["port"])]
-        key = (host, port)
-        dst = self.workers.get(key)
-        if dst is None:
-            dst = WorkerClient(host, port)
-            self.workers[key] = dst
-            self.sync_bus.attach(dst)
+        dst = self.worker_client(host, port)
         # target bootstrap: schema + table shape from this CN's meta
         cols_sql = ", ".join(
             f"{c.name} {c.dtype.sql_name()}" + ("" if c.nullable else " NOT NULL")
             for c in tm.columns)
         pk_sql = (f", PRIMARY KEY ({', '.join(tm.primary_key)})"
                   if tm.primary_key else "")
-        dst.execute(f"CREATE DATABASE IF NOT EXISTS {schema}", "")
+        dst.execute(f"CREATE DATABASE IF NOT EXISTS {schema}", "", idem=True)
         dst.execute(f"CREATE TABLE IF NOT EXISTS {name} ({cols_sql}{pk_sql})",
-                    schema)
+                    schema, idem=True)
         cols = tm.column_names()
         mdl_key = f"{schema.lower()}.{name.lower()}"
         pk = tm.primary_key[0] if tm.primary_key else cols[0]
@@ -446,14 +476,30 @@ class Instance:
                 pk_type = dict(zip(resp["columns"], resp["types"]))[pk]
                 in_list = ", ".join(self._sql_literal(pk_type, k, True)
                                     for k in drop)
+                # the delta apply is idempotent by construction (delete-by-PK
+                # before re-insert), so the DELETE is retry-safe
                 dst.execute(f"DELETE FROM {name} WHERE {pk} IN ({in_list})",
-                            schema)
+                            schema, idem=True)
             self._bulk_insert_remote(dst, schema, name, resp["columns"],
                                      resp["types"], ddata, dvalid)
             tm.remote = {"host": host, "port": port}
             self.catalog.bump_schema()
         self.counters.inc("table_moves")
         return tm
+
+    def try_revive_worker(self, addr) -> bool:
+        """Lazy fence revival: ONE ping decides whether a fenced endpoint
+        recovered (no background prober exists in production — fencing must
+        not be forever).  Returns True when the endpoint is now unfenced.
+        Shared by read routing and the remote-DML primary gate so the HA
+        policy lives in one place."""
+        client = self.workers.get(addr)
+        if client is None or not self.ha.worker_fenced(addr):
+            return False
+        if client.ping(timeout=2.0):
+            self.ha.fence_worker(addr, False)
+            return True
+        return False
 
     def read_endpoint(self, tm):
         """Pick the endpoint to serve a read of `tm`: weighted random over the
@@ -466,10 +512,24 @@ class Instance:
         for r in tm.replicas:
             if not r.get("stale"):
                 cands.append(((r["host"], r["port"]), r.get("weight", 1)))
+        # breaker-blocked endpoints (open + cooling down) are as good as
+        # fenced for routing: picking one would only fast-fail and burn a
+        # failover attempt.  A cooled-down breaker stays routable — the next
+        # request half-opens it with a ping probe.
         live = [(a, w) for a, w in cands
-                if a in self.workers and not self.ha.worker_fenced(a)]
+                if a in self.workers and not self.ha.worker_fenced(a) and
+                not getattr(self.workers[a], "breaker_blocked",
+                            lambda: False)()]
         if not live:
-            raise _errors.TddlError(
+            # lazy fence revival: fencing has no background prober in
+            # production, so before refusing, ping each fenced candidate
+            # once and unfence responders (a recovered worker serves again
+            # at the first read that needs it)
+            for a, w in cands:
+                if self.try_revive_worker(a):
+                    live.append((a, w))
+        if not live:
+            raise _errors.WorkerUnavailableError(
                 f"remote table {tm.name}: every endpoint is fenced/unattached")
         total = sum(w for _, w in live)
         pick = random.random() * total

@@ -104,6 +104,10 @@ class Transaction:
 
 
 class Session:
+    # bound on each replica DML leg (a hung replica goes stale after this,
+    # it must not stall the statement for socket-timeout x retries)
+    REPLICA_DML_TIMEOUT_S = 30.0
+
     def __init__(self, instance: Instance, schema: Optional[str] = None):
         self.instance = instance
         self.conn_id = instance.allocate_conn_id()
@@ -115,6 +119,10 @@ class Session:
         self.user = "root"
         self.last_trace: List[str] = []
         self.last_spans: List[Any] = []  # last traced query's span tree
+        # per-statement MAX_EXECUTION_TIME deadline (absolute seconds, None =
+        # unlimited): set at statement entry, threaded into ExecContext and
+        # worker RPC headers
+        self._deadline: Optional[float] = None
         instance.sessions[self.conn_id] = self
 
     # -- public API -----------------------------------------------------------
@@ -158,6 +166,10 @@ class Session:
         r"^\s*(?:/\*.*?\*/\s*)*select\b", __import__("re").I | __import__("re").S)
 
     def _execute_one(self, sql: str, params: Optional[list]) -> ResultSet:
+        # statement deadline: one config lookup; MAX_EXECUTION_TIME=0 (the
+        # default) keeps the hot path at a None check everywhere downstream
+        ms = self.instance.config.get("MAX_EXECUTION_TIME", self.vars)
+        self._deadline = time.time() + ms / 1000.0 if ms else None
         if self._SELECT_RE.match(sql):
             # SELECT hot path: the plan cache keys on the PARAMETERIZED text and
             # carries the AST, so re-parsing the raw text (distinct per literal,
@@ -231,6 +243,14 @@ class Session:
         if isinstance(stmt, (ast.Select, ast.SetOpSelect)):
             return self._run_query(stmt, sql, params)
         if isinstance(stmt, (ast.Insert, ast.Update, ast.Delete)):
+            # the MAX_EXECUTION_TIME hint must bind DML too (the SELECT path
+            # reads it off the cached plan; DML has no plan cache) — it rides
+            # self._deadline into the remote-DML RPC headers
+            from galaxysql_tpu.sql.hints import parse_hints
+            hint_ms = parse_hints(getattr(stmt, "hints", None)) \
+                .get("max_execution_time")
+            if hint_ms:
+                self._deadline = time.time() + hint_ms / 1000.0
             # statement-scope shared MDL on every referenced table: a
             # repartition cutover cannot swap partition metadata under
             # in-flight DML
@@ -619,6 +639,9 @@ class Session:
         tracing.GLOBAL_STATS.bump("errors")
         inst.metrics.counter("query_errors",
                              "queries failed mid-execution").inc()
+        if isinstance(exc, _err.QueryTimeoutError):
+            from galaxysql_tpu.utils.metrics import QUERY_TIMEOUTS
+            QUERY_TIMEOUTS.inc()
         self.last_trace = [f"trace-id {prof.trace_id}",
                            f"error {prof.error}",
                            f"elapsed={elapsed:.3f}s"]
@@ -661,6 +684,10 @@ class Session:
                                                         self.vars)
         ctx.join_spill_bytes = self.instance.config.get("JOIN_SPILL_BYTES",
                                                         self.vars)
+        # MAX_EXECUTION_TIME deadline: the hint form overrides the session
+        # param for this statement (MySQL optimizer-hint semantics)
+        hint_ms = getattr(plan, "hints", {}).get("max_execution_time")
+        ctx.deadline = t0 + hint_ms / 1000.0 if hint_ms else self._deadline
         # query-scoped runtime statistics: the profile rides the ExecContext so
         # operators, fused segments, and MPP stages all report into it; stats
         # collection (device syncs!) only when profiling is asked for
@@ -901,9 +928,13 @@ class Session:
                         batch = MppExecutor(ctx, mesh).execute(plan.rel)
                         mpp_used = True
                         self.instance.counters.inc("mpp_queries")
-                    except errors.NotSupportedError as e:
-                        # plan shape not yet distributed: local engine — NEVER
-                        # silent (trace tag + information_schema.engine_counters)
+                    except (errors.NotSupportedError,
+                            errors.WorkerUnavailableError) as e:
+                        # plan shape not yet distributed, or a worker died
+                        # mid-MPP: local engine — NEVER silent (trace tag +
+                        # information_schema.engine_counters).  Data permits
+                        # by construction: MPP stages only read local stores
+                        # (remote scans raise NotSupportedError at planning).
                         batch = None
                         self.instance.counters.inc("mpp_fallback_local")
                         ctx.trace.append(f"mpp-fallback {e}")
@@ -1099,10 +1130,11 @@ class Session:
         if self.instance.workers.get(primary) is None:
             raise errors.TddlError(
                 f"remote table {tm.name}: no worker attached")
-        if self.instance.ha.worker_fenced(primary):
-            raise errors.TddlError(
+        if self.instance.ha.worker_fenced(primary) and \
+                not self.instance.try_revive_worker(primary):
+            raise errors.WorkerUnavailableError(
                 f"remote table {tm.name}: worker {primary[0]}:{primary[1]} "
-                "is fenced")
+                "is fenced", sent=False)
         # synchronous replication: the statement ships to the primary AND every
         # live replica as branches of the same distributed txn; a fenced
         # replica is marked stale and excluded from read routing until rebuilt
@@ -1118,16 +1150,93 @@ class Session:
         auto = self.txn is None
         self._begin()
         affected = 0
+        # idempotency token: the coordinator stamps one statement uid; the
+        # worker's dedupe window replays the recorded result on a reconnect
+        # retry, so the retry policy may re-send DML without double-applying
+        # (each endpoint keeps its own window, so one uid serves them all)
+        stmt_uid = f"{self.instance.node_id}:{self.instance.trace_ids.next()}"
         for addr in endpoints:
+            had_branch = addr in self.txn.remote
             xid = self.txn.remote.setdefault(addr, f"g{self.txn.txn_id}")
             try:
+                # only the PRIMARY rpc carries the statement deadline: once
+                # the primary applied, the statement is on its committed
+                # course and every replica must receive it (or be marked
+                # stale) — a statement-deadline kill between endpoints would
+                # leave a non-stale replica silently missing a write the txn
+                # later commits.  Replica legs still get a FIXED bound: a
+                # hung replica costs seconds (then goes stale), not the full
+                # socket timeout times the retry budget.
+                leg_deadline = self._deadline if addr == primary \
+                    else time.time() + self.REPLICA_DML_TIMEOUT_S
                 resp, _ = self.instance.workers[addr].request({
                     "op": "dml", "xid": xid, "schema": tm.schema,
-                    "sql": self._current_sql,
-                    "params": list(self._current_params or [])})
-                err = resp.get("error")
-            except (errors.TddlError, ConnectionError, OSError) as e:
+                    "sql": self._current_sql, "uid": stmt_uid,
+                    "params": list(self._current_params or [])},
+                    deadline=leg_deadline)
+                # request() raises on any error response, so reaching here
+                # means the statement APPLIED; worker-reported errors arrive
+                # via the except-TddlError branch below
+                err = None
+                ambiguous = False
+                reached = True
+            except errors.QueryTimeoutError as e:
+                if addr != primary:
+                    # a replica leg's BOUNDED wait tripped (hung replica):
+                    # same contract as any replica failure — mark it stale
+                    # below and let the statement succeed on the primary
+                    err = str(e)
+                    ambiguous = False
+                    reached = True
+                else:
+                    # A POST-send primary timeout means the branch outcome
+                    # is UNKNOWN — the write may have applied before the
+                    # reply was lost — so the only divergence-free answer is
+                    # to roll the transaction back (xa_rollback undoes an
+                    # applied-but-unacked branch write); and the client MUST
+                    # hear that the txn died (a statement-scoped 3024 would
+                    # let it "COMMIT" a rolled-back txn, silently losing
+                    # every other statement).  A PRE-send timeout provably
+                    # applied nothing: statement-scoped, the txn survives.
+                    from galaxysql_tpu.utils.metrics import QUERY_TIMEOUTS
+                    QUERY_TIMEOUTS.inc()  # DML kills count too, not just DQL
+                    if auto:
+                        self._rollback()
+                        raise
+                    if getattr(e, "sent", True):
+                        self._rollback()
+                        raise errors.TransactionError(
+                            f"query deadline exceeded with unknown branch "
+                            f"outcome; transaction rolled back: {e}")
+                    if not had_branch:
+                        self.txn.remote.pop(addr, None)  # never opened
+                    raise
+            except errors.ProtocolError as e:
+                # a corrupt REPLY frame means the worker executed and the
+                # outcome is unknown; an OUTBOUND validation failure
+                # (_gx_sent False: the frame never shipped) provably applied
+                # nothing and stays statement-scoped
                 err = str(e)
+                reached = bool(getattr(e, "_gx_sent", True))
+                ambiguous = reached
+            except (errors.WorkerUnavailableError, ConnectionError,
+                    OSError) as e:
+                err = str(e)
+                # transport-level death: ambiguous ONLY if bytes may have
+                # reached the worker (the write may have applied before the
+                # reply was lost).  A breaker fast-fail / connect-refused
+                # failure (sent=False) provably applied nothing — the txn
+                # can keep statement-scoped semantics.
+                reached = bool(getattr(e, "sent", True))
+                ambiguous = reached
+            except errors.TddlError as e:
+                # worker-REPORTED error (request() raises these from the
+                # resp error field): the statement failed engine-side,
+                # nothing applied — outcome is KNOWN (the worker-side branch
+                # session exists, so its registration must stay)
+                err = str(e)
+                ambiguous = False
+                reached = True
             if err:
                 if addr != primary:
                     # a failed REPLICA write must not diverge silently: drop
@@ -1138,13 +1247,35 @@ class Session:
                             r["stale"] = True
                     self.txn.remote.pop(addr, None)
                     try:
+                        # bounded: a HUNG replica must not stall the
+                        # statement on its own cleanup (the branch resolves
+                        # via xa_recover when the replica returns)
                         self.instance.workers[addr].request(
-                            {"op": "xa_rollback", "xid": xid})
+                            {"op": "xa_rollback", "xid": xid},
+                            deadline=time.time() + 5.0)
                     except Exception:
                         pass
                     continue
                 if auto:
                     self._rollback()
+                    raise errors.TddlError(f"worker DML failed: {err}")
+                if ambiguous:
+                    # an AMBIGUOUS primary failure aborts even an explicit
+                    # txn: the branch may hold the write, and a later COMMIT
+                    # would persist a statement the client was told failed.
+                    # A worker-reported error instead keeps MySQL
+                    # statement-scoped semantics (nothing applied; the txn
+                    # survives).
+                    self._rollback()
+                    raise errors.TransactionError(
+                        f"worker DML failed with unknown outcome; "
+                        f"transaction rolled back: {err}")
+                if not reached and not had_branch:
+                    # nothing ever hit the wire AND this statement was the
+                    # branch's registrar: unregister it, or the surviving
+                    # txn's COMMIT would prepare a branch the worker never
+                    # opened ("unknown branch" -> spurious full rollback)
+                    self.txn.remote.pop(addr, None)
                 raise errors.TddlError(f"worker DML failed: {err}")
             if addr == primary:
                 affected = int(resp.get("affected", 0))

@@ -171,6 +171,16 @@ def handle(session, stmt: ast.Show):
         rows = sched.stats_rows() if sched is not None else []
         return ResultSet(["Stat", "Value"], [dt.VARCHAR, dt.DOUBLE],
                          [(n, float(v)) for n, v in rows])
+    if kind == "workers":
+        # SHOW WORKERS: attached worker endpoints with fence + circuit-breaker
+        # state and lifetime retry/failure counters (the fault-tolerance
+        # plane's SQL surface; information_schema.workers twin)
+        return ResultSet(
+            ["Host", "Port", "Breaker", "Fenced", "Consec_failures",
+             "Retries", "Failures", "Breaker_opens", "Last_error"],
+            [dt.VARCHAR, dt.BIGINT, dt.VARCHAR, dt.BIGINT, dt.BIGINT,
+             dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.VARCHAR],
+            inst.worker_rows())
     if kind == "metrics":
         # the typed counter/gauge registry (information_schema.metrics twin)
         rows = [(n, k, float(v), h) for n, k, v, h in inst.metrics.rows()]

@@ -22,6 +22,9 @@ behind them:
   batching (server/batch_scheduler.py).  Hinted statements never register
   PointPlans, so BATCH(OFF) structurally pins the statement to the planned
   (unbatched) path; the directive still parses so tools can round-trip it.
+- MAX_EXECUTION_TIME(ms)   per-statement deadline (MySQL's optimizer-hint
+  spelling): overrides the MAX_EXECUTION_TIME session param for this query;
+  past-deadline execution dies with a typed QueryTimeoutError.
 - BASELINE_OFF             bypass SPM for the statement (plan as costed)
 
 Unknown directives are ignored (hints must never break a query), matching the
@@ -72,6 +75,13 @@ def parse_hints(comment: Optional[str]) -> Dict[str, object]:
             mode = arglist[0].lower()
             if mode in ("off", "on"):
                 out["batch"] = mode
+        elif name == "MAX_EXECUTION_TIME" and arglist:
+            try:
+                ms = int(arglist[0])
+            except ValueError:
+                continue  # malformed hints must never break a query
+            if ms > 0:
+                out["max_execution_time"] = ms
         elif name == "BASELINE_OFF":
             out["baseline_off"] = True
     return out

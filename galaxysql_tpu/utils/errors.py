@@ -88,6 +88,54 @@ class CclRejectError(TddlError):
     sqlstate = "HY000"
 
 
+class QueryTimeoutError(TddlError):
+    """Query exceeded its MAX_EXECUTION_TIME deadline (ER_QUERY_TIMEOUT).
+
+    Raised at operator drain / fused-segment / MPP-stage boundaries and by
+    workers that receive a fragment whose propagated deadline already passed —
+    a deadline-killed query dies TYPED everywhere, never as a hang.
+
+    `sent` mirrors WorkerUnavailableError: False means the deadline expired
+    BEFORE any bytes hit the wire (provably nothing applied remotely); True
+    (default) means a remote side may have executed work."""
+    errno = 3024
+    sqlstate = "HY000"
+    sent = True
+
+    def __init__(self, message: str, sent: bool = True):
+        super().__init__(message)
+        self.sent = sent
+
+
+class WorkerUnavailableError(TddlError):
+    """A worker endpoint is unreachable: retry budget exhausted or the
+    circuit breaker is open (fast-fail).  Callers with an alternate endpoint
+    (replica reads) fail over; callers without one surface this typed.
+
+    `sent` tells write callers whether the request may have REACHED the
+    worker: False means nothing ever hit the wire (breaker fast-fail,
+    connect refused) — the outcome is provably "nothing applied" and an
+    explicit transaction can survive with statement-scoped semantics; True
+    (the conservative default) means the outcome is ambiguous."""
+    errno = 9002
+    sqlstate = "HY000"
+    sent = True
+
+    def __init__(self, message: str, sent: bool = True):
+        super().__init__(message)
+        self.sent = sent
+
+
+class ProtocolError(TddlError):
+    """Corrupt/overlong RPC frame on the CN<->worker wire (ER_NET_READ_ERROR).
+
+    Raised instead of trusting an attacker-or-corruption-controlled length
+    prefix: the framing layer caps header/name/array sizes and kills the
+    connection rather than allocating arbitrary memory."""
+    errno = 1158
+    sqlstate = "08S01"
+
+
 def span_attrs(exc: BaseException) -> dict:
     """Error attributes for span tracing: the (errno, sqlstate) taxonomy above
     rides error spans so SHOW TRACE / the Chrome-trace export explain a failed

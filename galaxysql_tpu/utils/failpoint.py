@@ -3,6 +3,18 @@
 Reference analog: `executor/utils/failpoint/FailPoint.java:63-111` (SURVEY.md §4) —
 no-op unless a key is armed (there via session vars `set @FP_X=...`); used by DDL
 crash-recovery tests to kill execution between tasks.
+
+The network-plane keys (FP_RPC_*) drive the chaos harness (tests/test_chaos.py):
+they are consulted inside `net/dn.WorkerClient.request` / `net/worker.Worker.handle`
+and accept OP-SCOPED arm values so a schedule can say "drop the reply leg of the
+next dml" without touching reads.  Arm-value forms for the RPC keys:
+
+- True            applies to every op
+- "dml"           applies to that op only
+- int n           applies to the first n matching hits, then auto-exhausts
+- {"op": "dml", "n": 1, "leg": "reply", "ms": 50}
+                  full form: op filter, hit budget, request/reply leg
+                  (FP_RPC_DROP), delay milliseconds (FP_RPC_DELAY_MS)
 """
 
 from __future__ import annotations
@@ -19,6 +31,19 @@ FP_BACKFILL_PAUSE = "FP_BACKFILL_PAUSE"
 # sessions inside a flush (error-isolation testing, server/batch_scheduler.py)
 FP_BATCH_POISON_KEY = "FP_BATCH_POISON_KEY"
 
+# -- network-plane faults (coordinator-side unless noted) ---------------------
+# drop the request or reply leg of an RPC: the socket dies mid-exchange.  A
+# reply-leg drop is the double-apply trap — the worker HAS executed the op
+# when the coordinator's retry fires (dedupe-window territory).
+FP_RPC_DROP = "FP_RPC_DROP"
+# sleep N ms before sending (slow network / slow worker; deadline fodder)
+FP_RPC_DELAY_MS = "FP_RPC_DELAY_MS"
+# fail the next N matching requests with a transport error before send
+FP_RPC_FAIL_N = "FP_RPC_FAIL_N"
+# WORKER-side: the worker process exits hard on the next matching op
+# (armed remotely via the `failpoint` sync action)
+FP_WORKER_CRASH = "FP_WORKER_CRASH"
+
 
 class FailPointError(RuntimeError):
     """Raised by an armed fail point (simulated crash)."""
@@ -29,20 +54,26 @@ class _FailPoints:
         self._armed: Dict[str, Any] = {}
         self._hits: Dict[str, int] = {}
         self._lock = threading.Lock()
+        # lock-free fast gate: hot paths (the RPC layer) check this plain
+        # bool and skip the locked lookup entirely when nothing is armed
+        self.active = False
 
     def arm(self, key: str, value: Any = True):
         with self._lock:
             self._armed[key] = value
             self._hits[key] = 0
+            self.active = True
 
     def disarm(self, key: str):
         with self._lock:
             self._armed.pop(key, None)
+            self.active = bool(self._armed)
 
     def clear(self):
         with self._lock:
             self._armed.clear()
             self._hits.clear()
+            self.active = False
 
     def value(self, key: str) -> Optional[Any]:
         with self._lock:
@@ -59,6 +90,50 @@ class _FailPoints:
             hits = self._hits[key]
         if v is True or (isinstance(v, int) and hits == v):
             raise FailPointError(f"failpoint {key} fired ({detail})")
+
+    def rpc_spec(self, key: str, op: str) -> Optional[dict]:
+        """Match an RPC-plane key against `op`; returns the normalized spec
+        dict ({"leg","ms",...}) when it applies to THIS hit, else None.
+
+        Int-budget arms ({"n": k} / bare int) consume one unit per matching
+        hit and auto-disarm at zero, so "fail the next 2 dml requests" is a
+        one-liner in a chaos schedule."""
+        with self._lock:
+            v = self._armed.get(key)
+            if v is None:
+                return None
+            spec: dict
+            if v is True:
+                spec = {}
+            elif isinstance(v, str):
+                if v != op:
+                    return None
+                spec = {}
+            elif isinstance(v, int):
+                spec = {"n": v}
+            elif isinstance(v, dict):
+                spec = dict(v)
+                want = spec.get("op")
+                if want is not None and want != op:
+                    return None
+            else:
+                return None
+            n = spec.get("n")
+            if n is not None:
+                if n <= 0:
+                    return None
+                n -= 1
+                # write back the decremented budget in the SAME value shape
+                # (an exhausted arm stays visible until disarm/clear but no
+                # longer fires)
+                if isinstance(v, dict):
+                    v = dict(v)
+                    v["n"] = n
+                else:
+                    v = n
+                self._armed[key] = v
+            self._hits[key] = self._hits.get(key, 0) + 1
+            return spec
 
 
 FAIL_POINTS = _FailPoints()

@@ -176,6 +176,26 @@ BATCH_GROUP_SIZE = Histogram(
 BATCH_WAIT_MS = Histogram(
     "batch_wait_ms", "batched point-query collection wait (ms)")
 
+# fault-tolerance plane (net/dn.py retry/breaker, SyncBus, deadline kills):
+# process-shared like the histograms above — WorkerClient instances have no
+# Instance handle; every Instance adopts these into its registry.
+RPC_RETRIES = Counter(
+    "rpc_retries", "worker RPC attempts retried after a transport failure")
+RPC_FAILURES = Counter(
+    "rpc_failures", "worker RPCs failed after exhausting the retry budget")
+BREAKER_OPENS = Counter(
+    "breaker_opens", "worker circuit breakers tripped open")
+WORKER_FAILOVERS = Counter(
+    "worker_failovers",
+    "replica-read requests re-routed to another endpoint mid-statement")
+SYNC_FAILURES = Counter(
+    "sync_failures", "sync-bus broadcast deliveries that failed")
+SYNC_HEALS = Counter(
+    "sync_heals",
+    "wholesale cache invalidations from a detected sync-epoch gap")
+QUERY_TIMEOUTS = Counter(
+    "query_timeouts", "queries killed by a MAX_EXECUTION_TIME deadline")
+
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 

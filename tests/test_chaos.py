@@ -1,0 +1,741 @@
+"""Chaos harness: fault-tolerant distributed execution under injected faults.
+
+The FailPoint framework (utils/failpoint.py) gained network-plane keys —
+FP_RPC_DROP / FP_RPC_DELAY_MS / FP_RPC_FAIL_N (coordinator-side, op-scoped)
+and FP_WORKER_CRASH (worker-side, armed remotely via the `failpoint` sync
+action) — and this suite drives the coordinator<->worker plane through them:
+
+- retries are transparent for retry-safe ops and NEVER double-apply DML (the
+  worker's uid dedupe window replays the recorded result on a reconnect retry
+  — the reply-leg-drop test is the exactly-once proof),
+- the circuit breaker opens on consecutive failures, fast-fails typed while
+  open, and half-open ping probes close it when the worker returns,
+- MAX_EXECUTION_TIME deadlines kill queries TYPED at drain/RPC boundaries,
+- a worker that missed a SyncBus broadcast heals its caches at next contact
+  (sync-epoch gap detection),
+- a worker crash between XA prepare and commit resolves exactly once after
+  restart (recover_remote),
+- replica reads fail over within the statement and fenced/stale replicas are
+  excluded from routing,
+- TPC-H Q5 with a worker-resident dimension + concurrent point DML returns
+  bit-identical results or a typed error under randomized fault schedules —
+  zero hangs (every run is wall-clock bounded), zero double-applies.
+"""
+
+import os
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+from galaxysql_tpu.net import dn
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.storage import tpch
+from galaxysql_tpu.storage.tpch_queries import QUERIES
+from galaxysql_tpu.utils import errors
+from galaxysql_tpu.utils.failpoint import (FAIL_POINTS, FP_RPC_DELAY_MS,
+                                           FP_RPC_DROP, FP_RPC_FAIL_N,
+                                           FP_WORKER_CRASH)
+from galaxysql_tpu.utils.metrics import (RPC_RETRIES, SYNC_FAILURES,
+                                         WORKER_FAILOVERS)
+
+pytestmark = pytest.mark.chaos
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# every chaos run is wall-clock bounded: a hang is a FAILURE, not a stall
+RUN_BOUND_S = 120.0
+
+
+def bounded(fn, timeout_s: float = RUN_BOUND_S):
+    """Run fn on a DAEMON thread; raise if it neither returns nor raises
+    within the bound (the suite's zero-hang enforcement).  A pool context
+    manager would defeat the purpose: its shutdown joins the hung thread."""
+    result: dict = {}
+
+    def run():
+        try:
+            result["v"] = fn()
+        except BaseException as exc:  # noqa: BLE001 — re-raised below
+            result["e"] = exc
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise AssertionError(f"hang: call exceeded {timeout_s}s bound")
+    if "e" in result:
+        raise result["e"]
+    return result.get("v")
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    FAIL_POINTS.clear()
+    yield
+    FAIL_POINTS.clear()
+
+
+class WorkerHarness:
+    """Spawn/kill/restart a real worker subprocess (same port across
+    restarts so attached WorkerClients reconnect transparently)."""
+
+    def __init__(self, init_sql: str = "", data_dir=None):
+        self.init_sql = init_sql
+        self.data_dir = data_dir
+        self.port = 0
+        self.proc = None
+        self._stderr = tempfile.NamedTemporaryFile(
+            mode="w", prefix="chaos-worker-", suffix=".log", delete=False)
+        self.spawn()
+
+    def spawn(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        cmd = [sys.executable, "-m", "galaxysql_tpu.net.worker",
+               "--port", str(self.port), "--platform", "cpu"]
+        if self.data_dir:
+            cmd += ["--data-dir", self.data_dir]
+        if self.init_sql and (self.data_dir is None or self.port == 0):
+            # with a data_dir the bootstrap state persists across restarts
+            cmd += ["--init-sql", self.init_sql]
+        self.proc = subprocess.Popen(
+            cmd, cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=self._stderr,
+            env=env, text=True)
+        line = self.proc.stdout.readline()
+        if not line.startswith("WORKER_READY"):
+            raise AssertionError(
+                f"worker failed to start: {line!r} "
+                f"(stderr: {self._stderr.name})")
+        self.port = int(line.split()[1])
+
+    def kill(self):
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait()
+
+    def restart(self):
+        self.kill()
+        self.spawn()
+
+    def wait_dead(self, timeout_s: float = 10.0):
+        self.proc.wait(timeout=timeout_s)
+
+    def close(self):
+        self.kill()
+        try:
+            self._stderr.close()
+        except Exception:
+            pass
+
+    @property
+    def addr(self):
+        return ("127.0.0.1", self.port)
+
+
+def _region_init_sql() -> str:
+    d = tpch.generate(0.01)["region"]
+    rows = ", ".join(
+        f"({k}, '{n}', '{c}')" for k, n, c in
+        zip(d["r_regionkey"], d["r_name"], d["r_comment"]))
+    return (
+        "CREATE DATABASE w; USE w; "
+        "CREATE TABLE kv (k BIGINT PRIMARY KEY, v BIGINT); "
+        "INSERT INTO kv VALUES (1, 10), (2, 20), (3, 30); "
+        "CREATE DATABASE tpch; USE tpch; "
+        + tpch.TPCH_DDL["region"].strip() + "; "
+        f"INSERT INTO region VALUES {rows}")
+
+
+@pytest.fixture(scope="module")
+def primary():
+    h = WorkerHarness(init_sql=_region_init_sql())
+    yield h
+    h.close()
+
+
+@pytest.fixture()
+def kv_env(primary):
+    """Coordinator with the primary worker's w.kv attached as a remote
+    table.  Function-scoped: breaker/fence state never leaks across tests."""
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE w")
+    s.execute("USE w")
+    inst.attach_remote_table("w", "kv", *primary.addr)
+    yield s, inst, primary
+    s.close()
+
+
+# -- unit layer: framing, retry policy, failpoints, SyncBus ------------------
+
+
+class TestFraming:
+    def _corrupt(self, payload: bytes):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(payload)
+            with pytest.raises(errors.ProtocolError):
+                dn.recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_header_length_capped(self):
+        # a corrupt/hostile 4-byte prefix must raise typed, not allocate GBs
+        self._corrupt(struct.pack(">I", (1 << 31) - 1) + b"x" * 64)
+
+    def test_array_count_capped(self):
+        import json
+        hb = json.dumps({"n_arrays": 1 << 30}).encode()
+        self._corrupt(struct.pack(">I", len(hb)) + hb)
+
+    def test_array_name_length_capped(self):
+        import json
+        hb = json.dumps({"n_arrays": 1}).encode()
+        self._corrupt(struct.pack(">I", len(hb)) + hb +
+                      struct.pack(">I", 1 << 24) + b"y" * 64)
+
+    def test_clean_roundtrip_still_works(self):
+        import numpy as np
+        a, b = socket.socketpair()
+        try:
+            dn.send_msg(a, {"op": "x"}, {"d": np.arange(4)})
+            hdr, arrs = dn.recv_msg(b)
+            assert hdr["op"] == "x" and list(arrs["d"]) == [0, 1, 2, 3]
+        finally:
+            a.close()
+            b.close()
+
+
+class TestRetryPolicy:
+    def test_classification(self):
+        rs = dn._retry_safe
+        assert rs({"op": "ping"})
+        assert rs({"op": "exec_plan", "fragment": {}})
+        assert rs({"op": "sync", "action": "x"})
+        assert rs({"op": "xa_commit", "xid": "g1"})
+        assert rs({"op": "exec_sql", "sql": "SELECT 1"})
+        assert rs({"op": "exec_sql", "sql": "  /* hint */ select k from t"})
+        # writes are retry-safe ONLY with an idempotency token / idem flag
+        assert not rs({"op": "exec_sql", "sql": "INSERT INTO t VALUES (1)"})
+        assert rs({"op": "exec_sql", "sql": "INSERT INTO t VALUES (1)",
+                   "uid": "cn:1"})
+        assert rs({"op": "exec_sql", "sql": "CREATE TABLE IF NOT EXISTS t",
+                   "idem": True})
+        assert not rs({"op": "dml", "sql": "UPDATE t SET v = 1"})
+        assert rs({"op": "dml", "sql": "UPDATE t SET v = 1", "uid": "cn:2"})
+
+    def test_rpc_spec_op_scoping_and_budget(self):
+        FAIL_POINTS.arm(FP_RPC_DROP, {"op": "dml", "leg": "reply", "n": 2})
+        assert FAIL_POINTS.rpc_spec(FP_RPC_DROP, "exec_plan") is None
+        assert FAIL_POINTS.rpc_spec(FP_RPC_DROP, "dml")["leg"] == "reply"
+        assert FAIL_POINTS.rpc_spec(FP_RPC_DROP, "dml")["leg"] == "reply"
+        assert FAIL_POINTS.rpc_spec(FP_RPC_DROP, "dml") is None  # exhausted
+        FAIL_POINTS.clear()
+        FAIL_POINTS.arm(FP_RPC_FAIL_N, "exec_sql")  # bare-op form
+        assert FAIL_POINTS.rpc_spec(FP_RPC_FAIL_N, "exec_sql") == {}
+        assert FAIL_POINTS.rpc_spec(FP_RPC_FAIL_N, "dml") is None
+
+
+class _StubWorker:
+    def __init__(self, delay_s=0.0, fail=False):
+        self.delay_s = delay_s
+        self.fail = fail
+        self.calls = 0
+
+    def sync_action(self, action, payload):
+        self.calls += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail:
+            raise ConnectionError("stub down")
+        return {"ok": True}
+
+
+class TestSyncBusBroadcast:
+    def test_parallel_fanout_and_failure_isolation(self):
+        bus = dn.SyncBus(origin="cn-test")
+        slow = [_StubWorker(delay_s=0.25) for _ in range(3)]
+        dead = _StubWorker(fail=True)
+        for w in slow + [dead]:
+            bus.attach(w)
+        f0 = SYNC_FAILURES.value
+        t0 = time.perf_counter()
+        out = bus.broadcast("invalidate_plan_cache", {})
+        wall = time.perf_counter() - t0
+        assert len(out) == 4
+        assert sum(1 for r in out if r.get("ok")) == 3
+        assert SYNC_FAILURES.value == f0 + 1
+        # serial would be >= 0.75s; parallel is one slowest-worker delay
+        assert wall < 0.6, f"broadcast not parallel: {wall:.3f}s"
+        assert bus.epoch == 1
+
+    def test_epoch_monotonic(self):
+        bus = dn.SyncBus(origin="cn-test")
+        for _ in range(3):
+            bus.broadcast("invalidate_plan_cache", {})
+        assert bus.epoch == 3
+
+
+class TestBreakerUnit:
+    def _dead_port(self):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def test_open_fastfail_and_reopen_on_failed_probe(self):
+        c = dn.WorkerClient("127.0.0.1", self._dead_port(), timeout=0.5,
+                            max_retries=2, retry_backoff_ms=5,
+                            failure_threshold=3, cooldown_ms=250)
+        with pytest.raises(errors.WorkerUnavailableError):
+            c.request({"op": "ping"})
+        assert c.breaker_state() == "open"  # 3 attempts = threshold
+        t0 = time.perf_counter()
+        with pytest.raises(errors.WorkerUnavailableError):
+            c.request({"op": "ping"})
+        assert time.perf_counter() - t0 < 0.2  # fast-fail, no socket touch
+        time.sleep(0.3)  # cooldown elapses -> half-open probe (fails)
+        with pytest.raises(errors.WorkerUnavailableError):
+            c.request({"op": "ping"})
+        assert c.breaker_state() == "open"
+        snap = c.breaker_snapshot()
+        assert snap["opens"] >= 1 and snap["failures"] >= 3
+
+
+# -- integration layer: a real worker process under faults -------------------
+
+
+class TestRetriesAndDedupe:
+    def test_transparent_retry_on_transient_failures(self, kv_env):
+        s, inst, w = kv_env
+        r0 = RPC_RETRIES.value
+        FAIL_POINTS.arm(FP_RPC_FAIL_N, {"op": "exec_plan", "n": 2})
+        rows = bounded(lambda: s.execute(
+            "SELECT k, v FROM kv ORDER BY k").rows)
+        assert rows == [(1, 10), (2, 20), (3, 30)]
+        assert RPC_RETRIES.value >= r0 + 2
+
+    def test_exhausted_retries_fail_typed_not_hang(self, kv_env):
+        s, inst, w = kv_env
+        FAIL_POINTS.arm(FP_RPC_FAIL_N, {"op": "exec_plan", "n": 50})
+        with pytest.raises(errors.TddlError):
+            bounded(lambda: s.execute("SELECT k FROM kv"))
+        FAIL_POINTS.clear()
+        inst.ha.fence_worker(w.addr, False)  # cleanup: unfence for peers
+
+    def test_dml_reply_drop_applies_exactly_once(self, kv_env):
+        """THE exactly-once proof: the reply leg of a dml drops AFTER the
+        worker executed it; the coordinator's retry re-sends the same uid and
+        the worker's dedupe window replays the recorded result instead of
+        double-applying."""
+        s, inst, w = kv_env
+        client = inst.workers[w.addr]
+        stats0 = client.sync_action("worker_stats", {})
+        FAIL_POINTS.arm(FP_RPC_DROP, {"op": "dml", "leg": "reply", "n": 1})
+        rs = bounded(lambda: s.execute("INSERT INTO kv VALUES (777, 7)"))
+        assert rs.affected == 1
+        FAIL_POINTS.clear()
+        try:
+            rows = s.execute("SELECT count(*) FROM kv WHERE k = 777").rows
+            assert rows == [(1,)], "retried DML double-applied!"
+            stats1 = client.sync_action("worker_stats", {})
+            assert stats1["dedupe_hits"] >= stats0["dedupe_hits"] + 1
+        finally:
+            s.execute("DELETE FROM kv WHERE k = 777")
+
+    def test_ambiguous_primary_failure_aborts_explicit_txn(self, kv_env):
+        """A primary DML whose every reply is lost POST-send has an UNKNOWN
+        outcome: the explicit transaction must roll back (a later COMMIT
+        could otherwise persist a write the client was told failed)."""
+        s, inst, w = kv_env
+        s.execute("BEGIN")
+        # reply-leg drops: the worker EXECUTES the statement, the
+        # coordinator never learns — the genuinely ambiguous class
+        FAIL_POINTS.arm(FP_RPC_DROP, {"op": "dml", "leg": "reply", "n": 50})
+        with pytest.raises(errors.TransactionError):
+            bounded(lambda: s.execute("INSERT INTO kv VALUES (666, 6)"))
+        FAIL_POINTS.clear()
+        assert s.txn is None  # txn aborted, not left half-applied
+        s.execute("COMMIT")   # no-op: nothing to persist
+        # the failed attempts correctly tripped the breaker; recover it
+        assert inst.workers[w.addr].ping()
+        # the rollback undid the branch the worker had (ambiguously) applied
+        assert s.execute(
+            "SELECT count(*) FROM kv WHERE k = 666").rows == [(0,)]
+
+    def test_presend_primary_failure_keeps_txn(self, kv_env):
+        """A pre-send failure (nothing ever hit the wire) has a KNOWN
+        outcome: statement-scoped error, the explicit txn survives — and a
+        later COMMIT must not trip over a phantom branch registration."""
+        s, inst, w = kv_env
+        s.execute("BEGIN")
+        FAIL_POINTS.arm(FP_RPC_FAIL_N, {"op": "dml", "n": 50})
+        with pytest.raises(errors.TddlError) as ei:
+            bounded(lambda: s.execute("INSERT INTO kv VALUES (667, 6)"))
+        FAIL_POINTS.clear()
+        assert not isinstance(ei.value, errors.TransactionError)
+        assert s.txn is not None, "provably-unapplied failure killed the txn"
+        assert inst.workers[w.addr].ping()  # failures tripped the breaker
+        # the surviving txn keeps working against the recovered worker and
+        # COMMITs cleanly (the never-opened branch was unregistered)
+        s.execute("INSERT INTO kv VALUES (668, 8)")
+        s.execute("COMMIT")
+        try:
+            assert s.execute("SELECT count(*) FROM kv "
+                             "WHERE k IN (667, 668)").rows == [(1,)]
+            assert s.execute(
+                "SELECT v FROM kv WHERE k = 668").rows == [(8,)]
+        finally:
+            s.execute("DELETE FROM kv WHERE k = 668")
+
+    def test_worker_reported_error_keeps_txn_alive(self, kv_env):
+        """A worker-REPORTED statement error has a KNOWN outcome (nothing
+        applied): MySQL statement-scoped semantics — the explicit txn
+        survives, unlike the ambiguous transport-death case above."""
+        s, inst, w = kv_env
+        s.execute("BEGIN")
+        s.execute("INSERT INTO kv VALUES (901, 1)")
+        with pytest.raises(errors.TddlError):
+            # worker-side bind error: column count mismatch
+            bounded(lambda: s.execute("INSERT INTO kv VALUES (902)"))
+        assert s.txn is not None, "statement error must not kill the txn"
+        s.execute("ROLLBACK")
+        assert s.execute(
+            "SELECT count(*) FROM kv WHERE k = 901").rows == [(0,)]
+
+    def test_dml_without_faults_unaffected(self, kv_env):
+        s, inst, w = kv_env
+        s.execute("INSERT INTO kv VALUES (888, 8)")
+        try:
+            assert s.execute(
+                "SELECT v FROM kv WHERE k = 888").rows == [(8,)]
+        finally:
+            s.execute("DELETE FROM kv WHERE k = 888")
+
+
+class TestDeadlines:
+    def test_worker_aborts_past_deadline_fragment(self, kv_env):
+        s, inst, w = kv_env
+        client = inst.workers[w.addr]
+        with pytest.raises(errors.QueryTimeoutError):
+            client.request({"op": "exec_plan",
+                            "fragment": {"schema": "w", "table": "kv",
+                                         "columns": ["k"]},
+                            "deadline_ms": 0})
+
+    def test_deadline_during_rpc_dies_typed(self, kv_env):
+        s, inst, w = kv_env
+        s.execute("SET MAX_EXECUTION_TIME = 60")
+        FAIL_POINTS.arm(FP_RPC_DELAY_MS, {"op": "exec_plan", "ms": 200})
+        with pytest.raises(errors.QueryTimeoutError):
+            bounded(lambda: s.execute("SELECT k FROM kv"))
+        FAIL_POINTS.clear()
+        s.execute("SET MAX_EXECUTION_TIME = 0")
+        # typed death is observable: the timeout counter moved
+        assert inst.metrics.counter("query_timeouts").value >= 1
+
+    def test_dml_hint_deadline(self, kv_env):
+        """The MAX_EXECUTION_TIME hint binds DML too: an expired deadline
+        kills the shipped statement typed, before anything applies."""
+        s, inst, w = kv_env
+        FAIL_POINTS.arm(FP_RPC_DELAY_MS, {"op": "dml", "ms": 200})
+        with pytest.raises(errors.QueryTimeoutError):
+            bounded(lambda: s.execute(
+                "/*+TDDL: MAX_EXECUTION_TIME(50)*/ "
+                "INSERT INTO kv VALUES (555, 5)"))
+        FAIL_POINTS.clear()
+        assert s.execute(
+            "SELECT count(*) FROM kv WHERE k = 555").rows == [(0,)]
+
+    def test_breaker_hatch_applies_to_attached_workers(self, kv_env):
+        """SET GLOBAL BREAKER_*/RPC_* must retune ALREADY-attached workers
+        (the client reads the bound config live)."""
+        s, inst, w = kv_env
+        client = inst.workers[w.addr]
+        assert client.failure_threshold == 3 and client.max_retries == 2
+        s.execute("SET GLOBAL BREAKER_FAILURE_THRESHOLD = 7")
+        s.execute("SET GLOBAL RPC_MAX_RETRIES = 5")
+        try:
+            assert client.failure_threshold == 7
+            assert client.max_retries == 5
+        finally:
+            s.execute("SET GLOBAL BREAKER_FAILURE_THRESHOLD = 3")
+            s.execute("SET GLOBAL RPC_MAX_RETRIES = 2")
+
+    def test_hint_overrides_session_param(self, kv_env):
+        s, inst, w = kv_env
+        FAIL_POINTS.arm(FP_RPC_DELAY_MS, {"op": "exec_plan", "ms": 200})
+        with pytest.raises(errors.QueryTimeoutError):
+            bounded(lambda: s.execute(
+                "/*+TDDL: MAX_EXECUTION_TIME(50)*/ SELECT k FROM kv"))
+        FAIL_POINTS.clear()
+        # no hint, no param: the same delayed scan completes fine
+        FAIL_POINTS.arm(FP_RPC_DELAY_MS, {"op": "exec_plan", "ms": 60, "n": 1})
+        assert len(bounded(lambda: s.execute("SELECT k FROM kv").rows)) == 3
+
+
+class TestBreakerIntegration:
+    def test_breaker_trips_fastfails_and_recovers(self):
+        h = WorkerHarness(init_sql="CREATE DATABASE w; USE w; "
+                          "CREATE TABLE t (a BIGINT PRIMARY KEY)")
+        inst = Instance()
+        s = Session(inst)
+        try:
+            s.execute("CREATE DATABASE w")
+            s.execute("USE w")
+            inst.attach_remote_table("w", "t", *h.addr)
+            client = inst.workers[h.addr]
+            client.timeout = 2.0
+            assert s.execute("SELECT a FROM t").rows == []
+            h.kill()
+            h.wait_dead()
+            with pytest.raises(errors.TddlError):
+                bounded(lambda: s.execute("SELECT a FROM t"))
+            assert client.breaker_state() == "open"
+            # open breaker: fast typed failure, no connect timeout paid
+            t0 = time.perf_counter()
+            with pytest.raises(errors.WorkerUnavailableError):
+                client.request({"op": "exec_plan", "fragment": {}})
+            assert time.perf_counter() - t0 < 0.2
+            h.restart()
+            time.sleep(client.cooldown_s + 0.05)
+            # half-open probe closes the breaker and the query serves again
+            inst.ha.fence_worker(h.addr, False)
+            assert bounded(lambda: s.execute("SELECT a FROM t").rows) == []
+            assert client.breaker_state() == "closed"
+            row = [r for r in s.execute("SHOW WORKERS").rows
+                   if r[1] == h.addr[1]][0]
+            assert row[2] == "closed" and row[7] >= 1  # breaker_opens
+        finally:
+            s.close()
+            h.close()
+
+
+class TestSyncEpochHealing:
+    def test_missed_broadcast_heals_at_next_contact(self, kv_env):
+        s, inst, w = kv_env
+        client = inst.workers[w.addr]
+        # establish the epoch plane on the worker
+        inst.sync_bus.broadcast("invalidate_plan_cache", {})
+        st0 = client.sync_action("worker_stats", {})
+        # the worker misses this broadcast (every delivery attempt drops)
+        FAIL_POINTS.arm(FP_RPC_DROP, {"op": "sync", "leg": "request", "n": 10})
+        out = inst.sync_bus.broadcast("invalidate_fragment_cache",
+                                      {"schema": "w", "table": "kv"})
+        assert not out[0].get("ok")
+        FAIL_POINTS.clear()
+        # the failed deliveries tripped the breaker (correctly); a ping probe
+        # closes it — pings carry no epoch, so the gap is still unhealed
+        assert client.ping()
+        # next DATA request carries the advanced epoch -> the worker detects
+        # the gap and wholesale-invalidates its caches
+        assert len(s.execute("SELECT k FROM kv").rows) == 3
+        st1 = client.sync_action("worker_stats", {})
+        assert st1["heals"] >= st0["heals"] + 1
+        assert st1["sync_epochs"][inst.node_id] == inst.sync_bus.epoch
+
+
+class TestXaCrashRecovery:
+    def test_worker_crash_between_prepare_and_commit_resolves_once(
+            self, tmp_path):
+        """Satellite: kill the worker between XA prepare and commit, restart
+        it, and recover_remote() resolves the branch exactly once."""
+        h = WorkerHarness(
+            init_sql="CREATE DATABASE w; USE w; "
+                     "CREATE TABLE t (a BIGINT PRIMARY KEY, b BIGINT)",
+            data_dir=str(tmp_path / "wdata"))
+        inst = Instance()
+        s = Session(inst)
+        try:
+            s.execute("CREATE DATABASE w")
+            s.execute("USE w")
+            inst.attach_remote_table("w", "t", *h.addr)
+            client = inst.workers[h.addr]
+            client.timeout = 5.0
+            s.execute("BEGIN")
+            s.execute("INSERT INTO t VALUES (1, 100)")
+            # the worker exits hard when xa_commit arrives: prepare has
+            # succeeded (durably), the commit point gets logged, the commit
+            # apply never lands -> branch in doubt
+            client.sync_action("failpoint", {"key": FP_WORKER_CRASH,
+                                             "value": {"op": "xa_commit"}})
+            with pytest.raises(errors.TransactionError) as ei:
+                bounded(lambda: s.execute("COMMIT"))
+            assert getattr(ei.value, "commit_ts", None) or \
+                "in doubt" in str(ei.value)
+            h.wait_dead()
+            h.restart()
+            out = bounded(lambda: inst.xa_coordinator.recover_remote())
+            assert any(v == "committed" for v in out.values()), out
+            inst.ha.fence_worker(h.addr, False)
+            assert bounded(lambda: s.execute(
+                "SELECT count(*), sum(b) FROM t").rows) == [(1, 100)]
+            # second recovery pass: nothing left in doubt (exactly once)
+            assert bounded(lambda: inst.xa_coordinator.recover_remote()) == {}
+        finally:
+            s.close()
+            h.close()
+
+
+class TestReplicaFailover:
+    def test_read_failover_stale_exclusion_and_rebuild(self, primary):
+        """Satellite: a dead replica read fails over WITHIN the statement;
+        fenced/stale replicas are excluded from routing; attach_replica's
+        backfill-needed detection still holds after failover."""
+        rep = WorkerHarness()
+        inst = Instance()
+        s = Session(inst)
+        try:
+            s.execute("CREATE DATABASE w")
+            s.execute("USE w")
+            inst.attach_remote_table("w", "kv", *primary.addr)
+            # huge weight: reads deterministically route to the replica
+            inst.attach_replica("w", "kv", *rep.addr, weight=10 ** 6)
+            # replica serves (and holds a backfilled copy)
+            _c0, _t, rdata, _v = inst.workers[rep.addr].execute(
+                "SELECT count(*) FROM kv", "w")
+            assert int(next(iter(rdata.values()))[0]) == 3
+            rep.kill()
+            rep.wait_dead()
+            inst.workers[rep.addr].timeout = 2.0
+            f0 = WORKER_FAILOVERS.value
+            # the read hits the dead replica and fails over mid-statement
+            rows = bounded(lambda: s.execute(
+                "SELECT k, v FROM kv ORDER BY k").rows)
+            assert rows == [(1, 10), (2, 20), (3, 30)]
+            assert WORKER_FAILOVERS.value >= f0 + 1
+            assert inst.ha.worker_fenced(rep.addr)
+            # a write marks the fenced replica STALE (excluded until rebuilt)
+            s.execute("INSERT INTO kv VALUES (40, 400)")
+            tm = inst.catalog.table("w", "kv")
+            entry = [r for r in tm.replicas
+                     if (r["host"], r["port"]) == rep.addr][0]
+            assert entry["stale"] is True
+            # stale replicas refuse re-attach without an explicit rebuild
+            with pytest.raises(errors.TddlError):
+                inst.attach_replica("w", "kv", *rep.addr)
+            # restart empty -> backfill=True rebuilds and re-registers
+            rep.restart()
+            inst.ha.fence_worker(rep.addr, False)
+            inst.workers[rep.addr].ping()  # close the breaker
+            inst.attach_replica("w", "kv", *rep.addr, weight=10 ** 6,
+                                backfill=True)
+            assert entry["stale"] is False
+            rows = bounded(lambda: s.execute(
+                "SELECT k, v FROM kv ORDER BY k").rows)
+            assert rows == [(1, 10), (2, 20), (3, 30), (40, 400)]
+            _c, _t2, rdata, _v2 = inst.workers[rep.addr].execute(
+                "SELECT count(*) FROM kv", "w")
+            assert int(next(iter(rdata.values()))[0]) == 4
+            s.execute("DELETE FROM kv WHERE k = 40")
+        finally:
+            s.close()
+            rep.close()
+
+
+# -- the randomized chaos matrix: TPC-H Q5 + concurrent point DML ------------
+
+
+@pytest.fixture(scope="module")
+def q5_env(primary):
+    """TPC-H SF0.01 with `region` living on the worker: Q5's fragments span
+    both processes, so RPC faults hit a real distributed query."""
+    data = tpch.generate(0.01)
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE tpch")
+    s.execute("USE tpch")
+    for t in tpch.TABLE_ORDER:
+        if t == "region":
+            continue
+        s.execute(tpch.TPCH_DDL[t])
+        inst.store("tpch", t).insert_pylists(data[t],
+                                             inst.tso.next_timestamp())
+    s.execute("ANALYZE TABLE " + ", ".join(
+        t for t in tpch.TABLE_ORDER if t != "region"))
+    inst.attach_remote_table("tpch", "region", *primary.addr)
+    s.execute("CREATE DATABASE w")  # for the concurrent-DML sessions
+    yield s, inst, primary
+    s.close()
+
+
+# fixed fault-schedule matrix (the make chaos-smoke seed set): each entry is
+# (name, [(key, value)...], q5_may_fail_typed)
+SCHEDULES = [
+    ("clean", [], False),
+    ("fail1-plan", [(FP_RPC_FAIL_N, {"op": "exec_plan", "n": 1})], False),
+    ("drop-reply-plan", [(FP_RPC_DROP,
+                          {"op": "exec_plan", "leg": "reply", "n": 1})],
+     False),
+    ("delay-plan", [(FP_RPC_DELAY_MS, {"op": "exec_plan", "ms": 30, "n": 2})],
+     False),
+    ("drop-reply-dml", [(FP_RPC_DROP,
+                         {"op": "dml", "leg": "reply", "n": 2})], False),
+    ("hard-down", [(FP_RPC_FAIL_N, {"op": "exec_plan", "n": 50})], True),
+]
+
+
+class TestQ5ChaosMatrix:
+    def _dml_storm(self, inst, base_key: int, n: int, acked: list):
+        ses = Session(inst)
+        ses.execute("USE w")
+        try:
+            for i in range(n):
+                k = base_key + i
+                try:
+                    ses.execute(f"INSERT INTO kv VALUES ({k}, {k})")
+                    acked.append(k)
+                except errors.TddlError:
+                    pass  # typed failure under faults is within contract
+        finally:
+            ses.close()
+
+    @pytest.mark.parametrize(
+        "name,faults,may_fail",
+        SCHEDULES, ids=[sc[0] for sc in SCHEDULES])
+    def test_q5_under_faults(self, q5_env, name, faults, may_fail):
+        s, inst, w = q5_env
+        inst.attach_remote_table("w", "kv", *w.addr)
+        baseline = bounded(lambda: s.execute(QUERIES[5]).rows)
+        assert baseline, "Q5 baseline empty — fixture broken"
+        base_key = 10_000 + abs(hash(name)) % 1_000_000
+        acked: list = []
+        for key, value in faults:
+            FAIL_POINTS.arm(key, value)
+        t = threading.Thread(target=self._dml_storm,
+                             args=(inst, base_key, 10, acked), daemon=True)
+        t.start()
+        try:
+            rows = bounded(lambda: s.execute(QUERIES[5]).rows)
+            assert rows == baseline, f"{name}: result drift under faults"
+        except errors.TddlError:
+            assert may_fail, f"{name}: unexpected typed failure"
+        finally:
+            t.join(timeout=RUN_BOUND_S)
+            assert not t.is_alive(), f"{name}: DML storm hung"
+            FAIL_POINTS.clear()
+            inst.ha.fence_worker(w.addr, False)
+            inst.workers[w.addr].ping()
+        # exactly-once audit on the worker itself: every acked key exists
+        # exactly once, no key double-applied
+        cols, _t, data, _v = inst.workers[w.addr].execute(
+            f"SELECT k, count(*) FROM kv WHERE k >= {base_key} "
+            f"AND k < {base_key + 10} GROUP BY k", "w")
+        got = dict(zip(data[cols[0]].tolist(), data[cols[1]].tolist()))
+        assert all(c == 1 for c in got.values()), f"double-apply: {got}"
+        for k in acked:
+            assert got.get(k) == 1, f"acked key {k} missing/duplicated"
+        # cleanup for the next schedule
+        ses = Session(inst)
+        ses.execute("USE w")
+        ses.execute(f"DELETE FROM kv WHERE k >= {base_key} "
+                    f"AND k < {base_key + 10}")
+        ses.close()

@@ -364,14 +364,31 @@ class TestHaActs:
     def test_fenced_worker_refuses_fast(self, session):
         s, port = session
         addr = ("127.0.0.1", port)
+        # fencing a LIVE worker self-heals: the next read's lazy revival
+        # ping proves it alive and unfences (no background prober exists
+        # in production, so fencing must not be forever)
         s.instance.ha.fence_worker(addr, True)
+        assert len(s.execute("SELECT k FROM dim").rows) == 5
+        assert not s.instance.ha.worker_fenced(addr)
+        # a fenced DEAD endpoint refuses FAST and typed: the revival ping
+        # fails immediately (nothing listens), no socket hang
+        from galaxysql_tpu.net.dn import WorkerClient
+        dead_addr = ("127.0.0.1", 1)
+        tm = s.instance.catalog.table("w", "dim")
+        old_remote = dict(tm.remote)
+        s.instance.workers[dead_addr] = WorkerClient("127.0.0.1", 1,
+                                                     timeout=0.5)
+        s.instance.ha.fence_worker(dead_addr, True)
+        tm.remote = {"host": dead_addr[0], "port": dead_addr[1]}
         try:
             t0 = time.time()
             with pytest.raises(errors.TddlError, match="fenced"):
                 s.execute("SELECT k FROM dim")
-            assert time.time() - t0 < 1.0  # refusal, not a socket hang
+            assert time.time() - t0 < 2.0  # refusal, not a socket hang
         finally:
-            s.instance.ha.fence_worker(addr, False)
+            tm.remote = old_remote
+            del s.instance.workers[dead_addr]
+            s.instance.ha.fence_worker(dead_addr, False)
         assert len(s.execute("SELECT k FROM dim").rows) == 5
 
     def test_probe_fences_dead_worker_and_recovers(self, session):
