@@ -61,5 +61,17 @@ batch-smoke:
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos -p no:cacheprovider
 
+# skew smoke: heavy-hitter hybrid joins + salted aggregation vs SKEW(OFF)
+# bit-identical across the Zipf theta sweep (8-virtual-device mesh), both
+# hybrid orientations, stats-drift deactivation, fragment-cache rekeying on
+# hot-key-set change, the hatch trio, and shard-skew observability surfaces
+skew-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m skew -p no:cacheprovider
+
+# skew bench: Zipf theta sweep on the Q9-like join family, skew-on vs
+# skew-off, 8 virtual devices (BENCH json lines on stdout)
+bench-skew:
+	JAX_PLATFORMS=cpu $(PY) bench.py --skew-only
+
 .PHONY: tier1 fusion-smoke obs-smoke rf-smoke cache-smoke trace-smoke bench \
-	batch-smoke chaos-smoke
+	batch-smoke chaos-smoke skew-smoke bench-skew

@@ -20,6 +20,15 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+if "--skew-only" in sys.argv and \
+        "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    # the skew family needs the 8-virtual-device mesh; XLA reads this at
+    # backend init, which has not happened yet at import time
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
 import jax
 
 if os.environ.get("BENCH_PLATFORM"):
@@ -576,6 +585,124 @@ def _bench_query_d(s, q, runs):
     return min(times), dispatches, compile_stats
 
 
+def skew_bench(platform):
+    """Zipf theta sweep on a Q9-like join family over the 8-device mesh:
+    skew-aware execution on vs SKEW(OFF), per-theta rows/sec/chip plus the
+    observed shard-skew ratio (max/mean live rows per shard of the join
+    stage) and steady-state retrace counts.
+
+    The Q9 shape: a Zipf-keyed fact joining two dimension tables sized above
+    the broadcast threshold (so both joins hash-shuffle — the skew-sensitive
+    plan), feeding a grouped aggregate.  rows/sec/chip divides by the mesh
+    size: the 8 virtual devices share this host's cores."""
+    from galaxysql_tpu.exec import operators as _ops
+    from galaxysql_tpu.parallel.mesh import make_mesh
+    from galaxysql_tpu.parallel.mpp import MppExecutor
+    from galaxysql_tpu.plan.physical import ExecContext
+    from galaxysql_tpu.server.instance import Instance
+    from galaxysql_tpu.server.session import Session
+
+    S = 8
+    n = int(os.environ.get("BENCH_SKEW_ROWS", str(2_000_000)))
+    k = int(os.environ.get("BENCH_SKEW_KEYS", str(600_000)))
+    reps = max(1, int(os.environ.get("BENCH_SKEW_RUNS", "3")))
+    rng = np.random.default_rng(17)
+    mesh = make_mesh(S)
+    out = []
+    q = ("SELECT d.attr, d2.attr, COUNT(*), SUM(f.v) "
+         "FROM fact f, dim d, dim2 d2 "
+         "WHERE f.k = d.k AND f.k2 = d2.k GROUP BY d.attr, d2.attr")
+
+    # theta sweep per the Zipf literature (top-key mass ~19% at theta=1.2)
+    # plus the production hot-key-incident shape: ONE key holding 35% — the
+    # case the off path's overflow ladder hurts most
+    for theta, label in ((0.0, "theta0"), (0.8, "theta08"),
+                         (1.2, "theta12"), ("hot", "hotkey35")):
+        inst = Instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE skb; USE skb")
+        s.execute("CREATE TABLE fact (id BIGINT PRIMARY KEY, k BIGINT, "
+                  "k2 BIGINT, v BIGINT) PARTITION BY HASH(id) PARTITIONS 8")
+        if theta == "hot":
+            p = np.full(k, 0.65 / (k - 1))
+            p[7] = 0.35
+            keys = rng.choice(k, size=n, p=p)
+            keys2 = rng.choice(k, size=n, p=p)
+        elif theta > 0:
+            p = np.arange(1, k + 1, dtype=np.float64) ** -theta
+            p /= p.sum()
+            keys = rng.choice(k, size=n, p=p)
+            keys2 = rng.choice(k, size=n, p=p)
+        else:
+            keys = rng.integers(0, k, size=n)
+            keys2 = rng.integers(0, k, size=n)
+        inst.store("skb", "fact").insert_arrays(
+            {"id": np.arange(n, dtype=np.int64),
+             "k": keys.astype(np.int64), "k2": keys2.astype(np.int64),
+             "v": rng.integers(0, 1000, size=n).astype(np.int64)},
+            inst.tso.next_timestamp())
+        for dim, mul in (("dim", 7919), ("dim2", 104729)):
+            s.execute(f"CREATE TABLE {dim} (did BIGINT PRIMARY KEY, "
+                      "k BIGINT, attr BIGINT) "
+                      "PARTITION BY HASH(did) PARTITIONS 8")
+            inst.store("skb", dim).insert_arrays(
+                {"did": (np.arange(k, dtype=np.int64) * mul) % (1 << 30),
+                 "k": np.arange(k, dtype=np.int64),
+                 "attr": np.arange(k, dtype=np.int64) % 11},
+                inst.tso.next_timestamp())
+        s.execute("ANALYZE TABLE fact, dim, dim2")
+
+        def once(sql, collect=False):
+            plan = inst.planner.plan_select(sql, "skb")
+            ctx = ExecContext(inst.stores, inst.tso.next_timestamp(), [],
+                              archive=inst.archive, archive_instance=inst,
+                              hints=plan.hints)
+            ctx.collect_stats = collect
+            t0 = time.perf_counter()
+            MppExecutor(ctx, mesh).execute(plan.rel)
+            return time.perf_counter() - t0, ctx
+
+        def best(sql):
+            once(sql)  # compile warmup
+            _ops.reset_compile_stats()
+            ts = []
+            for _ in range(reps):
+                inst.frag_cache.clear()
+                ts.append(once(sql)[0])
+            return min(ts), _ops.COMPILE_STATS["retraces"]
+
+        t_on, retraces = best(q)
+        t_off, _ = best("/*+TDDL: SKEW(OFF)*/ " + q)
+        # shard-skew ratio of the join stages, measured on the OFF path (the
+        # imbalance the hybrid removes); profiled run, excluded from timing
+        inst.frag_cache.clear()
+        _, ctx = once("/*+TDDL: SKEW(OFF)*/ " + q, collect=True)
+        ratios = [st["shard_skew"] for st in ctx.op_stats
+                  if st.get("shard_skew")]
+        _, ctx_on = once(q)
+        out.append({
+            "metric": f"tpch_q9_skew_{label}_rows_per_sec_per_chip",
+            "value": round(n / t_on / S, 1), "unit": "rows/s",
+            "vs_skew_off": round(t_off / t_on, 3),
+            "skew_off_rows_per_sec_per_chip": round(n / t_off / S, 1),
+            "shard_skew_ratio_off": max(ratios) if ratios else None,
+            "hybrid_engaged": any("mpp-hybrid-join" in t
+                                  for t in ctx_on.trace),
+            "salted": any("mpp-salted-agg" in t for t in ctx_on.trace),
+            "retraces_steady": retraces, "theta": theta,
+            "platform": platform, "mesh": S,
+        })
+        s.close()
+    return out
+
+
+def skew_only_main():
+    """`bench.py --skew-only` (make bench-skew): the Zipf theta sweep on the
+    8-virtual-device mesh."""
+    for line in skew_bench(jax.devices()[0].platform):
+        print(json.dumps(line))
+
+
 def main():
     sf = float(os.environ.get("BENCH_SF", "0.2"))
     runs = int(os.environ.get("BENCH_RUNS", "3"))
@@ -616,6 +743,17 @@ def main():
     # -- mega-batched TP serving: closed-loop multi-session QPS ---------------
     if os.environ.get("BENCH_BATCH", "1") != "0":
         results.extend(batch_serving_bench(inst, s, data, platform))
+
+    # -- skew-aware execution: Zipf theta sweep on Q9-like joins --------------
+    # needs the 8-device mesh; single-device runs use `bench.py --skew-only`
+    # (which forces 8 virtual CPU devices) / `make bench-skew`
+    if os.environ.get("BENCH_SKEW", "1") != "0" and len(jax.devices()) >= 8:
+        try:
+            results.extend(skew_bench(platform))
+        except Exception as e:
+            # best-effort (headline lines still print) but never silent: a
+            # dashboard must see WHY the tpch_q9_skew_* lines disappeared
+            print(f"skew bench failed: {e!r}", file=sys.stderr)
 
     # -- TPC-H Q3: 3-way join + high-NDV agg + top-n ---------------------------
     q3_best, q3_d, q3_c = _bench_query_d(s, QUERIES[3], runs)
@@ -811,5 +949,7 @@ def batch_only_main():
 if __name__ == "__main__":
     if "--batch-only" in sys.argv:
         batch_only_main()
+    elif "--skew-only" in sys.argv:
+        skew_only_main()
     else:
         main()

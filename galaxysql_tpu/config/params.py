@@ -79,6 +79,12 @@ ENABLE_MPP = _p("ENABLE_MPP", True, "SPMD mesh execution for AP queries")
 MPP_PARALLELISM = _p("MPP_PARALLELISM", 8, "devices per query")
 MPP_MIN_AP_ROWS = _p("MPP_MIN_AP_ROWS", 1 << 22, "rows before cluster MPP kicks in")
 
+ENABLE_SKEW_EXECUTION = _p(
+    "ENABLE_SKEW_EXECUTION", True,
+    "skew-aware distributed execution (exec/skew.py): heavy-hitter hybrid "
+    "broadcast/shuffle joins and salted aggregation on the MPP mesh; "
+    "planted skew plans go inert when off (cached plans stay valid)")
+
 # --- CCL ----------------------------------------------------------------------
 CCL_MAX_CONCURRENCY = _p("CCL_MAX_CONCURRENCY", 0, "0 = unlimited")
 CCL_WAIT_QUEUE_SIZE = _p("CCL_WAIT_QUEUE_SIZE", 64, "")

@@ -190,15 +190,24 @@ def _fp(node, ctx, frag, tables, plans, targets) -> Tuple:
         return ("p", tuple((n, _expr_key(e)) for n, e in node.exprs),
                 _fp(node.child, ctx, frag, tables, plans, targets))
     if isinstance(node, L.Aggregate):
+        from galaxysql_tpu.exec import skew as _skew
         return ("a", tuple((n, _expr_key(e)) for n, e in node.groups),
                 tuple((a.kind, _expr_key(a.arg), a.out_id, a.distinct)
                       for a in node.aggs),
+                # salted execution changes float-summation order: cached MPP
+                # twins must not cross the salt boundary, and a re-ANALYZE
+                # that shifts the hot-key candidates re-keys the entry
+                _skew.node_signature(node, ctx),
                 _fp(node.child, ctx, frag, tables, plans, targets))
     if isinstance(node, L.Join):
+        from galaxysql_tpu.exec import skew as _skew
         plans.update(p.filter_id for p in getattr(node, "rf_plans", []) or [])
         return ("j", node.kind, getattr(node, "scalar", False),
                 tuple((_expr_key(a), _expr_key(b)) for a, b in node.equi),
                 _expr_key(node.residual),
+                # hybrid-join hot-key set: an artifact computed over one hot
+                # set must go unreachable when ANALYZE shifts the candidates
+                _skew.node_signature(node, ctx),
                 _fp(node.left, ctx, frag, tables, plans, targets),
                 _fp(node.right, ctx, frag, tables, plans, targets))
     if isinstance(node, L.Sort):

@@ -1026,7 +1026,8 @@ class HashJoinOp(Operator):
                  spill_threshold: int = 256 << 20,
                  enable_bloom: bool = True, probe_prelude=None,
                  rf_publish=None, rf_manager=None,
-                 frag_cache=None, frag_key=None, frag_note=None):
+                 frag_cache=None, frag_key=None, frag_note=None,
+                 skew_watch=None):
         assert join_type in ("inner", "left", "semi", "anti")
         # filter-only fused segment (exec/fusion.FusedSegment) ANDed into the
         # probe live mask INSIDE the probe kernels: the WHERE above the probe
@@ -1057,6 +1058,11 @@ class HashJoinOp(Operator):
         self.frag_cache = frag_cache
         self.frag_key = frag_key
         self.frag_note = frag_note
+        # heavy-hitter runtime refresh (meta/statistics.observe_build_keys):
+        # (TableMeta, column, field id) per build key that is a bare scan
+        # column — the materialized build lane feeds the column's runtime
+        # sketch so skew detection stays fresh between ANALYZE runs
+        self.skew_watch = list(skew_watch or [])
 
     def _key_compilers(self):
         """Compile key pairs into a common lane domain.
@@ -1586,6 +1592,16 @@ class HashJoinOp(Operator):
         from galaxysql_tpu.exec import runtime_filter as _rf
         _rf.publish_captured(self.rf_manager, self.rf_publish, art.filters)
 
+    def _observe_skew(self, build_batch: ColumnBatch):
+        from galaxysql_tpu.meta import statistics as _stats
+        live = build_batch.np_live()
+        for tm, colname, fid in self.skew_watch:
+            c = build_batch.columns.get(fid)
+            if c is None:
+                continue
+            mask = live if c.valid is None else (live & c.np_valid())
+            _stats.observe_build_keys(tm, colname, c.np_data()[mask])
+
     def _empty_build_batches(self) -> Iterator[ColumnBatch]:
         # empty build: inner/semi yield nothing; anti passes probe rows through;
         # left null-extends using the declared build schema
@@ -1651,6 +1667,11 @@ class HashJoinOp(Operator):
             # side gathered out of an upstream join is mostly dead rows —
             # host-compact first (sub-ms at build sizes)
             build_batch = build_batch.compact()
+        if self.skew_watch and build_batch.capacity and K.prefer_scatter():
+            # heavy-hitter refresh from the lanes this pass just materialized
+            # on the host; the TPU path skips (lanes are device-resident and
+            # the refresh must never add a sync)
+            self._observe_skew(build_batch)
         art = self._frag_admit(build_batch)
         if build_batch.capacity == 0:
             if art is not None:

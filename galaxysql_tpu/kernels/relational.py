@@ -706,9 +706,9 @@ def _hash_join_pairs_table(build_keys, probe_keys, build_live, probe_live,
     npr = probe_keys[0][0].shape[0]
 
     M = 1 << max(4, int(nb * 4 - 1).bit_length())
-    h_b = hash_columns(build_keys)
-    s_b = (h_b & jnp.uint64(M - 1)).astype(jnp.int32)
-    s_b = jnp.where(b_live, s_b, jnp.int32(M))  # dead rows -> scratch slot
+    # slot-id lane shared with the host-CSR path (hash + dead-row masking):
+    # one definition both join formulations and the hybrid union probe reuse
+    s_b = hash_join_build_slots(build_keys, build_live, M)
     # CSR: build row ids grouped by slot (argsort of the small side)
     perm = jnp.argsort(s_b).astype(jnp.int32)
     slot_counts = jax.ops.segment_sum(jnp.ones(nb, jnp.int32), s_b,
@@ -807,6 +807,39 @@ def hash_join_probe_csr(build_keys, probe_keys, build_live, probe_live,
         if npr else jnp.zeros(0, jnp.bool_)
 
     return JoinPairs(b_of, p_of, verified, probe_matched, starts, offsets, overflow)
+
+
+def hot_key_mask(keys: Sequence[Tuple[Any, Optional[Any]]],
+                 hot_hashes: Any, hot_valid: Any) -> Any:
+    """Heavy-hitter classification lane for the skew-aware hybrid join.
+
+    True where the row's combined key hash (the SAME `hash_columns` lane the
+    repartition destinations derive from) is one of the `hot_hashes` runtime
+    values (`hot_valid` masks the static padding slots — the hot-set size is
+    a runtime property and must not retrace).  Classification is purely
+    hash-based ON PURPOSE: a cold key colliding with a hot hash is classified
+    hot on BOTH sides of the join, so the broadcast/shuffle lanes stay
+    consistent and correctness never depends on the hot set's contents."""
+    h = hash_columns(keys)
+    hit = (h[:, None] == hot_hashes[None, :]) & hot_valid[None, :]
+    return jnp.any(hit, axis=1)
+
+
+def hash_join_probe_hybrid(build_keys: Sequence[Tuple[Any, Optional[Any]]],
+                           probe_keys: Sequence[Tuple[Any, Optional[Any]]],
+                           build_live: Any, probe_live: Any,
+                           cap: int) -> JoinPairs:
+    """Union-lane probe of the skew-aware hybrid join.
+
+    The caller concatenates each shard's two build partitions — the broadcast
+    hot lane and the hash-shuffled cold lane — and likewise the two probe
+    partitions (locally-kept hot rows + shuffled cold rows); this entry
+    enumerates verified pairs over the union in ONE pass with the standard
+    fixed-shape/overflow contract.  Both lanes go through the same build-slot
+    construction (`hash_join_build_slots` inside the table formulation), so
+    the hybrid probe costs one program, not one per lane, and shares its
+    backend-adaptive formulation with `hash_join_pairs`."""
+    return hash_join_pairs(build_keys, probe_keys, build_live, probe_live, cap)
 
 
 def probe_matched_from(pair_live: Any, starts: Any, offsets: Any) -> Any:

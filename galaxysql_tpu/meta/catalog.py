@@ -81,6 +81,10 @@ class TableStats:
     # equi-depth histograms + HLL sketches (meta/statistics.py), built by ANALYZE
     histograms: Dict[str, Any] = dataclasses.field(default_factory=dict)
     sketches: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # heavy-hitter (Space-Saving) sketches: ANALYZE truth + the runtime twin
+    # refreshed from hash-join build sides (meta/statistics.observe_build_keys)
+    heavy: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    heavy_rt: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
 class TableMeta:

@@ -1,16 +1,23 @@
-"""Column statistics: equi-depth histograms + HyperLogLog NDV sketches.
+"""Column statistics: equi-depth histograms + HLL NDV + heavy-hitter sketches.
 
 Reference analog: `polardbx-optimizer/.../config/table/statistic/Histogram.java`
 (equi-depth buckets driving range selectivity) and `executor/statistic/ndv/*`
 (HLL sketches, mergeable per-shard so ANALYZE can union partition sketches
 without a global distinct pass).  `_selectivity` in plan/rules.py consults
 these instead of hard-coded guesses, so skewed data can flip the join order.
+
+`HeavyHitterSketch` (Space-Saving / batched Misra-Gries) tracks the frequent
+lane values of each column: ANALYZE builds one per column alongside the
+HLL/histogram, and hash-join build sides refresh a runtime twin as they
+materialize key columns (exec/operators.HashJoinOp) — the skew-aware planner
+(plan/rules.plan_skew + exec/skew.py) reads both to decide hybrid
+broadcast/shuffle joins and salted aggregation.
 """
 
 from __future__ import annotations
 
 import base64
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -75,6 +82,93 @@ class NdvSketch:
     @classmethod
     def from_json(cls, s: str) -> "NdvSketch":
         return cls(np.frombuffer(base64.b64decode(s), dtype=np.uint8).copy())
+
+
+class HeavyHitterSketch:
+    """Frequent-item sketch over lane values (Space-Saving / batched
+    Misra-Gries).  At most K counters; after folding a batch in, the
+    (K+1)-th largest count is subtracted from every counter and non-positive
+    counters drop — the classic MG guarantee survives batching: any value
+    with true frequency above total/K is retained, and a retained counter
+    under-estimates its true count by at most total/K.
+
+    Mergeable (counter-wise sum + one prune) so ANALYZE unions per-partition
+    sketches, and cheap to refresh from hash-join build sides at runtime:
+    `add_array` is one np.unique over an already-host-resident lane.  Values
+    are stored in LANE domain (dictionary codes for strings, scaled ints for
+    decimals, day numbers for dates) — the same domain join-key hashing and
+    repartitioning operate in."""
+
+    K = 64
+
+    def __init__(self, counts: Optional[Dict[Any, int]] = None,
+                 total: int = 0):
+        self.counts: Dict[Any, int] = counts if counts is not None else {}
+        self.total = int(total)
+
+    def add_array(self, values: np.ndarray):
+        if values.size == 0:
+            return
+        if values.dtype.kind == "f":
+            values = values[~np.isnan(values)]
+            if values.size == 0:
+                return
+        vals, cnts = np.unique(values, return_counts=True)
+        self.total += int(values.size)
+        counts = self.counts
+        if vals.size > 32 * self.K:
+            # high-NDV batch: only its top counts (plus already-tracked
+            # values) can survive the MG prune — fold just those instead of
+            # paying a Python dict op per distinct value (measured ~150ms
+            # for a 600k-distinct lane; this is on the hash-join hot path).
+            # A value frequent in the STREAM is frequent in the batch, so
+            # the retained-candidate guarantee is preserved; dropped tail
+            # values only deepen the (already bounded) undercount.
+            top = np.argpartition(cnts, -32 * self.K)[-32 * self.K:]
+            keep = np.zeros(vals.size, dtype=np.bool_)
+            keep[top] = True
+            if counts:
+                keep |= np.isin(vals, np.asarray(list(counts),
+                                                 dtype=vals.dtype))
+            vals, cnts = vals[keep], cnts[keep]
+        for v, c in zip(vals.tolist(), cnts.tolist()):
+            counts[v] = counts.get(v, 0) + int(c)
+        self._prune()
+
+    def merge(self, other: "HeavyHitterSketch") -> "HeavyHitterSketch":
+        out = dict(self.counts)
+        for v, c in other.counts.items():
+            out[v] = out.get(v, 0) + c
+        m = HeavyHitterSketch(out, self.total + other.total)
+        m._prune()
+        return m
+
+    def _prune(self):
+        if len(self.counts) <= self.K:
+            return
+        ordered = sorted(self.counts.values(), reverse=True)
+        cut = ordered[self.K]  # (K+1)-th largest count
+        self.counts = {v: c - cut for v, c in self.counts.items() if c > cut}
+
+    def candidates(self, min_frac: float) -> List[Tuple[Any, float]]:
+        """(value, estimated frequency) for every retained counter at or above
+        `min_frac` of the observed total, most frequent first."""
+        if self.total <= 0:
+            return []
+        out = [(v, c / self.total) for v, c in self.counts.items()
+               if c / self.total >= min_frac]
+        out.sort(key=lambda x: (-x[1], repr(x[0])))
+        return out
+
+    def to_json(self) -> dict:
+        # lane values are numeric scalars (codes/ints/floats): json-native
+        return {"counts": [[v, c] for v, c in self.counts.items()],
+                "total": self.total}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "HeavyHitterSketch":
+        return cls({v: int(c) for v, c in d.get("counts", [])},
+                   int(d.get("total", 0)))
 
 
 class Histogram:
@@ -148,6 +242,7 @@ def analyze_store(tm, store, sample_cap: int = 262144):
     per_part = max(sample_cap // max(len(store.partitions), 1), 4096)
     for c in tm.columns:
         sk = NdvSketch()
+        hh = HeavyHitterSketch()
         samples: List[np.ndarray] = []
         col_min = col_max = None
         for p in store.partitions:
@@ -157,6 +252,7 @@ def analyze_store(tm, store, sample_cap: int = 262144):
             if vals.size == 0:
                 continue
             sk.add_array(vals)  # per-partition sketch; np.maximum.at merges
+            hh.add_array(vals)  # frequent items fold across partitions too
             if vals.size > per_part:
                 # strided sample: a leading-prefix slice of insertion-ordered
                 # data (e.g. monotone timestamps) sees only the oldest rows and
@@ -176,7 +272,33 @@ def analyze_store(tm, store, sample_cap: int = 262144):
             ndv = int(len(np.unique(vals)))
         tm.stats.ndv[c.name] = ndv
         tm.stats.sketches[c.name] = sk
+        tm.stats.heavy[c.name] = hh
+        # ANALYZE resets the runtime refresh: fresh full-table truth wins
+        tm.stats.heavy_rt.pop(c.name, None)
         if vals.size and not c.dtype.is_string:
             # min/max over the FULL valid lanes, not the sample
             tm.stats.min_max[c.name] = (col_min, col_max)
             tm.stats.histograms[c.name] = Histogram.build(vals, ndv)
+
+
+# minimum live build rows before a runtime observation is worth folding in: a
+# tiny (or heavily filtered) build side says nothing about column skew
+RUNTIME_HH_MIN_ROWS = 4096
+
+
+def observe_build_keys(tm, column: str, values: np.ndarray):
+    """Runtime heavy-hitter refresh from a materialized hash-join build side.
+
+    The build pass already holds the key lane on the host (exec/operators.py
+    CSR construction — no extra device sync), so folding it into a sketch is
+    one np.unique.  Observations land in `tm.stats.heavy_rt` — a runtime twin
+    of the ANALYZE sketch, NOT the sketch itself: build sides are filtered
+    subsets, so their frequencies refresh the drift re-check
+    (exec/skew.recheck) without rewriting the planner's full-table truth.
+    ANALYZE clears the twin."""
+    if values.size < RUNTIME_HH_MIN_ROWS:
+        return
+    hh = tm.stats.heavy_rt.get(column)
+    if hh is None:
+        hh = tm.stats.heavy_rt[column] = HeavyHitterSketch()
+    hh.add_array(values)

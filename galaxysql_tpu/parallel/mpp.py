@@ -37,6 +37,7 @@ from galaxysql_tpu.exec.operators import (DISPATCH_STATS, AggCall, HashAggOp,
                                           SortOp, SourceOp, broadcast_value,
                                           bucket_capacity, expr_cache_key,
                                           global_jit)
+from galaxysql_tpu.exec import skew
 from galaxysql_tpu.expr import ir
 from galaxysql_tpu.expr.compiler import ExprCompiler, _find_dictionary
 from galaxysql_tpu.kernels import relational as K
@@ -50,6 +51,37 @@ BROADCAST_BUILD_LIMIT = 1 << 19  # est. rows: at or below, broadcast the build s
 
 SHARD = P("shard")
 REP = P()
+
+
+def _shard_skew_ratio(per_shard) -> Optional[float]:
+    """max/mean live rows per shard, or None for an empty stage."""
+    total = float(np.sum(per_shard))
+    if total <= 0:
+        return None
+    mean = total / len(per_shard)
+    return round(float(np.max(per_shard)) / mean, 2)
+
+
+def _pack_lanes(pairs):
+    """Flatten [(data, valid)] lanes into one exchange payload: data lanes
+    first, then the non-None valid lanes — `_unpack_lanes` mirrors the
+    layout.  The ONE home for this convention (shuffles, broadcasts and the
+    salted-agg repartition all move lanes through it)."""
+    return [d for d, _v in pairs] + [v for _d, v in pairs if v is not None]
+
+
+def _unpack_lanes(out_lanes, template):
+    """Rebuild [(data, valid)] pairs from an exchange's output lanes, using
+    `template` (the pre-exchange pairs) for validity presence."""
+    vix = len(template)
+    res = []
+    for i, (_d, v) in enumerate(template):
+        nv = None
+        if v is not None:
+            nv = out_lanes[vix]
+            vix += 1
+        res.append((out_lanes[i], nv))
+    return res
 
 
 @dataclasses.dataclass
@@ -66,14 +98,17 @@ class DistBatch:
 
 
 def _join_block(benv, blive, penv, plive, bk, pk, kind, residual_pred, cap,
-                build_ids, probe_ids):
+                build_ids, probe_ids, pairs_fn=K.hash_join_pairs):
     """Per-shard equi-join: returns ((cols, live), overflow).
 
     For inner/left the output region is [cap] matched pairs; left joins append a
-    [R_probe] region of null-extended unmatched probe rows (fixed total shape)."""
+    [R_probe] region of null-extended unmatched probe rows (fixed total shape).
+    `pairs_fn` is the pair-enumeration kernel — the default sorted/CSR probe,
+    or `hash_join_probe_hybrid` when the caller unioned broadcast + shuffled
+    partitions (skew-aware hybrid join)."""
     bkeys = [f(benv) for f in bk]
     pkeys = [f(penv) for f in pk]
-    pairs = K.hash_join_pairs(bkeys, pkeys, blive, plive, cap)
+    pairs = pairs_fn(bkeys, pkeys, blive, plive, cap)
     over = pairs.overflow
 
     bcols = {i: (benv[i][0][pairs.build_idx],
@@ -172,10 +207,22 @@ class MppExecutor:
         sp.attrs["rows"] = int(live.sum())
         sp.attrs["replicated"] = out.replicated
         if not out.replicated and live.size and live.size % self.S == 0:
-            for si, rn in enumerate(live.reshape(self.S, -1).sum(axis=1)):
+            per_shard = live.reshape(self.S, -1).sum(axis=1)
+            for si, rn in enumerate(per_shard):
                 tc.add(f"shard{si}", kind="shard", parent=sp.span_id,
                        start_us=sp.start_us, dur_us=sp.dur_us,
                        shard=si, rows=int(rn))
+            ratio = _shard_skew_ratio(per_shard)
+            if ratio is not None:
+                # skew = max/mean live rows per shard: 1.0 is perfectly
+                # balanced, ~S means one shard holds everything
+                sp.attrs["skew"] = ratio
+                self._note_shard_skew(ratio)
+        info = getattr(self.ctx, "skew_stats", {}).get(id(node))
+        if info is not None:
+            # the hybrid/salted decision rides the stage span (HotKeys /
+            # Salted in information_schema.query_spans and /trace/<id>)
+            sp.attrs["skew_exec"] = skew.explain_line(info)
         return out
 
     def _run_collect(self, node: L.RelNode) -> DistBatch:
@@ -197,10 +244,24 @@ class MppExecutor:
               "replicated": out.replicated}
         if not out.replicated and live.size % self.S == 0:
             # per-shard task stats: shard s owns slice s of the [S*R] layout
-            st["rows_per_shard"] = [int(x) for x in
-                                    live.reshape(self.S, -1).sum(axis=1)]
+            per_shard = live.reshape(self.S, -1).sum(axis=1)
+            st["rows_per_shard"] = [int(x) for x in per_shard]
+            ratio = _shard_skew_ratio(per_shard)
+            if ratio is not None:
+                st["shard_skew"] = ratio
+                self._note_shard_skew(ratio)
         self.ctx.op_stats.append(st)
         return out
+
+    def _note_shard_skew(self, ratio: float):
+        """`mpp_shard_skew` gauge: max/mean live rows per shard of the last
+        profiled/traced MPP stage (slow-query triage without a full trace)."""
+        inst = getattr(self.ctx, "archive_instance", None)
+        m = getattr(inst, "metrics", None)
+        if m is not None:
+            m.gauge("mpp_shard_skew",
+                    "max/mean live rows per shard (last profiled MPP stage)"
+                    ).set(ratio)
 
     def _run_node(self, node: L.RelNode) -> DistBatch:
         if isinstance(node, L.Scan):
@@ -429,6 +490,16 @@ class MppExecutor:
                 child_node = base
                 self.ctx.trace.append(f"mpp-fuse-agg-prelude {prelude.chain}")
         child = self.run(child_node)
+        factor = skew.active_salt(node, self.ctx, self.S)
+        if factor is not None and not child.replicated:
+            p = node.salt_plan
+            self.ctx.trace.append(
+                f"mpp-salted-agg factor={factor} col={p.table}.{p.column}")
+            skew.note(self.ctx, node, kind="agg", factor=factor,
+                      column=f"{p.table}.{p.column}")
+            return self._aggregate_salted(child, node.groups, calls,
+                                          estimate_rows(node), factor,
+                                          prelude=prelude)
         return self._aggregate_batch(child, node.groups, calls,
                                      estimate_rows(node), prelude=prelude)
 
@@ -464,23 +535,7 @@ class MppExecutor:
 
         def build():
             papply = prelude.build_apply(jnp) if prelude is not None else None
-            comp = ExprCompiler(jnp)
-            gfns = [comp.compile(e) for _, e in groups]
-            ifns = []
-            for e in inputs:
-                f = comp.compile(e)
-                d_ = _find_dictionary(e) if e.dtype.is_string else None
-                from galaxysql_tpu.types import collation as _coll
-                if d_ is not None and len(d_) and (
-                        not d_.is_sorted or
-                        _coll.collation_of_expr(e) is not None):
-                    rank = _coll.sort_rank_array(e, d_)
-
-                    def ranked(env, _f=f, _r=rank):
-                        dd, vv = _f(env)
-                        return jnp.asarray(_r)[dd], vv
-                    f = ranked
-                ifns.append(f)
+            gfns, ifns = _agg_expr_fns(groups, inputs)
 
             def local_partial(env, live, plits):
                 n = live.shape[0]
@@ -526,6 +581,109 @@ class MppExecutor:
         r, overflow = global_jit(key, build)(child.env(), child.live, plits)
         return r, bool(overflow)
 
+    def _aggregate_salted(self, child: DistBatch, groups, calls, est: float,
+                          factor: int, prelude=None) -> DistBatch:
+        """Skew-aware salted aggregation (plan/rules.plan_skew's SaltAggPlan).
+
+        Rows repartition on hash(group key, salt) with salt = row % factor —
+        a hot group's rows spread over `factor` destination shards instead of
+        piling one — then each shard aggregates its received rows and a final
+        merge stage re-combines the (at most factor x S) partials per group.
+        One fused SPMD program per round, same overflow-retry discipline and
+        finalize as the default partial-merge path, so results are identical
+        up to float-summation order."""
+        helper = HashAggOp(None, groups, calls)
+        inputs, lanes = helper._partial_specs()
+        lane_names = tuple(name for name, _ in lanes)
+        specs = tuple(s for _, s in lanes)
+        merge_specs = tuple(
+            K.AggSpec("sum" if s.kind in ("count", "count_star", "sum")
+                      else s.kind, i)
+            for i, (_, s) in enumerate(lanes))
+        R = int(child.live.shape[0]) // self.S
+        quota = max(2 * R // self.S, 128)
+        G = 1 << max(int(est * 2).bit_length(), 8)
+        while True:
+            r, over_shuffle, over_groups = self._salted_agg_round(
+                groups, child, inputs, specs, merge_specs, G, factor, quota,
+                prelude)
+            if not (over_shuffle or over_groups):
+                break
+            if over_shuffle:
+                quota *= 2
+            if over_groups:
+                G *= 2
+            if max(quota, G) > (1 << 22):
+                raise errors.TddlError(
+                    "MPP salted aggregation exceeds capacity ceiling")
+        batch = helper._finalize(jax.tree.map(jnp.asarray, r), lane_names)
+        return DistBatch(batch.columns, batch.live_mask(), True)
+
+    def _salted_agg_round(self, groups, child, inputs, specs, merge_specs,
+                          G, factor, quota, prelude=None):
+        key = ("mpp_agg_salt", jax.default_backend(),
+               tuple((n, expr_cache_key(e)) for n, e in groups),
+               tuple(expr_cache_key(e) for e in inputs), specs, G, factor,
+               self.S, quota,
+               prelude.key() if prelude is not None else None)
+
+        def build():
+            papply = prelude.build_apply(jnp) if prelude is not None else None
+            gfns, ifns = _agg_expr_fns(groups, inputs)
+
+            def spmd(env, live, plits):
+                if papply is not None:
+                    env, live = papply(env, live, plits)
+                n = live.shape[0]
+                keys0 = [broadcast_value(n, *f(env)) for f in gfns]
+                ins0 = [broadcast_value(n, *f(env)) for f in ifns]
+                # salted destination: the key hash (NULL-tagged, exactly the
+                # lane a plain repartition would use) mixed with row % factor
+                kh = K.hash_columns(keys0) if keys0 else \
+                    jnp.zeros(n, jnp.uint64)
+                salt = jnp.arange(n, dtype=jnp.uint64) % jnp.uint64(factor)
+                dh = K.hash_columns([(kh, None), (salt, None)])
+                pairs = keys0 + ins0
+                out_lanes, live_x, over_x = exchange.repartition_by_hash(
+                    _pack_lanes(pairs), live, dh, quota)
+                moved = _unpack_lanes(out_lanes, pairs)
+                keys = moved[:len(keys0)]
+                ins = moved[len(keys0):]
+                r = K.groupby(keys, ins, specs, live_x, G)
+
+                # final merge stage: gather every shard's partial groups and
+                # re-combine the salt buckets (replicated result)
+                def gather_pairs(prs):
+                    out = []
+                    for d, v in prs:
+                        dg = jax.lax.all_gather(d, "shard", axis=0).reshape(-1)
+                        vg = None if v is None else \
+                            jax.lax.all_gather(v, "shard",
+                                               axis=0).reshape(-1)
+                        out.append((dg, vg))
+                    return out
+
+                flat_keys = gather_pairs(r.keys)
+                flat_aggs = gather_pairs(r.aggs)
+                live_g = jax.lax.all_gather(r.live, "shard",
+                                            axis=0).reshape(-1)
+                m = K.groupby(flat_keys, flat_aggs, merge_specs, live_g, G)
+
+                def rep(x):
+                    return jax.lax.pmax(x.astype(jnp.int32),
+                                        "shard").astype(jnp.bool_)
+                return m, (rep(over_x), rep(r.overflow | m.overflow))
+
+            fn = shard_map(spmd, mesh=self.mesh, in_specs=(SHARD, SHARD, REP),
+                           out_specs=(REP, REP), check_vma=False)
+            return jax.jit(fn)
+
+        plits = prelude.lits() if prelude is not None else ()
+        DISPATCH_STATS["dispatches"] += 1
+        r, flags = global_jit(key, build)(child.env(), child.live, plits)
+        over_shuffle, over_groups = (bool(x) for x in flags)
+        return r, over_shuffle, over_groups
+
     # -- join ------------------------------------------------------------------------
 
     def _join(self, node: L.Join) -> DistBatch:
@@ -563,8 +721,19 @@ class MppExecutor:
             out = self._broadcast_join(node, build, probe, build_keys, probe_keys,
                                        build_ids, probe_ids)
         else:
-            out = self._shuffle_join(node, build, probe, build_keys, probe_keys,
-                                     build_ids, probe_ids)
+            # shuffle shape: a heavy-hitter probe key would pile one shard —
+            # hybrid-split when planning planted a skew plan for the side we
+            # actually probe AND its stats survive the runtime re-check
+            active = skew.active_join_skew(
+                node, self.ctx, "left" if probe_node is node.left else "right",
+                self.S)
+            if active is not None:
+                out = self._hybrid_join(node, build, probe, build_keys,
+                                        probe_keys, build_ids, probe_ids,
+                                        active)
+            else:
+                out = self._shuffle_join(node, build, probe, build_keys,
+                                         probe_keys, build_ids, probe_ids)
         return self._join_result(node, out, build_ids, probe_ids)
 
     def _build_side(self, node: L.Join, build_node: L.RelNode) -> DistBatch:
@@ -723,20 +892,12 @@ class MppExecutor:
                         keys = [f(env) for f in key_fns]
                         h = K.hash_columns(keys)
                         ids = list(env.keys())
-                        lanes = [env[i][0] for i in ids]
-                        vlanes = [env[i][1] for i in ids]
-                        payload = list(lanes) + [v for v in vlanes if v is not None]
+                        pairs = [env[i] for i in ids]
                         out_lanes, live_x, over = exchange.repartition_by_hash(
-                            payload, live, h, quota)
-                        new_env = {}
-                        vix = len(lanes)
-                        for k2, i in enumerate(ids):
-                            v = None
-                            if vlanes[k2] is not None:
-                                v = out_lanes[vix]
-                                vix += 1
-                            new_env[i] = (out_lanes[k2], v)
-                        return new_env, live_x, over
+                            _pack_lanes(pairs), live, h, quota)
+                        return (dict(zip(ids, _unpack_lanes(out_lanes,
+                                                            pairs))),
+                                live_x, over)
 
                     benv2, blive2, over_b = shuffle_side(benv, blive, bk, _qb)
                     penv2, plive2, over_p = shuffle_side(penv, plive, pk, _qp)
@@ -767,6 +928,218 @@ class MppExecutor:
                 cap *= 2
             if max(quota_b, quota_p, cap) > (1 << 24):
                 raise errors.TddlError("MPP shuffle exceeds capacity ceiling")
+
+    def _hybrid_join(self, node, build, probe, build_keys, probe_keys,
+                     build_ids, probe_ids, active):
+        """Skew-aware hybrid shuffle join (JSPIM-style hot/cold split).
+
+        The skewed side's hot rows STAY WHERE THE SCAN LAYOUT ALREADY
+        BALANCED THEM — the hash shuffle is what concentrates them — and the
+        OTHER side's hot rows (few: the matching dimension/probe rows) are
+        BROADCAST to every shard, compacted into a fixed `hot_quota` lane
+        then all-gathered.  Cold rows of both sides hash-shuffle exactly as
+        `_shuffle_join`, with quotas sized for the unskewed remainder.
+        Orientation 'probe' = skew on the probe side (hot build rows
+        broadcast); orientation 'build' = skew on the build side (hot probe
+        rows broadcast; inner joins only — a broadcast probe row would
+        multiply unmatched left/semi/anti semantics S-fold).  Each shard then
+        probes the UNION of the broadcast and shuffled partitions through one
+        `hash_join_probe_hybrid` pass, all fused under one global_jit key:
+        the hot-hash set rides as a padded runtime argument, so steady-state
+        retraces stay 0 while the hot keys drift.
+
+        Classification is by the SAME combined key hash both repartitions
+        use, computed on BOTH sides, so a hot row's matches are always
+        resident (broadcast or local) and a cold row's matches always
+        shuffle to its hash shard — each output pair materializes exactly
+        once regardless of the hot set's contents."""
+        hot = active.hot_hashes()
+        H = max(8, 1 << max(len(hot) - 1, 0).bit_length())  # static pad ladder
+        hot_h = np.zeros(H, np.uint64)
+        hot_h[:len(hot)] = hot
+        hot_v = np.zeros(H, np.bool_)
+        hot_v[:len(hot)] = True
+        skew_on_probe = active.orientation == "probe"
+        bR = int(build.live.shape[0]) // self.S
+        pR = int(probe.live.shape[0]) // self.S
+        # the broadcast side carries few rows per hot key (dimension-style),
+        # so start small and let the ladder grow; the kept-local hot rows of
+        # the SKEWED side compact into their own quota lane (they are evenly
+        # spread by scan layout, ~hot-mass x R per shard)
+        hot_quota = max(2 * H, 128)
+        loc_quota = max((pR if skew_on_probe else bR) // 2, 128)
+        # the skewed side's cold shuffle excludes the hot mass — size its
+        # quota for the remainder (the ladder covers sketch underestimates)
+        cold = 1.0 - active.hot_mass()
+        quota_b = max(2 * bR // self.S, 128)
+        quota_p = max(2 * pR // self.S, 128)
+        if skew_on_probe:
+            quota_p = max(int(quota_p * cold), 128)
+        else:
+            quota_b = max(int(quota_b * cold), 128)
+        p = active.plan
+        self.ctx.trace.append(
+            f"mpp-hybrid-join hot={len(hot)} col={p.table}.{p.column} "
+            f"skew={active.orientation}")
+        skew.note(self.ctx, node, kind="join", hot=len(hot),
+                  column=f"{p.table}.{p.column}")
+        # pair capacity: the same sizing as _shuffle_join — hybrid pairs are
+        # BALANCED across shards (that is the point), so the fair-share bound
+        # holds where the plain shuffle's hot shard overflows it
+        cap = bucket_capacity(max(2 * quota_p * self.S, 1024))
+        while True:
+            key = ("mpp_hybrid_join", node.kind, active.orientation,
+                   tuple(expr_cache_key(e) for e in build_keys),
+                   tuple(expr_cache_key(e) for e in probe_keys),
+                   expr_cache_key(node.residual)
+                   if node.residual is not None else None,
+                   tuple(build_ids), tuple(probe_ids), self.S, H,
+                   hot_quota, loc_quota, quota_b, quota_p, cap)
+
+            def builder():
+                bk, pk = self._join_key_fns(build_keys, probe_keys)
+                residual_pred = (
+                    ExprCompiler(jnp).compile_predicate(node.residual)
+                    if node.residual is not None else None)
+                kind = node.kind
+                bids, pids = list(build_ids), list(probe_ids)
+                _hq, _lq = hot_quota, loc_quota
+                _qb, _qp, _cap = quota_b, quota_p, cap
+
+                def shuffle_cold(env, live, h, quota, ids):
+                    pairs = [env[i] for i in ids]
+                    out_lanes, live_x, over = exchange.repartition_by_hash(
+                        _pack_lanes(pairs), live, h, quota)
+                    return (dict(zip(ids, _unpack_lanes(out_lanes, pairs))),
+                            live_x, over)
+
+                def compact_hot(env, hot_mask, ids, q):
+                    """Compact rows under `hot_mask` into a [q] lane env.
+                    Backend-adaptive, same stance as the join kernels:
+                    scatter-by-rank on CPU (XLA:CPU comparator sorts are
+                    ~100x slower than its scatters), argsort on TPU
+                    (scatters serialize there)."""
+                    over = jnp.sum(hot_mask.astype(jnp.int32)) > q
+                    if K.prefer_scatter():
+                        rank = jnp.cumsum(hot_mask.astype(jnp.int64)) - 1
+                        pos = jnp.where(hot_mask, rank, jnp.int64(q))
+
+                        def compact(lane):
+                            return jnp.zeros(q, lane.dtype).at[pos].set(
+                                lane, mode="drop")
+                        clive = jnp.zeros(q, jnp.bool_).at[pos].set(
+                            hot_mask, mode="drop")
+                    else:
+                        order = jnp.argsort(~hot_mask, stable=True)[:q]
+
+                        def compact(lane):
+                            return lane[order]
+                        clive = hot_mask[order]
+                    out = {}
+                    for i in ids:
+                        d, v = env[i]
+                        out[i] = (compact(d),
+                                  None if v is None else compact(v))
+                    return out, clive, over
+
+                def broadcast_hot(env, hot_mask, ids):
+                    # compact hot rows to _hq slots, then replicate
+                    cenv, clive, over = compact_hot(env, hot_mask, ids, _hq)
+                    pairs = [cenv[i] for i in ids]
+                    gl, glive = exchange.broadcast_all(_pack_lanes(pairs),
+                                                       clive)
+                    return (dict(zip(ids, _unpack_lanes(gl, pairs))),
+                            glive, over)
+
+                def union(a_env, a_live, b_env, b_live, ids):
+                    out = {}
+                    for i in ids:
+                        da, va = a_env[i]
+                        db, vb = b_env[i]
+                        d = jnp.concatenate([da, db])
+                        v = None if (va is None and vb is None) else \
+                            jnp.concatenate(
+                                [va if va is not None else
+                                 jnp.ones(da.shape[0], jnp.bool_),
+                                 vb if vb is not None else
+                                 jnp.ones(db.shape[0], jnp.bool_)])
+                        out[i] = (d, v)
+                    return out, jnp.concatenate([a_live, b_live])
+
+                def spmd(benv, blive, penv, plive, hoth, hotv):
+                    bkeys_l = [f(benv) for f in bk]
+                    pkeys_l = [f(penv) for f in pk]
+                    hot_b = K.hot_key_mask(bkeys_l, hoth, hotv) & blive
+                    hot_p = K.hot_key_mask(pkeys_l, hoth, hotv) & plive
+                    bh = K.hash_columns(bkeys_l)
+                    ph = K.hash_columns(pkeys_l)
+
+                    # cold rows of both sides hash-shuffle as today
+                    cb_env, cb_live, over_b = shuffle_cold(
+                        benv, blive & ~hot_b, bh, _qb, bids)
+                    cp_env, cp_live, over_p = shuffle_cold(
+                        penv, plive & ~hot_p, ph, _qp, pids)
+
+                    if skew_on_probe:
+                        # hot build rows broadcast; hot probe rows stay
+                        # local (compacted — their shard does not change)
+                        ghot, ghot_live, over_h = broadcast_hot(
+                            benv, hot_b, bids)
+                        lenv, llive, over_l = compact_hot(
+                            penv, hot_p, pids, _lq)
+                        ubenv, ublive = union(ghot, ghot_live,
+                                              cb_env, cb_live, bids)
+                        upenv, uplive = union(lenv, llive,
+                                              cp_env, cp_live, pids)
+                    else:
+                        # skewed build: hot probe rows broadcast, hot build
+                        # rows stay where the scan layout balanced them
+                        ghot, ghot_live, over_h = broadcast_hot(
+                            penv, hot_p, pids)
+                        lenv, llive, over_l = compact_hot(
+                            benv, hot_b, bids, _lq)
+                        ubenv, ublive = union(lenv, llive,
+                                              cb_env, cb_live, bids)
+                        upenv, uplive = union(ghot, ghot_live,
+                                              cp_env, cp_live, pids)
+
+                    (cols, live), over_cap = _join_block(
+                        ubenv, ublive, upenv, uplive, bk, pk, kind,
+                        residual_pred, _cap, bids, pids,
+                        pairs_fn=K.hash_join_probe_hybrid)
+
+                    def rep(x):
+                        return jax.lax.pmax(x.astype(jnp.int32),
+                                            "shard").astype(jnp.bool_)
+                    return (cols, live), (rep(over_h), rep(over_l),
+                                          rep(over_b), rep(over_p),
+                                          rep(over_cap))
+
+                fn = shard_map(spmd, mesh=self.mesh,
+                               in_specs=(SHARD, SHARD, SHARD, SHARD, REP, REP),
+                               out_specs=(SHARD, REP), check_vma=False)
+                return jax.jit(fn)
+
+            out, flags = global_jit(key, builder)(
+                build.env(), build.live, probe.env(), probe.live,
+                jnp.asarray(hot_h), jnp.asarray(hot_v))
+            over_h, over_l, over_b, over_p, over_cap = \
+                (bool(x) for x in flags)
+            if not (over_h or over_l or over_b or over_p or over_cap):
+                return out
+            if over_h:
+                hot_quota *= 2
+            if over_l:
+                loc_quota *= 2
+            if over_b:
+                quota_b *= 2
+            if over_p:
+                quota_p *= 2
+            if over_cap:
+                cap *= 2
+            if max(hot_quota, loc_quota, quota_b, quota_p, cap) > (1 << 24):
+                raise errors.TddlError(
+                    "MPP hybrid join exceeds capacity ceiling")
 
     def _join_result(self, node, out, build_ids, probe_ids) -> DistBatch:
         cols, live = out
@@ -834,19 +1207,11 @@ class MppExecutor:
                     pk0 = [f(env) for f in pfns]
                     h = K.hash_columns([broadcast_value(live.shape[0], *kv)
                                         for kv in pk0])
-                    lanes_in = [env[i][0] for i in cids]
-                    vlanes = [env[i][1] for i in cids]
-                    payload = lanes_in + [v for v in vlanes if v is not None]
+                    in_pairs = [env[i] for i in cids]
                     out_lanes, live_x, over = exchange.repartition_by_hash(
-                        payload, live, h, _q)
-                    new_env = {}
-                    vix = len(lanes_in)
-                    for k2, i in enumerate(cids):
-                        v = None
-                        if vlanes[k2] is not None:
-                            v = out_lanes[vix]
-                            vix += 1
-                        new_env[i] = (out_lanes[k2], v)
+                        _pack_lanes(in_pairs), live, h, _q)
+                    new_env = dict(zip(cids, _unpack_lanes(out_lanes,
+                                                           in_pairs)))
                     n = live_x.shape[0]
                     pk = [broadcast_value(n, *f(new_env)) for f in pfns]
                     ok = []
@@ -1101,3 +1466,28 @@ class MppExecutor:
 
 def build_replicated_to_dist_error(node):
     raise errors.NotSupportedError("MPP join: replicated probe side unsupported")
+
+
+def _agg_expr_fns(groups, inputs):
+    """(group fns, input fns) for an aggregation program: compiled group-key
+    and agg-input expressions, with dictionary-code inputs re-ranked for
+    collation-correct min/max.  Shared by the default partial-merge round and
+    the salted-repartition round."""
+    comp = ExprCompiler(jnp)
+    gfns = [comp.compile(e) for _, e in groups]
+    ifns = []
+    for e in inputs:
+        f = comp.compile(e)
+        d_ = _find_dictionary(e) if e.dtype.is_string else None
+        from galaxysql_tpu.types import collation as _coll
+        if d_ is not None and len(d_) and (
+                not d_.is_sorted or
+                _coll.collation_of_expr(e) is not None):
+            rank = _coll.sort_rank_array(e, d_)
+
+            def ranked(env, _f=f, _r=rank):
+                dd, vv = _f(env)
+                return jnp.asarray(_r)[dd], vv
+            f = ranked
+        ifns.append(f)
+    return gfns, ifns

@@ -160,6 +160,10 @@ class Aggregate(RelNode):
         self.children = [child]
         self.groups = list(groups)
         self.aggs = list(aggs)
+        # skew-aware salted repartition plan (exec/skew.SaltAggPlan), planted
+        # by plan/rules.plan_skew when a group key's heavy-hitter stats say a
+        # plain key-hash repartition would hot-spot one shard
+        self.salt_plan: Optional[Any] = None
 
     @property
     def child(self) -> RelNode:
@@ -197,6 +201,10 @@ class Join(RelNode):
         # runtime-filter producer edges (exec/runtime_filter.RuntimeFilterPlan):
         # equi pairs whose build side publishes a bloom/min-max filter
         self.rf_plans: List[Any] = []
+        # skew-aware hybrid-join plans (exec/skew.SkewJoinPlan), one per probe
+        # direction whose key column has heavy hitters; the executor activates
+        # only the direction matching its actual probe side (rf_plans stance)
+        self.skew_plans: List[Any] = []
 
     @property
     def left(self) -> RelNode:

@@ -70,6 +70,12 @@ class ExecContext:
         # store uids this execution's txn has written (session fills it in);
         # None with a live txn means "unknown write set" — the cache bypasses
         self.txn_write_uids = frozenset() if txn_id == 0 else None
+        # skew-aware execution (exec/skew.py): which planted plans this
+        # execution may activate (env + SKEW hint + ENABLE_SKEW_EXECUTION),
+        # and the per-node decisions EXPLAIN ANALYZE / stage spans surface
+        from galaxysql_tpu.exec import skew as _skew
+        self.skew_modes = _skew.exec_modes(self.hints, archive_instance)
+        self.skew_stats: Dict[int, dict] = {}
         # MAX_EXECUTION_TIME deadline (absolute time.time() seconds, or None):
         # checked at operator drain / fused-segment / MPP-stage boundaries and
         # propagated to workers as the remaining budget in RPC headers
@@ -777,7 +783,7 @@ def _build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
 
 
 def annotate_explain(rel: L.RelNode, op_stats: List[dict],
-                     rf=None) -> List[str]:
+                     rf=None, skew_stats=None) -> List[str]:
     """EXPLAIN ANALYZE tree rendering: the logical plan's explain lines with
     each node annotated with its measured rows/batches/wall time (matched by
     node identity).  Operators that executed inside a fused segment carry a
@@ -785,7 +791,9 @@ def annotate_explain(rel: L.RelNode, op_stats: List[dict],
 
     `rf` (the execution's RuntimeFilterManager) adds one indented
     `RuntimeFilter(column, kinds, pruned=…)` line under each scan a planned
-    runtime filter masked.
+    runtime filter masked.  `skew_stats` (ExecContext.skew_stats) adds one
+    `HotKeys(n, broadcast)` / `Salted(f)` line under each join/aggregate the
+    skew-aware executor split.
 
     Rendering rides the existing `explain_lines` (plain EXPLAIN and ANALYZE
     must draw the same tree): `explain_lines` emits one line per node in
@@ -813,10 +821,14 @@ def annotate_explain(rel: L.RelNode, op_stats: List[dict],
             line += (f"  (actual rows={st['rows_out']} "
                      f"batches={st['batches']} wall={st['wall_ms']}ms{tag})")
         lines.append(line)
+        indent = " " * (len(line) - len(line.lstrip()) + 2)
         for rst in rf_by_node.get(id(n), []):
-            indent = " " * (len(line) - len(line.lstrip()) + 2)
             lines.append(f"{indent}RuntimeFilter({rst['column']}, "
                          f"{rst['kinds']}, pruned={rst['pruned']})")
+        info = (skew_stats or {}).get(id(n))
+        if info is not None:
+            from galaxysql_tpu.exec import skew as _skew
+            lines.append(f"{indent}{_skew.explain_line(info)}")
     return lines
 
 
@@ -882,6 +894,31 @@ def _build_side_op(build_node: L.RelNode, ctx: ExecContext, fkey, cache):
     return op
 
 
+def _skew_watch(build_node: L.RelNode, build_keys, ctx: ExecContext):
+    """Heavy-hitter runtime-refresh targets for a join build side: one
+    (TableMeta, column, field id) per build key that is a bare scan column —
+    the materialized build pass folds the key lane into the column's runtime
+    sketch (meta/statistics.observe_build_keys), keeping skew detection fresh
+    between ANALYZE runs at zero extra device syncs."""
+    if not getattr(ctx, "skew_modes", None):
+        return []
+    from galaxysql_tpu.plan.rules import _rf_resolve_scan
+    out = []
+    for e in build_keys:
+        if not isinstance(e, ir.ColRef):
+            continue
+        got = _rf_resolve_scan(build_node, e.name)
+        if got is None:
+            continue
+        scan, out_id = got
+        if getattr(scan.table, "remote", None) is not None:
+            continue
+        colname = dict(scan.columns).get(out_id)
+        if colname is not None:
+            out.append((scan.table, scan.table.column(colname).name, e.name))
+    return out
+
+
 def _build_join(node: L.Join, ctx: ExecContext) -> ops.Operator:
     if node.kind == "cross":
         left = build_operator(node.left, ctx)
@@ -905,7 +942,8 @@ def _build_join(node: L.Join, ctx: ExecContext) -> ops.Operator:
                               enable_bloom=bloom,
                               spill_threshold=ctx.join_spill_bytes,
                               rf_publish=rf_specs, rf_manager=rf_mgr,
-                              frag_cache=cache, frag_key=fkey, frag_note=note)
+                              frag_cache=cache, frag_key=fkey, frag_note=note,
+                              skew_watch=_skew_watch(node.right, rkeys, ctx))
     # inner: build the smaller estimated side
     l_est = estimate_rows(node.left)
     r_est = estimate_rows(node.right)
@@ -929,4 +967,5 @@ def _build_join(node: L.Join, ctx: ExecContext) -> ops.Operator:
                           spill_threshold=ctx.join_spill_bytes,
                           probe_prelude=prelude,
                           rf_publish=rf_specs, rf_manager=rf_mgr,
-                          frag_cache=cache, frag_key=fkey, frag_note=note)
+                          frag_cache=cache, frag_key=fkey, frag_note=note,
+                          skew_watch=_skew_watch(build_node, build_keys, ctx))

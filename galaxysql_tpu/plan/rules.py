@@ -709,6 +709,110 @@ def _rf_plan_direction(node: L.Join, direction, ctr, mode: str):
 
 
 # ---------------------------------------------------------------------------
+# skew planning (heavy-hitter hybrid joins + salted aggregation)
+# ---------------------------------------------------------------------------
+
+def plan_skew(node: L.RelNode, hints=None) -> L.RelNode:
+    """Annotate joins/aggregates whose repartition key column has heavy
+    hitters (exec/skew.py policy; detection from ANALYZE's Space-Saving
+    sketches in meta/statistics.py).
+
+    Joins: for each probe direction of a single-pair equi join whose probe
+    key is a bare integer column traceable to a base-table scan
+    (`_rf_resolve_scan`, the runtime-filter lineage walk), plant a
+    `SkewJoinPlan` carrying the column's heavy-hitter candidates — the MPP
+    executor thresholds them by its actual mesh size and splits the shuffle
+    into a broadcast (hot) and a hash (cold) lane.  Aggregates: a skewed
+    group-key column plants a `SaltAggPlan`; the executor repartitions on a
+    salted key hash and adds a final merge stage.  The SKEW(OFF|JOIN|AGG)
+    hint and the GALAXYSQL_SKEW env switch gate the pass STRUCTURALLY: a
+    disabled mode plants nothing, so the hybrid path cannot engage."""
+    from galaxysql_tpu.exec import skew as sk
+    modes = sk.plan_modes(hints)
+    if not modes:
+        return node
+    for n in L.walk(node):
+        if isinstance(n, L.Join) and "join" in modes:
+            _skew_plan_join(n, sk)
+        elif isinstance(n, L.Aggregate) and "agg" in modes:
+            _skew_plan_agg(n, sk)
+    return node
+
+
+def _skew_candidates(probe_node: L.RelNode, key: ir.Expr, sk):
+    """(SkewPlan fields) for a bare-column repartition key with heavy
+    hitters, or None.  Integer lanes only: hot-key classification hashes the
+    host-side candidate values with the device hash's exact cast semantics,
+    which float lanes do not share."""
+    if not isinstance(key, ir.ColRef):
+        return None
+    got = _rf_resolve_scan(probe_node, key.name)
+    if got is None:
+        return None
+    scan, out_id = got
+    tm = scan.table
+    if getattr(tm, "remote", None) is not None:
+        return None
+    colname = dict(scan.columns).get(out_id)
+    if colname is None:
+        return None
+    cm = tm.column(colname)
+    if not np.issubdtype(np.dtype(cm.dtype.lane), np.integer):
+        return None
+    hh = tm.stats.heavy.get(cm.name)
+    if hh is None:
+        return None
+    cands = tuple((v, round(f, 6)) for v, f in
+                  hh.candidates(sk.MIN_CANDIDATE_FRAC))
+    if not cands:
+        return None
+    return cands, f"{tm.schema.lower()}.{tm.name.lower()}", cm.name, \
+        hh.total, tm
+
+
+def _skew_plan_join(node: L.Join, sk):
+    if node.kind not in ("inner", "left", "semi", "anti") or \
+            len(node.equi) != 1:
+        return
+    le, re_ = node.equi[0]
+    # probe directions mirror _rf_walk: inner joins may flip sides at
+    # execution, so plant both and let the executor pick its actual probe
+    directions = [("left", node.left, le)]
+    if node.kind == "inner":
+        directions.append(("right", node.right, re_))
+    for side, probe_node, pk in directions:
+        if pk.dtype.is_string:
+            # hybrid classification hashes host-side hot values; string codes
+            # may be dictionary-TRANSLATED before the device hash, so the
+            # host twin cannot reproduce it.  Salted aggregation (no value
+            # hashing) still covers skewed string keys.
+            continue
+        if estimate_rows(probe_node) < sk.MIN_SKEW_ROWS:
+            continue
+        got = _skew_candidates(probe_node, pk, sk)
+        if got is None:
+            continue
+        cands, table, column, total, tm = got
+        node.skew_plans.append(sk.SkewJoinPlan(
+            0, side, cands, table, column, total, tm))
+
+
+def _skew_plan_agg(node: L.Aggregate, sk):
+    # single group key only: the repartition hashes the COMBINED key, and a
+    # hot value in one column of a composite key says nothing about the
+    # composite's distribution (GROUP BY region, customer_id is uniform even
+    # when region has a dominant value) — salting there is pure overhead
+    if len(node.groups) != 1:
+        return
+    if estimate_rows(node.child) < sk.MIN_SKEW_ROWS:
+        return
+    got = _skew_candidates(node.child, node.groups[0][1], sk)
+    if got is not None:
+        cands, table, column, total, tm = got
+        node.salt_plan = sk.SaltAggPlan(cands, table, column, total, tm)
+
+
+# ---------------------------------------------------------------------------
 # partition pruning
 # ---------------------------------------------------------------------------
 
@@ -931,4 +1035,6 @@ def optimize(node: L.RelNode, spm=None, catalog=None, hints=None) -> L.RelNode:
     node = prune_partitions(node)
     # LAST: filter edges bind scan identities, which GSI routing just settled
     node = plan_runtime_filters(node, hints)
+    # skew plans bind the same scan identities (and reuse the rf lineage walk)
+    node = plan_skew(node, hints)
     return node

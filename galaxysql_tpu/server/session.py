@@ -684,6 +684,10 @@ class Session:
                                                         self.vars)
         ctx.join_spill_bytes = self.instance.config.get("JOIN_SPILL_BYTES",
                                                         self.vars)
+        # session-scoped SET ENABLE_SKEW_EXECUTION (the ctx default only sees
+        # instance scope)
+        from galaxysql_tpu.exec import skew as _skew
+        ctx.skew_modes = _skew.exec_modes(ctx.hints, self.instance, self.vars)
         # MAX_EXECUTION_TIME deadline: the hint form overrides the session
         # param for this statement (MySQL optimizer-hint semantics)
         hint_ms = getattr(plan, "hints", {}).get("max_execution_time")
@@ -903,47 +907,59 @@ class Session:
                     "slow_queries", "queries over SLOW_SQL_MS").inc()
         return ResultSet(pp["names"], pp["types"], req.rows)
 
-    def _run_query_locked(self, plan, ctx, sql, t0, prof) -> ResultSet:
-        from galaxysql_tpu.utils.tracing import SEGMENT_TRACER
-        batch = None
-        mpp_used = False
+    def _try_mpp(self, plan, ctx, count: bool):
+        """Engine dispatch shared by real execution and EXPLAIN ANALYZE
+        (which must report the engine users actually run): the MPP result
+        batch, or None for the local engine.  `count` bumps the
+        mpp_queries/mpp_fallback_local counters (real executions only —
+        EXPLAIN ANALYZE must not skew the engine ratios)."""
         engine_hint = getattr(plan, "hints", {}).get("engine")
         want_mpp = engine_hint == "MPP" or (
             engine_hint is None and plan.workload == "AP" and
             self.instance.config.get("ENABLE_MPP", self.vars) and
             plan.scanned_rows >= self.instance.config.get("MPP_MIN_AP_ROWS",
                                                           self.vars))
+        if not want_mpp:
+            return None
+        # cluster MPP mode: the plan compiles to SPMD stages over the
+        # device mesh (ExecutorHelper.executeCluster analog)
+        mesh = self.instance.mesh()
+        if mesh is None:
+            return None
+        from galaxysql_tpu.parallel.mpp import MppExecutor
+        try:
+            batch = MppExecutor(ctx, mesh).execute(plan.rel)
+            if count:
+                self.instance.counters.inc("mpp_queries")
+            return batch
+        except (errors.NotSupportedError,
+                errors.WorkerUnavailableError) as e:
+            # plan shape not yet distributed, or a worker died
+            # mid-MPP: local engine — NEVER silent (trace tag +
+            # information_schema.engine_counters).  Data permits
+            # by construction: MPP stages only read local stores
+            # (remote scans raise NotSupportedError at planning).
+            if count:
+                self.instance.counters.inc("mpp_fallback_local")
+            ctx.trace.append(f"mpp-fallback {e}")
+            # fresh runtime-filter hub: the aborted MPP walk may
+            # have consumed scan edges the local run must re-wire
+            from galaxysql_tpu.exec.runtime_filter import \
+                RuntimeFilterManager
+            ctx.rf = RuntimeFilterManager(
+                hints=ctx.hints, metrics=self.instance.metrics)
+            return None
+
+    def _run_query_locked(self, plan, ctx, sql, t0, prof) -> ResultSet:
+        from galaxysql_tpu.utils.tracing import SEGMENT_TRACER
         # segment spans correlate to THIS query's profile (not the global
         # ring) — bound only when profiling, since spans cost a device sync
         span_scope = SEGMENT_TRACER.scoped(prof.segments) \
             if ctx.collect_stats else contextlib.nullcontext()
+        engine_hint = getattr(plan, "hints", {}).get("engine")
         with span_scope:
-            if want_mpp:
-                # cluster MPP mode: the plan compiles to SPMD stages over the
-                # device mesh (ExecutorHelper.executeCluster analog)
-                mesh = self.instance.mesh()
-                if mesh is not None:
-                    from galaxysql_tpu.parallel.mpp import MppExecutor
-                    try:
-                        batch = MppExecutor(ctx, mesh).execute(plan.rel)
-                        mpp_used = True
-                        self.instance.counters.inc("mpp_queries")
-                    except (errors.NotSupportedError,
-                            errors.WorkerUnavailableError) as e:
-                        # plan shape not yet distributed, or a worker died
-                        # mid-MPP: local engine — NEVER silent (trace tag +
-                        # information_schema.engine_counters).  Data permits
-                        # by construction: MPP stages only read local stores
-                        # (remote scans raise NotSupportedError at planning).
-                        batch = None
-                        self.instance.counters.inc("mpp_fallback_local")
-                        ctx.trace.append(f"mpp-fallback {e}")
-                        # fresh runtime-filter hub: the aborted MPP walk may
-                        # have consumed scan edges the local run must re-wire
-                        from galaxysql_tpu.exec.runtime_filter import \
-                            RuntimeFilterManager
-                        ctx.rf = RuntimeFilterManager(
-                            hints=ctx.hints, metrics=self.instance.metrics)
+            batch = self._try_mpp(plan, ctx, count=True)
+            mpp_used = batch is not None
             if batch is None:
                 op = build_operator(plan.rel, ctx)
                 # TP fast path: pin execution to the host CPU backend — point
@@ -1683,8 +1699,13 @@ class Session:
             ctx = ExecContext(self.instance.stores, self._snapshot_ts(),
                               params or [], device_cache=cache,
                               archive=self.instance.archive,
-                              archive_instance=self.instance)
+                              archive_instance=self.instance,
+                              hints=getattr(plan, "hints", None))
             ctx.collect_stats = True  # per-operator rows/time (RuntimeStatistics)
+            # session-scoped SET ENABLE_SKEW_EXECUTION, same as the real path
+            from galaxysql_tpu.exec import skew as _skew
+            ctx.skew_modes = _skew.exec_modes(ctx.hints, self.instance,
+                                              self.vars)
             prof = QueryProfile(trace_id=self.instance.trace_ids.next(),
                                 sql="<explain analyze>", schema=schema,
                                 conn_id=self.conn_id, started_at=time.time())
@@ -1695,7 +1716,6 @@ class Session:
             from galaxysql_tpu.exec.operators import COMPILE_STATS
             c0 = dict(COMPILE_STATS)
             x0 = dict(TRANSFER_STATS)
-            op = build_operator(plan.rel, ctx)
             from galaxysql_tpu.plan import logical as L
             mdl_keys = {f"{n.table.schema.lower()}.{n.table.name.lower()}"
                         for n in L.walk(plan.rel) if isinstance(n, L.Scan)}
@@ -1704,7 +1724,14 @@ class Session:
             # partition lanes mid-execution (same torn-read class as SELECT)
             with self.instance.mdl.shared(mdl_keys), \
                     SEGMENT_TRACER.scoped(prof.segments):
-                batch = run_to_batch(op)
+                # same engine dispatch as _run_query_locked: ANALYZE numbers
+                # must describe the engine users actually run — an AP query
+                # above the MPP threshold reports its SPMD stages (per-shard
+                # rows, skew, HotKeys/Salted decisions), not a local stand-in
+                batch = self._try_mpp(plan, ctx, count=False)
+                if batch is None:
+                    op = build_operator(plan.rel, ctx)
+                    batch = run_to_batch(op)
             elapsed = time.time() - t0
             rows = batch.num_live()
             # the operator tree annotated in place with measured rows/time —
@@ -1712,7 +1739,9 @@ class Session:
             # the stats program variant, tagged `fused(<chain>)`)
             from galaxysql_tpu.plan.physical import annotate_explain
             lines = annotate_explain(plan.rel, ctx.op_stats,
-                                     rf=getattr(ctx, "rf", None))
+                                     rf=getattr(ctx, "rf", None),
+                                     skew_stats=getattr(ctx, "skew_stats",
+                                                        None))
             d_retr = COMPILE_STATS["retraces"] - c0["retraces"]
             d_cms = COMPILE_STATS["compile_ms"] - c0["compile_ms"]
             d_bytes = TRANSFER_STATS["bytes"] - x0["bytes"]

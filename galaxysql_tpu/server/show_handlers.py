@@ -21,6 +21,18 @@ def _like_filter(names: List[str], pattern) -> List[str]:
     return [n for n in names if fnmatch.fnmatch(n.lower(), translated.lower())]
 
 
+def _max_shard_rows(p) -> int:
+    """Largest per-shard live-row count across the profile's MPP stages —
+    slow-query triage sees shard skew straight from SHOW PROFILES, without
+    tracing enabled (0 for local-engine or unprofiled queries)."""
+    m = 0
+    for st in p.op_stats:
+        per = st.get("rows_per_shard")
+        if per:
+            m = max(m, max(per))
+    return m
+
+
 def _profile_rows(inst):
     """Last-N QueryProfiles as a result set, newest first (SHOW FULL STATS)."""
     from galaxysql_tpu.server.session import ResultSet
@@ -28,12 +40,14 @@ def _profile_rows(inst):
     for p in reversed(inst.profiles.entries()):
         rows.append((p.trace_id, p.conn_id, p.schema, p.workload, p.engine,
                      p.elapsed_ms, p.rows, len(p.op_stats), len(p.segments),
-                     1 if p.profiled else 0, p.sql))
+                     _max_shard_rows(p), 1 if p.profiled else 0, p.sql))
     return ResultSet(
         ["Trace_id", "Conn", "Schema", "Workload", "Engine", "Elapsed_ms",
-         "Rows", "Operators", "Segments", "Profiled", "SQL"],
+         "Rows", "Operators", "Segments", "Max_shard_rows", "Profiled",
+         "SQL"],
         [dt.BIGINT, dt.BIGINT, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.DOUBLE,
-         dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.VARCHAR], rows)
+         dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.VARCHAR],
+        rows)
 
 
 def handle(session, stmt: ast.Show):

@@ -25,6 +25,10 @@ behind them:
 - MAX_EXECUTION_TIME(ms)   per-statement deadline (MySQL's optimizer-hint
   spelling): overrides the MAX_EXECUTION_TIME session param for this query;
   past-deadline execution dies with a typed QueryTimeoutError.
+- SKEW(OFF|JOIN|AGG|ON)    per-statement control of skew-aware execution
+  (exec/skew.py): OFF skips the planning pass entirely — no node carries a
+  skew plan, so the hybrid/salted paths are structurally unreachable;
+  JOIN/AGG restrict planting to that feature.  `=` syntax accepted.
 - BASELINE_OFF             bypass SPM for the statement (plan as costed)
 
 Unknown directives are ignored (hints must never break a query), matching the
@@ -75,6 +79,10 @@ def parse_hints(comment: Optional[str]) -> Dict[str, object]:
             mode = arglist[0].lower()
             if mode in ("off", "on"):
                 out["batch"] = mode
+        elif name == "SKEW" and arglist:
+            mode = arglist[0].lower()
+            if mode in ("off", "join", "agg", "on"):
+                out["skew"] = mode
         elif name == "MAX_EXECUTION_TIME" and arglist:
             try:
                 ms = int(arglist[0])

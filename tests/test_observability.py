@@ -248,8 +248,10 @@ class TestSqlSurfaces:
         assert r.rows, "profiles should be retained"
         newest = r.rows[0]
         assert newest[0] == session.instance.profiles.entries()[-1].trace_id
-        assert newest[10].lower().startswith("show full stats") or \
-            "count" in newest[10]
+        sql_col = r.names.index("SQL")
+        assert newest[sql_col].lower().startswith("show full stats") or \
+            "count" in newest[sql_col]
+        assert "Max_shard_rows" in r.names  # per-shard skew triage column
         # SHOW STATS (without FULL) stays the instance-counter surface
         plain = session.execute("SHOW STATS")
         assert plain.names == ["Name", "Value"]

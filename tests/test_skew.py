@@ -1,0 +1,452 @@
+"""Skew-aware distributed execution: heavy-hitter hybrid joins + salted agg.
+
+Coverage: hybrid-on vs SKEW(OFF) bit-identical results on Q9-like joins and
+salted aggregation across a Zipf theta sweep {0, 0.8, 1.2}, both hybrid
+orientations (skewed probe / skewed build), NULL-key and empty-build edges,
+stats-drift deactivation, fragment-cache invalidation when the hot-key set
+changes, the escape-hatch trio, shard-skew observability surfaces, and a
+dispatch-count guard proving the uniform-data path is unchanged.
+"""
+
+import numpy as np
+import pytest
+
+from galaxysql_tpu.exec import operators as ops
+from galaxysql_tpu.exec import skew as sk
+from galaxysql_tpu.meta.statistics import HeavyHitterSketch
+from galaxysql_tpu.parallel import mpp as M
+from galaxysql_tpu.parallel.mesh import make_mesh
+from galaxysql_tpu.plan import logical as L
+from galaxysql_tpu.plan.physical import ExecContext
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+
+pytestmark = pytest.mark.skew
+
+N = 57344           # rows per fact table (>= exec/skew.MIN_SKEW_ROWS, and
+                    # > AP_ROW_THRESHOLD so session-level runs classify AP)
+K = 800             # key domain
+MID = 16384         # mid-size dim: big enough that the build does NOT flip
+
+
+def zipf_keys(rng, theta: float, n: int = N, k: int = K) -> np.ndarray:
+    if theta <= 0:
+        return rng.integers(0, k, size=n)
+    p = np.arange(1, k + 1, dtype=np.float64) ** -theta
+    p /= p.sum()
+    return rng.choice(k, size=n, p=p)
+
+
+@pytest.fixture(scope="module")
+def env():
+    import jax
+    assert len(jax.devices()) >= 8
+    rng = np.random.default_rng(13)
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE sk; USE sk")
+    tables = []
+    for name, theta in (("fact_t0", 0.0), ("fact_t08", 0.8),
+                        ("fact_t12", 1.2)):
+        s.execute(f"CREATE TABLE {name} (id BIGINT PRIMARY KEY, k BIGINT, "
+                  "v BIGINT) PARTITION BY HASH(id) PARTITIONS 8")
+        keys = zipf_keys(rng, theta)
+        inst.store("sk", name).insert_arrays(
+            {"id": np.arange(N, dtype=np.int64),
+             "k": keys.astype(np.int64),
+             "v": rng.integers(0, 1000, size=N).astype(np.int64)},
+            inst.tso.next_timestamp())
+        tables.append(name)
+    # "hot" fact: one dominant key (35%) — production hot-key incident shape
+    s.execute("CREATE TABLE fact_hot (id BIGINT PRIMARY KEY, k BIGINT, "
+              "v BIGINT) PARTITION BY HASH(id) PARTITIONS 8")
+    p = np.full(K, 0.65 / (K - 1))
+    p[5] = 0.35
+    inst.store("sk", "fact_hot").insert_arrays(
+        {"id": np.arange(N, dtype=np.int64),
+         "k": rng.choice(K, size=N, p=p).astype(np.int64),
+         "v": rng.integers(0, 1000, size=N).astype(np.int64)},
+        inst.tso.next_timestamp())
+    tables.append("fact_hot")
+    # dim: one row per key; partitioned by an unrelated column so storage
+    # placement does not accidentally align with the exchange hash
+    s.execute("CREATE TABLE dim (did BIGINT PRIMARY KEY, k BIGINT, "
+              "attr BIGINT) PARTITION BY HASH(did) PARTITIONS 8")
+    inst.store("sk", "dim").insert_arrays(
+        {"did": (np.arange(K, dtype=np.int64) * 7919) % (1 << 30),
+         "k": np.arange(K, dtype=np.int64),
+         "attr": np.arange(K, dtype=np.int64) % 7},
+        inst.tso.next_timestamp())
+    # mid: many rows per key, sized so the engine keeps fact as the BUILD
+    # side (no 4x flip) — exercises the skewed-build orientation
+    s.execute("CREATE TABLE mid (mid BIGINT PRIMARY KEY, k BIGINT, "
+              "w BIGINT) PARTITION BY HASH(mid) PARTITIONS 8")
+    inst.store("sk", "mid").insert_arrays(
+        {"mid": np.arange(MID, dtype=np.int64),
+         "k": (np.arange(MID, dtype=np.int64) * 31) % K,
+         "w": np.arange(MID, dtype=np.int64) % 13},
+        inst.tso.next_timestamp())
+    s.execute("ANALYZE TABLE " + ", ".join(tables + ["dim", "mid"]))
+    mesh = make_mesh(8)
+    old = M.BROADCAST_BUILD_LIMIT
+    M.BROADCAST_BUILD_LIMIT = 0  # force the shuffle shape for every join
+    yield inst, s, mesh
+    M.BROADCAST_BUILD_LIMIT = old
+    s.close()
+
+
+def run_mpp(inst, mesh, sql, collect=False):
+    plan = inst.planner.plan_select(sql, "sk")
+    ctx = ExecContext(inst.stores, inst.tso.next_timestamp(), [],
+                      archive=inst.archive, archive_instance=inst,
+                      hints=plan.hints)
+    ctx.collect_stats = collect
+    out = M.MppExecutor(ctx, mesh).execute(plan.rel)
+    return sorted(out.to_pylist()), ctx
+
+
+def on_vs_off(inst, mesh, sql):
+    rows_on, ctx_on = run_mpp(inst, mesh, sql)
+    rows_off, ctx_off = run_mpp(inst, mesh, "/*+TDDL: SKEW(OFF)*/ " + sql)
+    assert rows_on == rows_off
+    return ctx_on, ctx_off
+
+
+def hybrid_engaged(ctx):
+    return any("mpp-hybrid-join" in t for t in ctx.trace)
+
+
+def salted(ctx):
+    return any("mpp-salted-agg" in t for t in ctx.trace)
+
+
+class TestSketch:
+    def test_heavy_hitters_and_merge(self):
+        rng = np.random.default_rng(1)
+        a = np.concatenate([np.full(5000, 7), rng.integers(100, 4000, 15000)])
+        hh = HeavyHitterSketch()
+        hh.add_array(a)
+        cands = dict(hh.candidates(1 / 64))
+        assert 7 in cands and abs(cands[7] - 0.25) < 0.03
+        other = HeavyHitterSketch()
+        other.add_array(np.full(20000, 9))
+        m = hh.merge(other)
+        top = m.candidates(1 / 64)
+        assert top[0][0] == 9 and abs(top[0][1] - 0.5) < 0.05
+        rt = HeavyHitterSketch.from_json(m.to_json())
+        assert rt.total == m.total and rt.counts == m.counts
+
+    def test_mg_bound_many_distinct(self):
+        hh = HeavyHitterSketch()
+        hh.add_array(np.arange(100000))  # all unique: nothing is frequent
+        assert hh.candidates(1 / 64) == []
+        assert len(hh.counts) <= HeavyHitterSketch.K
+
+    def test_host_device_hash_twin(self):
+        import jax.numpy as jnp
+        from galaxysql_tpu.kernels import relational as KK
+        vals = np.array([0, 5, -3, 1 << 40, 123456789], dtype=np.int64)
+        host = sk.hot_hash_lane(vals.tolist())
+        dev64 = np.asarray(KK.hash_columns([(jnp.asarray(vals), None)]))
+        assert (host == dev64).all()
+        v32 = np.array([0, 5, -3, 77], dtype=np.int32)
+        dev32 = np.asarray(KK.hash_columns([(jnp.asarray(v32), None)]))
+        assert (sk.hot_hash_lane(v32.tolist()) == dev32).all()
+
+
+class TestHybridJoin:
+    @pytest.mark.parametrize("fact,want_hybrid", [
+        ("fact_t0", False), ("fact_t08", None), ("fact_t12", True),
+        ("fact_hot", True)])
+    def test_theta_sweep_bit_identical(self, env, fact, want_hybrid):
+        inst, _s, mesh = env
+        sql = (f"SELECT d.attr, COUNT(*), SUM(f.v) FROM {fact} f, dim d "
+               "WHERE f.k = d.k GROUP BY d.attr")
+        ctx_on, ctx_off = on_vs_off(inst, mesh, sql)
+        if want_hybrid is not None:  # theta=0.8 sits on the hot threshold
+            assert hybrid_engaged(ctx_on) == want_hybrid
+        assert not hybrid_engaged(ctx_off)
+
+    def test_build_orientation(self, env):
+        inst, _s, mesh = env
+        # mid is big enough that the engine keeps the skewed fact as BUILD
+        sql = ("SELECT COUNT(*), SUM(m.w) FROM mid m, fact_hot f "
+               "WHERE m.k = f.k")
+        ctx_on, _ = on_vs_off(inst, mesh, sql)
+        assert any("skew=build" in t for t in ctx_on.trace), ctx_on.trace
+
+    def test_left_and_semi(self, env):
+        inst, _s, mesh = env
+        # left join keeps unmatched probe rows (restrict dim: half the keys)
+        left = ("SELECT COUNT(*), SUM(f.v), COUNT(d.attr) FROM fact_hot f "
+                "LEFT JOIN dim d ON f.k = d.k AND d.k < 400")
+        ctx_on, _ = on_vs_off(inst, mesh, left)
+        assert hybrid_engaged(ctx_on)
+        semi = ("SELECT COUNT(*), SUM(v) FROM fact_hot WHERE k IN "
+                "(SELECT k FROM dim WHERE attr < 3)")
+        ctx_on, _ = on_vs_off(inst, mesh, semi)
+
+    def test_null_keys_and_empty_build(self, env):
+        inst, s, mesh = env
+        s.execute("CREATE TABLE fnull (id BIGINT PRIMARY KEY, k BIGINT, "
+                  "v BIGINT) PARTITION BY HASH(id) PARTITIONS 8")
+        rng = np.random.default_rng(3)
+        keys = zipf_keys(rng, 1.2).astype(object)
+        keys[::17] = None  # ~6% NULL join keys
+        inst.store("sk", "fnull").insert_pylists(
+            {"id": list(range(N)), "k": list(keys),
+             "v": [int(x) for x in rng.integers(0, 100, N)]},
+            inst.tso.next_timestamp())
+        s.execute("ANALYZE TABLE fnull")
+        on_vs_off(inst, mesh,
+                  "SELECT COUNT(*), SUM(f.v) FROM fnull f, dim d "
+                  "WHERE f.k = d.k")
+        on_vs_off(inst, mesh,
+                  "SELECT COUNT(*), SUM(f.v), COUNT(d.attr) FROM fnull f "
+                  "LEFT JOIN dim d ON f.k = d.k")
+        # empty build side: no dim rows survive the filter
+        on_vs_off(inst, mesh,
+                  "SELECT COUNT(*), SUM(f.v) FROM fnull f, dim d "
+                  "WHERE f.k = d.k AND d.k < 0")
+
+    def test_steady_state_retraces_zero(self, env):
+        inst, _s, mesh = env
+        sql = ("SELECT COUNT(*), SUM(f.v) FROM fact_hot f, dim d "
+               "WHERE f.k = d.k")
+        run_mpp(inst, mesh, sql)
+        inst.frag_cache.clear()
+        ops.reset_compile_stats()
+        ctx, _ = run_mpp(inst, mesh, sql)[1], None
+        assert ops.COMPILE_STATS["retraces"] == 0
+
+    def test_dispatch_guard_uniform_path_unchanged(self, env):
+        inst, _s, mesh = env
+        sql = ("SELECT d.attr, COUNT(*) FROM fact_t0 f, dim d "
+               "WHERE f.k = d.k GROUP BY d.attr")
+        run_mpp(inst, mesh, sql)  # warm compiles
+        run_mpp(inst, mesh, "/*+TDDL: SKEW(OFF)*/ " + sql)
+
+        def dispatches(q):
+            inst.frag_cache.clear()
+            ops.reset_dispatch_stats()
+            run_mpp(inst, mesh, q)
+            return ops.DISPATCH_STATS["dispatches"]
+        assert dispatches(sql) == dispatches("/*+TDDL: SKEW(OFF)*/ " + sql)
+
+
+class TestSaltedAgg:
+    @pytest.mark.parametrize("fact,want_salt", [
+        ("fact_t0", False), ("fact_t08", False), ("fact_t12", True),
+        ("fact_hot", True)])
+    def test_theta_sweep_bit_identical(self, env, fact, want_salt):
+        inst, _s, mesh = env
+        sql = (f"SELECT k, COUNT(*), SUM(v), MIN(v), MAX(v) FROM {fact} "
+               "GROUP BY k")
+        ctx_on, ctx_off = on_vs_off(inst, mesh, sql)
+        assert salted(ctx_on) == want_salt
+        assert not salted(ctx_off)
+
+    def test_salted_with_filter_prelude(self, env):
+        inst, _s, mesh = env
+        on_vs_off(inst, mesh,
+                  "SELECT k, COUNT(*), SUM(v) FROM fact_hot "
+                  "WHERE v < 500 GROUP BY k")
+
+
+class TestDeactivation:
+    def test_stats_drift_deactivates(self, env):
+        inst, s, mesh = env
+        s.execute("CREATE TABLE fdrift (id BIGINT PRIMARY KEY, k BIGINT, "
+                  "v BIGINT) PARTITION BY HASH(id) PARTITIONS 8")
+        rng = np.random.default_rng(5)
+        p = np.full(K, 0.6 / (K - 1))
+        p[0] = 0.4
+        inst.store("sk", "fdrift").insert_arrays(
+            {"id": np.arange(N, dtype=np.int64),
+             "k": rng.choice(K, size=N, p=p).astype(np.int64),
+             "v": np.ones(N, dtype=np.int64)},
+            inst.tso.next_timestamp())
+        s.execute("ANALYZE TABLE fdrift")
+        sql = ("SELECT COUNT(*), SUM(f.v) FROM fdrift f, dim d "
+               "WHERE f.k = d.k")
+        ctx, _ = run_mpp(inst, mesh, sql)[1], None
+        assert hybrid_engaged(ctx)
+        # bulk load doubles the table WITHOUT re-ANALYZE: the runtime
+        # re-check must deactivate the stale plan, not execute it
+        inst.store("sk", "fdrift").insert_arrays(
+            {"id": np.arange(N, 3 * N, dtype=np.int64),
+             "k": rng.integers(0, K, size=2 * N).astype(np.int64),
+             "v": np.ones(2 * N, dtype=np.int64)},
+            inst.tso.next_timestamp())
+        inst.catalog.table("sk", "fdrift").bump_version()
+        ctx2, _ = run_mpp(inst, mesh, sql)[1], None
+        assert not hybrid_engaged(ctx2)
+        assert any("skew-deactivated" in t for t in ctx2.trace)
+
+    def test_runtime_refresh_from_build_side(self, env):
+        inst, s, _mesh = env
+        tm = inst.catalog.table("sk", "mid")
+        tm.stats.heavy_rt.pop("k", None)
+        # local-engine join: mid (>= 4096 live rows) is the build side, so
+        # its key lane refreshes the runtime sketch as it materializes
+        s.execute("SELECT COUNT(*) FROM fact_t0 f, mid m WHERE f.k = m.k")
+        hh = tm.stats.heavy_rt.get("k")
+        assert hh is not None and hh.total >= 4096
+
+
+class TestFragmentCacheInvalidation:
+    def test_hot_key_set_change_rekeys_fingerprint(self, env):
+        inst, _s, mesh = env
+        from galaxysql_tpu.exec import fragment_cache as fc
+        plan = inst.planner.plan_select(
+            "SELECT k, COUNT(*) FROM fact_hot GROUP BY k", "sk")
+        agg = next(n for n in L.walk(plan.rel) if isinstance(n, L.Aggregate))
+        ctx = ExecContext(inst.stores, inst.tso.next_timestamp(), [],
+                          archive=inst.archive, archive_instance=inst)
+        key1 = fc.fingerprint(agg, ctx).key
+        # the hot-key candidate set changed (a re-ANALYZE after data shifted)
+        tm = inst.catalog.table("sk", "fact_hot")
+        old = tm.stats.heavy["k"]
+        try:
+            tm.stats.heavy["k"] = HeavyHitterSketch({11: 30000}, old.total)
+            inst.planner.cache.invalidate_all()
+            plan2 = inst.planner.plan_select(
+                "SELECT k, COUNT(*) FROM fact_hot GROUP BY k", "sk")
+            agg2 = next(n for n in L.walk(plan2.rel)
+                        if isinstance(n, L.Aggregate))
+            ctx2 = ExecContext(inst.stores, inst.tso.next_timestamp(), [],
+                               archive=inst.archive, archive_instance=inst)
+            key2 = fc.fingerprint(agg2, ctx2).key
+            assert key1 != key2
+            # disabled skew execution separates the cached shapes too
+            ctx3 = ExecContext(inst.stores, inst.tso.next_timestamp(), [],
+                               archive=inst.archive, archive_instance=inst,
+                               hints={"skew": "off"})
+            # same plan (hints only gate execution here): signature goes inert
+            key3 = fc.fingerprint(agg2, ctx3).key
+            assert key3 != key2
+        finally:
+            tm.stats.heavy["k"] = old
+
+
+class TestHatches:
+    def test_hint_structurally_unplants(self, env):
+        inst, _s, _mesh = env
+        sql = "SELECT COUNT(*) FROM fact_hot f, dim d WHERE f.k = d.k"
+        plan = inst.planner.plan_select("/*+TDDL: SKEW(OFF)*/ " + sql, "sk")
+        assert all(not getattr(n, "skew_plans", None)
+                   for n in L.walk(plan.rel))
+        plan2 = inst.planner.plan_select(sql, "sk")
+        assert any(getattr(n, "skew_plans", None)
+                   for n in L.walk(plan2.rel))
+
+    def test_hint_join_agg_split(self, env):
+        inst, _s, mesh = env
+        sql = ("SELECT f.k, COUNT(*) FROM fact_hot f, dim d "
+               "WHERE f.k = d.k GROUP BY f.k")
+        _, ctx_j = run_mpp(inst, mesh, "/*+TDDL: SKEW(JOIN)*/ " + sql)
+        assert hybrid_engaged(ctx_j) and not salted(ctx_j)
+        _, ctx_a = run_mpp(inst, mesh, "/*+TDDL: SKEW(AGG)*/ " + sql)
+        assert not hybrid_engaged(ctx_a) and salted(ctx_a)
+
+    def test_param_gates_execution(self, env):
+        inst, _s, mesh = env
+        sql = "SELECT COUNT(*) FROM fact_hot f, dim d WHERE f.k = d.k"
+        inst.config.set_instance("ENABLE_SKEW_EXECUTION", False)
+        try:
+            _, ctx = run_mpp(inst, mesh, sql)
+            assert not hybrid_engaged(ctx)
+        finally:
+            inst.config.set_instance("ENABLE_SKEW_EXECUTION", True)
+        _, ctx2 = run_mpp(inst, mesh, sql)
+        assert hybrid_engaged(ctx2)
+
+    def test_session_set_gates_execution(self, env):
+        inst, _s, _mesh = env
+        s2 = Session(inst)
+        s2.execute("USE sk")
+        inst.config.set_instance("MPP_MIN_AP_ROWS", 1)
+        sql = "SELECT COUNT(*) FROM fact_hot f, dim d WHERE f.k = d.k"
+        try:
+            s2.execute("SET ENABLE_SKEW_EXECUTION = 0")
+            inst.frag_cache.clear()  # a warm mpp agg would skip the join
+            s2.execute(sql)
+            trace = "\n".join(t[0] for t in s2.execute("SHOW TRACE").rows)
+            assert "mpp-hybrid-join" not in trace, trace
+            s2.execute("SET ENABLE_SKEW_EXECUTION = 1")
+            inst.frag_cache.clear()
+            s2.execute(sql)
+            trace = "\n".join(t[0] for t in s2.execute("SHOW TRACE").rows)
+            assert "mpp-hybrid-join" in trace, trace
+        finally:
+            inst.config.set_instance("MPP_MIN_AP_ROWS", 1 << 22)
+            s2.close()
+
+    def test_env_kill_switch(self, env, monkeypatch):
+        inst, _s, _mesh = env
+        monkeypatch.setattr(sk, "ENABLED", False)
+        inst.planner.cache.invalidate_all()
+        sql = "SELECT COUNT(*) FROM fact_hot f, dim d WHERE f.k = d.k"
+        try:
+            plan = inst.planner.plan_select(sql, "sk")
+            assert all(not getattr(n, "skew_plans", None)
+                       for n in L.walk(plan.rel))
+        finally:
+            # drop the unplanted plan so later tests re-plan with skew on
+            inst.planner.cache.invalidate_all()
+
+
+class TestObservability:
+    def test_shard_skew_stats_and_gauge(self, env):
+        inst, _s, mesh = env
+        _, ctx = run_mpp(
+            inst, mesh,
+            "SELECT COUNT(*) FROM fact_hot f, dim d WHERE f.k = d.k",
+            collect=True)
+        skews = [st.get("shard_skew") for st in ctx.op_stats
+                 if st.get("shard_skew")]
+        assert skews, ctx.op_stats
+        assert all(x >= 1.0 for x in skews)
+        vals = {n: v for n, k_, v, _h in inst.metrics.rows()}
+        assert vals.get("mpp_shard_skew", 0) >= 1.0
+        info = ctx.skew_stats
+        assert any(i.get("kind") == "join" for i in info.values())
+
+    def test_explain_analyze_annotations(self, env):
+        inst, _s, _mesh = env
+        s2 = Session(inst)
+        s2.execute("USE sk")
+        s2.execute("SET ENABLE_MPP = 1")
+        inst.config.set_instance("MPP_MIN_AP_ROWS", 1)
+        old = M.BROADCAST_BUILD_LIMIT
+        M.BROADCAST_BUILD_LIMIT = 0
+        try:
+            r = s2.execute(
+                "EXPLAIN ANALYZE SELECT f.k, COUNT(*), SUM(f.v) "
+                "FROM fact_hot f, dim d WHERE f.k = d.k GROUP BY f.k")
+            text = "\n".join(row[0] for row in r.rows)
+            assert "HotKeys(" in text, text
+            assert "Salted(" in text, text
+        finally:
+            M.BROADCAST_BUILD_LIMIT = old
+            inst.config.set_instance("MPP_MIN_AP_ROWS", 1 << 22)
+            s2.close()
+
+    def test_show_profiles_max_shard_rows(self, env):
+        inst, _s, _mesh = env
+        s2 = Session(inst)
+        s2.execute("USE sk")
+        s2.execute("SET ENABLE_QUERY_PROFILING = 1")
+        inst.config.set_instance("MPP_MIN_AP_ROWS", 1)
+        old = M.BROADCAST_BUILD_LIMIT
+        M.BROADCAST_BUILD_LIMIT = 0
+        try:
+            s2.execute("SELECT COUNT(*) FROM fact_hot f, dim d "
+                       "WHERE f.k = d.k")
+            r = s2.execute("SHOW PROFILES")
+            ix = r.names.index("Max_shard_rows")
+            assert any(row[ix] > 0 for row in r.rows)
+        finally:
+            M.BROADCAST_BUILD_LIMIT = old
+            inst.config.set_instance("MPP_MIN_AP_ROWS", 1 << 22)
+            s2.close()
