@@ -73,5 +73,14 @@ skew-smoke:
 bench-skew:
 	JAX_PLATFORMS=cpu $(PY) bench.py --skew-only
 
+# workload-insight smoke: statement-digest aggregation (exec/error counts,
+# windows, digest stability across literals), the event journal, slow-log
+# digest linkage, SHOW/information_schema/web/Prometheus surfaces, the
+# plan-regression sentinel end-to-end, summary-on-vs-off bit-identical
+# results, race-free concurrent aggregation, and the zero-extra-dispatch /
+# zero-device-sync hot-path guard
+summary-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m summary -p no:cacheprovider
+
 .PHONY: tier1 fusion-smoke obs-smoke rf-smoke cache-smoke trace-smoke bench \
-	batch-smoke chaos-smoke skew-smoke bench-skew
+	batch-smoke chaos-smoke skew-smoke bench-skew summary-smoke

@@ -924,6 +924,16 @@ def main():
         "profile": _profile_summary(s, QUERIES[1]),
     })
 
+    # statement-summary snapshot: per-digest aggregates of everything this
+    # bench run executed, so future runs can diff per-digest latency across
+    # PRs (meta/statement_summary.py)
+    ss = getattr(inst, "stmt_summary", None)
+    if ss is not None:
+        results.append({"metric": "statement_summary_snapshot",
+                        "unit": "digests", "platform": platform,
+                        "value": len(ss.rows()),
+                        "statements": ss.top_digests(10)})
+
     try:
         results.insert(0, kernel_microbench(data, platform, runs))
     except Exception:

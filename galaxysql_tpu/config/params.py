@@ -112,6 +112,35 @@ BREAKER_COOLDOWN_MS = _p(
     "open-state hold before the breaker half-opens (one ping probe decides "
     "closed vs re-open); while open, requests fast-fail typed")
 
+# --- workload insight (meta/statement_summary.py) ------------------------------
+ENABLE_STATEMENT_SUMMARY = _p(
+    "ENABLE_STATEMENT_SUMMARY", True,
+    "aggregate every finished query per statement digest x plan fingerprint "
+    "into time-bucketed windows (SHOW STATEMENT SUMMARY [HISTORY]); "
+    "host-side adds only — zero device syncs")
+STMT_SUMMARY_WINDOW_S = _p(
+    "STMT_SUMMARY_WINDOW_S", 60,
+    "statement-summary time-bucket width in seconds")
+STMT_SUMMARY_HISTORY = _p(
+    "STMT_SUMMARY_HISTORY", 16,
+    "window buckets retained per digest x plan (bounded history)")
+STMT_SUMMARY_MAX_DIGESTS = _p(
+    "STMT_SUMMARY_MAX_DIGESTS", 512,
+    "distinct statement digests retained (least-recently-updated evicted)")
+STMT_SUMMARY_PROM_TOPK = _p(
+    "STMT_SUMMARY_PROM_TOPK", 5,
+    "digests exported to Prometheus with a `digest` label (top-K by total "
+    "time — bounded label cardinality)")
+PLAN_REGRESSION_FACTOR = _p(
+    "PLAN_REGRESSION_FACTOR", 1.5,
+    "sentinel threshold: a digest's windowed MEDIAN latency above factor x "
+    "its frozen baseline median flags a plan regression (medians, so one "
+    "compile-heavy outlier can neither fake nor hide a regression)")
+PLAN_REGRESSION_MIN_EXECS = _p(
+    "PLAN_REGRESSION_MIN_EXECS", 5,
+    "successful executions needed to freeze a digest's latency baseline "
+    "(median of them), and per window before the sentinel will judge it")
+
 # --- misc ---------------------------------------------------------------------
 SQL_SELECT_LIMIT = _p("SQL_SELECT_LIMIT", -1, "-1 = unlimited")
 SLOW_SQL_MS = _p("SLOW_SQL_MS", 1000, "slow query log threshold")

@@ -404,6 +404,10 @@ class DdlEngine:
                 db.execute("INSERT INTO ddl_engine_task VALUES (?,?,?,?,?)",
                            (job.job_id, tid, type(t).__name__, "PENDING",
                             json.dumps(t.payload)))
+        from galaxysql_tpu.utils import events
+        events.publish("ddl", f"{job.schema}: {job.sql}"[:256],
+                       node=self.instance.node_id, schema=job.schema,
+                       job_id=job.job_id)
         self._execute(job)
 
     def _execute(self, job: DdlJob, start_from: int = 0):

@@ -241,9 +241,25 @@ def active_join_skew(node, ctx, probe_side: str, S: int) \
         if not recheck(p, ctx):
             ctx.trace.append(
                 f"skew-deactivated join {p.table}.{p.column} (stats drift)")
+            from galaxysql_tpu.utils import events
+            events.publish("skew_deactivate",
+                           f"hybrid join {p.table}.{p.column}: stats drift",
+                           dedupe=f"skew-off:join:{p.table}.{p.column}",
+                           table=p.table, column=p.column, op="join")
             continue
         values = _hot_values(p.candidates, S)
         if values:
+            from galaxysql_tpu.utils import events
+            # per-execution publisher: the counter counts every activation,
+            # the ring keeps one event per join site (dedupe) so a steady
+            # skewed workload cannot evict rare fault/regression events
+            events.publish("skew_activate",
+                           f"hybrid join {p.table}.{p.column}: "
+                           f"{len(values)} hot keys ({orientation})",
+                           dedupe=f"skew:join:{p.table}.{p.column}:"
+                                  f"{orientation}",
+                           table=p.table, column=p.column, op="join",
+                           orientation=orientation, hot_keys=len(values))
             return ActiveJoinSkew(p, values, orientation)
     return None
 
@@ -260,6 +276,11 @@ def active_salt(node, ctx, S: int) -> Optional[int]:
     if not recheck(p, ctx):
         ctx.trace.append(
             f"skew-deactivated agg {p.table}.{p.column} (stats drift)")
+        from galaxysql_tpu.utils import events
+        events.publish("skew_deactivate",
+                       f"salted agg {p.table}.{p.column}: stats drift",
+                       dedupe=f"skew-off:agg:{p.table}.{p.column}",
+                       table=p.table, column=p.column, op="agg")
         return None
     values = _hot_values(p.candidates, S, AGG_HOT_RATIO)
     if not values:
@@ -268,7 +289,13 @@ def active_salt(node, ctx, S: int) -> Optional[int]:
     factor = 1
     while factor < fmax * S and factor < SALT_MAX_FACTOR:
         factor *= 2
-    return max(factor, SALT_MIN_FACTOR)
+    factor = max(factor, SALT_MIN_FACTOR)
+    from galaxysql_tpu.utils import events
+    events.publish("skew_activate",
+                   f"salted agg {p.table}.{p.column}: factor {factor}",
+                   dedupe=f"skew:agg:{p.table}.{p.column}:{factor}",
+                   table=p.table, column=p.column, op="agg", factor=factor)
+    return factor
 
 
 # -- fragment-cache fingerprints ----------------------------------------------

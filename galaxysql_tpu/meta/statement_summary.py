@@ -1,0 +1,443 @@
+"""Statement-digest summary store: workload insight across queries and time.
+
+Reference analog: the CN's `statement_summary` / Top-SQL layer (SURVEY.md §L2
+manager surfaces) — every finished query is normalized to a **statement
+digest** and aggregated per digest x plan fingerprint into time-bucketed
+sliding windows, so "which statements run, under which plans, and how has
+each been behaving lately" is answerable without tracing anything.
+
+Digesting is ~free on the hot path: the digest KEY is the parameterized SQL
+text `sql/parameterize.parameterize` already memoizes for the plan cache, so
+the summary layer pays one dict probe plus host-side integer adds under one
+lock.  The printable digest (a short hash of schema+text) is minted once per
+entry, never per execution.  Nothing here may touch device state.
+
+Two consumers ride the store:
+
+- the **plan-regression sentinel**: when a known digest starts executing
+  under a new plan fingerprint (or the same plan drifts) and its windowed
+  latency degrades beyond `PLAN_REGRESSION_FACTOR` x the digest's frozen
+  baseline, it publishes a typed `plan_regression` event
+  (utils/events.py), bumps the `plan_regressions` counter, and annotates
+  the SPM `PlanRecord` (plan/spm.py) so baselines can be audited;
+- the Prometheus top-K exporter (server/web.py): per-digest latency
+  summaries with a bounded-cardinality `digest` label.
+
+Escape hatches: `ENABLE_STATEMENT_SUMMARY` param (SET-able) and the
+`GALAXYSQL_STMT_SUMMARY=0` environment kill switch."""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+from zlib import crc32
+
+from galaxysql_tpu.utils.metrics import Histogram
+
+# kill switch: GALAXYSQL_STMT_SUMMARY=0 disables recording entirely (surfaces
+# stay queryable, just empty) — read once at import like the other hatches
+ENABLED = os.environ.get("GALAXYSQL_STMT_SUMMARY", "1") != "0"
+
+
+# -- digests -------------------------------------------------------------------
+
+_DIGEST_CACHE: Dict[Tuple[str, str], str] = {}
+_DIGEST_CACHE_CAP = 8192
+
+
+def digest_key(schema: str, ptext: str) -> str:
+    """Printable 16-hex digest of (schema, parameterized SQL).  Memoized by
+    the same epoch-reset discipline as the parameterize cache: OLTP traffic
+    repeats statements, so the hash runs once per distinct text."""
+    k = (schema, ptext)
+    hit = _DIGEST_CACHE.get(k)
+    if hit is not None:
+        return hit
+    d = hashlib.blake2b(f"{schema}\x00{ptext}".encode(),
+                        digest_size=8).hexdigest()
+    if len(_DIGEST_CACHE) >= _DIGEST_CACHE_CAP:
+        _DIGEST_CACHE.clear()
+    _DIGEST_CACHE[k] = d
+    return d
+
+
+def plan_fingerprint(plan) -> str:
+    """Stable fingerprint of the one high-blast-radius physical identity this
+    engine has — the join order (the SPM plan identity; every other physical
+    choice is deterministic given the join tree).  Joinless plans share the
+    'scan' fingerprint; the point fast path records as 'point'."""
+    orders = getattr(plan, "join_orders", None)
+    if not orders:
+        return "scan"
+    return f"j{crc32(repr(sorted(orders)).encode()) & 0xFFFFFFFF:08x}"
+
+
+# -- per-query counter attribution --------------------------------------------
+#
+# The engine's compile/cache/filter/retry truth lives in process counters
+# (COMPILE_STATS, RF_STATS, frag cache hits, RPC_RETRIES, skew events).
+# Bracketing a query with two host-side snapshot reads attributes their
+# deltas to the digest.  Under concurrency the deltas are approximate
+# (concurrent queries' work can cross-attribute) — fine for aggregate
+# insight, and the price is six dict/attr reads, no locks, no syncs.
+
+def counters_snapshot(instance) -> tuple:
+    from galaxysql_tpu.exec.operators import COMPILE_STATS
+    from galaxysql_tpu.exec.runtime_filter import RF_STATS
+    from galaxysql_tpu.utils.events import EVENTS
+    from galaxysql_tpu.utils.metrics import RPC_RETRIES
+    fc = getattr(instance, "frag_cache", None)
+    return (COMPILE_STATS["retraces"],
+            fc.hits if fc is not None else 0,
+            RF_STATS["rows_pruned"],
+            EVENTS._counts.get("skew_activate", 0),  # GIL-atomic dict read
+            RPC_RETRIES.value)
+
+
+def counters_delta(base: Optional[tuple], instance) -> Optional[dict]:
+    if base is None:
+        return None
+    now = counters_snapshot(instance)
+    return {"retraces": now[0] - base[0], "frag_hits": now[1] - base[1],
+            "rf_rows_pruned": now[2] - base[2],
+            "skew_activations": now[3] - base[3],
+            "rpc_retries": now[4] - base[4]}
+
+
+# -- aggregation structures ----------------------------------------------------
+
+_EXTRA_KEYS = ("retraces", "frag_hits", "rf_rows_pruned", "skew_activations",
+               "rpc_retries")
+
+
+class _Bucket:
+    """One time window of one digest x plan (host-side adds only)."""
+
+    __slots__ = ("start", "execs", "errors", "sum_ms", "min_ms", "max_ms",
+                 "rows_returned", "rows_examined", "peak_rss_kb", "extras",
+                 "lat")
+
+    def __init__(self, start: float):
+        self.start = start
+        self.execs = 0
+        self.errors = 0
+        self.sum_ms = 0.0
+        self.min_ms = float("inf")
+        self.max_ms = 0.0
+        self.rows_returned = 0
+        self.rows_examined = 0
+        self.peak_rss_kb = 0
+        self.extras = dict.fromkeys(_EXTRA_KEYS, 0)
+        # bounded latency reservoir: the sentinel judges the window's MEDIAN
+        # — a mean would let one compile-heavy retrace fake a regression (or
+        # one cached replay hide a real one)
+        self.lat = Histogram("w", reservoir=64)
+
+    @property
+    def avg_ms(self) -> float:
+        return self.sum_ms / self.execs if self.execs else 0.0
+
+
+class _PlanAgg:
+    """Lifetime + windowed stats of one digest x plan fingerprint."""
+
+    __slots__ = ("fp", "orders", "engines", "workloads", "first_seen",
+                 "last_seen", "execs", "errors", "total_ms", "latency",
+                 "buckets", "flagged", "rows_returned", "rows_examined",
+                 "peak_rss_kb", "extras")
+
+    def __init__(self, fp: str, orders: str, history: int):
+        self.fp = fp
+        self.orders = orders          # json-ish join-order text ("" joinless)
+        self.engines: set = set()
+        self.workloads: set = set()   # TP | AP seen under this plan
+        self.first_seen = 0.0
+        self.last_seen = 0.0
+        self.execs = 0
+        self.errors = 0
+        self.total_ms = 0.0
+        self.latency = Histogram(f"stmt_{fp}", reservoir=256)
+        self.buckets: collections.deque = collections.deque(maxlen=history)
+        self.flagged = False          # sentinel: currently regressed
+        # lifetime totals (the summary row): buckets roll off the bounded
+        # history deque, so summing them would silently undercount
+        self.rows_returned = 0
+        self.rows_examined = 0
+        self.peak_rss_kb = 0
+        self.extras = dict.fromkeys(_EXTRA_KEYS, 0)
+
+    def bucket(self, now: float, window_s: float) -> _Bucket:
+        start = now - (now % window_s)
+        if not self.buckets or self.buckets[-1].start != start:
+            self.buckets.append(_Bucket(start))
+        return self.buckets[-1]
+
+
+class _Entry:
+    """One statement digest: plans seen + the sentinel's frozen baseline."""
+
+    __slots__ = ("schema", "ptext", "digest", "sample_sql", "first_seen",
+                 "last_seen", "plans", "baseline_fp", "baseline_ms",
+                 "baseline_samples")
+
+    def __init__(self, schema: str, ptext: str, sample_sql: str):
+        self.schema = schema
+        self.ptext = ptext
+        self.digest = digest_key(schema, ptext)
+        self.sample_sql = sample_sql[:512]
+        self.first_seen = 0.0
+        self.last_seen = 0.0
+        self.plans: Dict[str, _PlanAgg] = {}
+        # baseline: MEDIAN of the FIRST plan's first `min_execs` successful
+        # runs, frozen once established — the yardstick the sentinel judges
+        # later windows (any plan) against.  Median, not mean: the first
+        # execution usually pays the compile.
+        self.baseline_fp: Optional[str] = None
+        self.baseline_ms: Optional[float] = None
+        self.baseline_samples: List[float] = []
+
+
+class StatementSummaryStore:
+    """Per-Instance digest x plan x window aggregator + regression sentinel.
+
+    One plain lock guards everything: updates are a handful of float adds
+    (the concurrency suite proves multi-session totals exact), and readers
+    materialize row snapshots under the same lock."""
+
+    def __init__(self, instance):
+        self.instance = instance
+        self._lock = threading.Lock()
+        # (schema, ptext) -> _Entry, LRU by last update for digest eviction
+        self._entries: "collections.OrderedDict[Tuple[str, str], _Entry]" = \
+            collections.OrderedDict()
+        self._regressions = instance.metrics.counter(
+            "plan_regressions",
+            "digests whose windowed latency regressed vs their plan baseline")
+        self.recorded = instance.metrics.counter(
+            "stmt_summary_recorded", "queries aggregated into the summary")
+
+    # -- config (read per call: SET-able hatches must apply live) ----------
+
+    def on(self, session_vars: Optional[dict] = None) -> bool:
+        return ENABLED and bool(self.instance.config.get(
+            "ENABLE_STATEMENT_SUMMARY", session_vars))
+
+    def _cfg(self, name: str, default):
+        v = self.instance.config.get(name)
+        return default if v is None else v
+
+    # -- recording ----------------------------------------------------------
+
+    def record(self, schema: str, ptext: str, raw_sql: str, plan_fp: str,
+               orders: str, workload: str, engine: str, elapsed_ms: float,
+               rows: int, rows_examined: int = 0, error: bool = False,
+               peak_rss_kb: int = 0, extras: Optional[dict] = None,
+               now: Optional[float] = None):
+        """Aggregate one finished query (success or failure).  Host-side
+        adds under the store lock; the sentinel check rides the same hold."""
+        now = time.time() if now is None else now
+        window_s = float(self._cfg("STMT_SUMMARY_WINDOW_S", 60))
+        history = int(self._cfg("STMT_SUMMARY_HISTORY", 16))
+        max_digests = int(self._cfg("STMT_SUMMARY_MAX_DIGESTS", 512))
+        key = (schema.lower(), ptext)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                e = _Entry(schema.lower(), ptext, raw_sql or ptext)
+                e.first_seen = now
+                self._entries[key] = e
+                while len(self._entries) > max_digests:
+                    self._entries.popitem(last=False)  # LRU digest eviction
+            else:
+                self._entries.move_to_end(key)
+            e.last_seen = now
+            agg = e.plans.get(plan_fp)
+            if agg is None:
+                agg = _PlanAgg(plan_fp, orders, history)
+                agg.first_seen = now
+                e.plans[plan_fp] = agg
+                if len(e.plans) > 16:
+                    # plan-churn bound: a digest replanned under many
+                    # fingerprints keeps only the 16 most recently seen
+                    # (the baseline yardstick lives on the entry, not here)
+                    stale = min((a for a in e.plans.values()
+                                 if a is not agg), key=lambda a: a.last_seen)
+                    del e.plans[stale.fp]
+            agg.last_seen = now
+            agg.engines.add(engine)
+            if workload:
+                agg.workloads.add(workload)
+            agg.execs += 1
+            b = agg.bucket(now, window_s)
+            b.execs += 1
+            if error:
+                agg.errors += 1
+                b.errors += 1
+            else:
+                agg.total_ms += elapsed_ms
+                agg.latency.observe(elapsed_ms)
+                b.sum_ms += elapsed_ms
+                b.min_ms = min(b.min_ms, elapsed_ms)
+                b.max_ms = max(b.max_ms, elapsed_ms)
+                b.lat.observe(elapsed_ms)
+            b.rows_returned += rows
+            agg.rows_returned += rows
+            b.rows_examined += rows_examined
+            agg.rows_examined += rows_examined
+            if peak_rss_kb:
+                b.peak_rss_kb = max(b.peak_rss_kb, peak_rss_kb)
+                agg.peak_rss_kb = max(agg.peak_rss_kb, peak_rss_kb)
+            if extras:
+                bx, ax = b.extras, agg.extras
+                for k in _EXTRA_KEYS:
+                    v = extras.get(k, 0)
+                    if v > 0:  # concurrent-delta noise must not go negative
+                        bx[k] += v
+                        ax[k] += v
+            self.recorded.inc()
+            flagged = self._sentinel(e, agg, b, elapsed_ms) \
+                if not error else None
+        if flagged is not None:
+            # event publish + SPM annotation (a metadb write) happen OUTSIDE
+            # the store lock: every query's exit ramp contends on it, and a
+            # slow persist must not stall concurrent sessions
+            self._flag(e, agg, flagged)
+
+    # -- plan-regression sentinel -------------------------------------------
+
+    def _sentinel(self, e: _Entry, agg: _PlanAgg, b: _Bucket,
+                  elapsed_ms: float) -> Optional[float]:
+        """Judge this window under the store lock; returns the regressed
+        window median when a NEW regression episode just started (the caller
+        publishes after releasing the lock), else None."""
+        min_execs = int(self._cfg("PLAN_REGRESSION_MIN_EXECS", 5))
+        factor = float(self._cfg("PLAN_REGRESSION_FACTOR", 1.5))
+        if e.baseline_ms is None:
+            # baseline forms from the digest's FIRST plan only: a digest
+            # born under two plans has no stable yardstick yet
+            if e.baseline_fp is None:
+                e.baseline_fp = agg.fp
+            if agg.fp == e.baseline_fp:
+                e.baseline_samples.append(elapsed_ms)
+                if len(e.baseline_samples) >= min_execs:
+                    s = sorted(e.baseline_samples)
+                    e.baseline_ms = s[len(s) // 2]
+                    e.baseline_samples = []
+            return None
+        good = b.execs - b.errors
+        if good < min_execs or e.baseline_ms <= 0:
+            return None
+        cur = b.lat.quantile(0.5)
+        if cur > factor * e.baseline_ms:
+            if not agg.flagged:
+                agg.flagged = True
+                return cur  # new episode: caller publishes outside the lock
+        else:
+            agg.flagged = False  # window recovered: re-arm the sentinel
+        return None
+
+    def _flag(self, e: _Entry, agg: _PlanAgg, cur_ms: float):
+        from galaxysql_tpu.utils import events
+        reason = "new_plan" if agg.fp != e.baseline_fp else "plan_drift"
+        inst = self.instance
+        self._regressions.inc()
+        events.publish(
+            "plan_regression",
+            f"digest {e.digest} plan {agg.fp}: window {cur_ms:.1f}ms vs "
+            f"baseline {e.baseline_ms:.1f}ms ({reason})",
+            node=inst.node_id, digest=e.digest, plan=agg.fp, reason=reason,
+            schema=e.schema, window_ms=round(cur_ms, 2),
+            baseline_ms=round(e.baseline_ms, 2),
+            baseline_plan=e.baseline_fp)
+        # annotate the SPM record so BASELINE audits see the runtime verdict
+        # (returns False when this key never captured a baseline — hinted or
+        # uncached plans — which needs no handling here)
+        inst.planner.spm.note_regression(
+            (e.schema, e.ptext),
+            f"{reason}: plan {agg.fp} {cur_ms:.1f}ms vs baseline "
+            f"{e.baseline_fp} {e.baseline_ms:.1f}ms")
+
+    # -- surfaces ------------------------------------------------------------
+
+    def rows(self) -> List[tuple]:
+        """SHOW STATEMENT SUMMARY / information_schema.statement_summary: one
+        row per digest x plan, hottest (total time) first."""
+        out = []
+        with self._lock:
+            for e in self._entries.values():
+                for agg in e.plans.values():
+                    qs = agg.latency.quantiles()
+                    ex = agg.extras
+                    out.append((agg.total_ms, (
+                        e.digest, e.schema, agg.fp,
+                        ",".join(sorted(agg.engines)), agg.execs, agg.errors,
+                        round(agg.total_ms / max(agg.execs - agg.errors, 1),
+                              3),
+                        round(qs[0.95], 3), round(qs[0.99], 3),
+                        agg.rows_returned, agg.rows_examined,
+                        ex["retraces"], ex["frag_hits"],
+                        ex["rf_rows_pruned"], ex["skew_activations"],
+                        ex["rpc_retries"], agg.peak_rss_kb,
+                        1 if agg.flagged else 0,
+                        agg.orders, e.sample_sql)))
+        out.sort(key=lambda t: -t[0])  # hottest = most total time consumed
+        return [r for _, r in out]
+
+    def history_rows(self) -> List[tuple]:
+        """SHOW STATEMENT SUMMARY HISTORY: one row per digest x plan x
+        window bucket, newest bucket first."""
+        out = []
+        with self._lock:
+            for e in self._entries.values():
+                for agg in e.plans.values():
+                    for b in agg.buckets:
+                        out.append((
+                            e.digest, e.schema, agg.fp, int(b.start),
+                            b.execs, b.errors, round(b.avg_ms, 3),
+                            0.0 if b.min_ms == float("inf")
+                            else round(b.min_ms, 3),
+                            round(b.max_ms, 3), b.rows_returned,
+                            b.rows_examined, b.extras["retraces"],
+                            b.extras["frag_hits"],
+                            b.extras["rf_rows_pruned"],
+                            b.extras["rpc_retries"], e.sample_sql[:128]))
+        out.sort(key=lambda r: (-r[3], r[0], r[2]))
+        return out
+
+    def top_digests(self, k: int) -> List[dict]:
+        """Top-K digests by total time — the bounded-cardinality Prometheus
+        export (server/web.py) and the /statements JSON ranking."""
+        ranked: List[Tuple[float, dict]] = []
+        with self._lock:
+            for e in self._entries.values():
+                total_ms = sum(a.total_ms for a in e.plans.values())
+                execs = sum(a.execs for a in e.plans.values())
+                errors = sum(a.errors for a in e.plans.values())
+                # blended quantiles across plans: sample the per-plan
+                # reservoirs proportionally (host-side, tiny)
+                merged = Histogram("m", reservoir=256)
+                for a in e.plans.values():
+                    with a.latency._lock:
+                        buf = list(a.latency._buf)
+                    merged.observe_many(buf)
+                qs = merged.quantiles()
+                ranked.append((total_ms, {
+                    "digest": e.digest, "schema": e.schema,
+                    "sql": e.sample_sql, "execs": execs, "errors": errors,
+                    "total_ms": round(total_ms, 3),
+                    "plans": sorted(e.plans),
+                    "workloads": sorted(set().union(
+                        *(a.workloads for a in e.plans.values()))),
+                    "regressed": any(a.flagged for a in e.plans.values()),
+                    "p50_ms": round(qs[0.5], 3), "p95_ms": round(qs[0.95], 3),
+                    "p99_ms": round(qs[0.99], 3)}))
+        ranked.sort(key=lambda t: -t[0])
+        return [d for _, d in ranked[:k]]
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()

@@ -285,8 +285,15 @@ class WorkerClient:
     def _breaker_ok(self):
         if self._bk_fails or self._bk_state != "closed":
             with self._bk_lock:
+                reopened = self._bk_state != "closed"
                 self._bk_fails = 0
                 self._bk_state = "closed"
+            if reopened:
+                from galaxysql_tpu.utils import events
+                events.publish("breaker_close",
+                               f"worker {self.addr[0]}:{self.addr[1]}: "
+                               "circuit breaker closed (probe succeeded)",
+                               worker=f"{self.addr[0]}:{self.addr[1]}")
 
     def _breaker_fail(self, exc: BaseException):
         from galaxysql_tpu.utils.metrics import BREAKER_OPENS
@@ -300,6 +307,13 @@ class WorkerClient:
                 self._bk_opened_at = time.time()
                 self.stat_opens += 1
                 BREAKER_OPENS.inc()
+                from galaxysql_tpu.utils import events
+                events.publish("breaker_open",
+                               f"worker {self.addr[0]}:{self.addr[1]}: "
+                               f"breaker opened after {self._bk_fails} "
+                               f"failures ({self.last_error})",
+                               worker=f"{self.addr[0]}:{self.addr[1]}",
+                               consec_failures=self._bk_fails)
 
     def _breaker_gate(self):
         """Fast-fail while open; after the cooldown, half-open and let ONE
@@ -335,6 +349,11 @@ class WorkerClient:
                 # breaker_opens counter must show a flapping endpoint
                 self.stat_opens += 1
             BREAKER_OPENS.inc()
+            from galaxysql_tpu.utils import events
+            events.publish("breaker_open",
+                           f"worker {self.addr[0]}:{self.addr[1]}: "
+                           "half-open probe failed; breaker re-opened",
+                           worker=f"{self.addr[0]}:{self.addr[1]}")
             raise errors.WorkerUnavailableError(
                 f"worker {self.addr[0]}:{self.addr[1]}: half-open probe "
                 f"failed; breaker re-opened", sent=False)
@@ -765,6 +784,11 @@ class SyncBus:
                     r = {"ok": False, "error": "sync broadcast timed out"}
                 if not r.get("ok"):
                     SYNC_FAILURES.inc()
+                    from galaxysql_tpu.utils import events
+                    events.publish("sync_failure",
+                                   f"sync '{action}' delivery failed: "
+                                   f"{r.get('error', '')}"[:200],
+                                   node=self.origin or "", action=action)
                     if hasattr(w, "mark_needs_heal"):
                         w.mark_needs_heal()
                 results.append(r)

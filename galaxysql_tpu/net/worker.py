@@ -156,6 +156,11 @@ class Worker:
         with self._lock:
             self.heals += 1
         SYNC_HEALS.inc()
+        from galaxysql_tpu.utils import events
+        events.publish("sync_heal",
+                       "missed sync broadcast detected: plan/fragment/"
+                       "privilege caches wholesale-invalidated",
+                       node=getattr(inst, "node_id", ""))
 
     # -- idempotency dedupe window -------------------------------------------
 

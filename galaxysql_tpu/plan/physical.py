@@ -292,8 +292,15 @@ class ScanSource(ops.Operator):
                     # blip (worker restarting, half-open probe race) must
                     # not fence an endpoint the next ping proves alive
                     from galaxysql_tpu.utils.metrics import WORKER_FAILOVERS
+                    from galaxysql_tpu.utils import events
                     inst.ha.fence_worker(addr, True)
                     WORKER_FAILOVERS.inc()
+                    events.publish("worker_failover",
+                                   f"scan {t.name}: fenced dead endpoint "
+                                   f"{addr[0]}:{addr[1]}, re-routing",
+                                   node=inst.node_id, table=t.name,
+                                   worker=f"{addr[0]}:{addr[1]}",
+                                   fenced=True)
                     self.ctx.trace.append(
                         f"failover {t.name}: fenced {addr[0]}:{addr[1]}")
                     continue  # endpoint dead: re-route within the statement
@@ -301,7 +308,14 @@ class ScanSource(ops.Operator):
                     # alive but erroring (breaker mid-recovery): re-route
                     # this statement without fencing
                     from galaxysql_tpu.utils.metrics import WORKER_FAILOVERS
+                    from galaxysql_tpu.utils import events
                     WORKER_FAILOVERS.inc()
+                    events.publish("worker_failover",
+                                   f"scan {t.name}: rerouted off live "
+                                   f"endpoint {addr[0]}:{addr[1]}",
+                                   node=inst.node_id, table=t.name,
+                                   worker=f"{addr[0]}:{addr[1]}",
+                                   fenced=False)
                     self.ctx.trace.append(
                         f"failover {t.name}: rerouted off "
                         f"{addr[0]}:{addr[1]} (alive)")

@@ -46,7 +46,8 @@ class SpmContext:
 
 
 class PlanRecord:
-    __slots__ = ("orders", "origin", "runs", "total_ms")
+    __slots__ = ("orders", "origin", "runs", "total_ms", "regressions",
+                 "last_regression")
 
     def __init__(self, orders: List[Tuple[str, ...]], origin: str = "cost",
                  runs: int = 0, total_ms: float = 0.0):
@@ -54,6 +55,11 @@ class PlanRecord:
         self.origin = origin          # cost | evolved | manual
         self.runs = runs
         self.total_ms = total_ms
+        # runtime-regression audit trail, written by the statement-summary
+        # sentinel (meta/statement_summary.py): how often this accepted plan
+        # was flagged against the digest's latency baseline, and why last
+        self.regressions = 0
+        self.last_regression = ""
 
     @property
     def avg_ms(self) -> float:
@@ -61,12 +67,17 @@ class PlanRecord:
 
     def to_json(self):
         return {"orders": [list(o) for o in self.orders], "origin": self.origin,
-                "runs": self.runs, "total_ms": self.total_ms}
+                "runs": self.runs, "total_ms": self.total_ms,
+                "regressions": self.regressions,
+                "last_regression": self.last_regression}
 
     @classmethod
     def from_json(cls, d):
-        return cls([tuple(o) for o in d["orders"]], d.get("origin", "cost"),
-                   d.get("runs", 0), d.get("total_ms", 0.0))
+        r = cls([tuple(o) for o in d["orders"]], d.get("origin", "cost"),
+                d.get("runs", 0), d.get("total_ms", 0.0))
+        r.regressions = d.get("regressions", 0)
+        r.last_regression = d.get("last_regression", "")
+        return r
 
 
 class Baseline:
@@ -185,6 +196,20 @@ class PlanManager:
             b = self._baselines.get(key)
             return list(b.last_params) if b is not None else []
 
+    def note_regression(self, key: Tuple[str, str], note: str) -> bool:
+        """Statement-summary sentinel verdict: stamp the accepted PlanRecord
+        so BASELINE audits (SHOW BASELINE, /baselines) carry the runtime
+        truth.  Returns False when the key has no baseline (hinted or
+        uncached plans never captured one)."""
+        with self._lock:
+            b = self._baselines.get(key)
+            if b is None:
+                return False
+            b.accepted.regressions += 1
+            b.accepted.last_regression = note[:256]
+            self._persist(b)
+            return True
+
     # -- DAL ----------------------------------------------------------------
 
     def rows(self) -> List[tuple]:
@@ -198,7 +223,9 @@ class PlanManager:
                             b.accepted.origin, b.accepted.runs,
                             round(b.accepted.avg_ms, 3) if b.accepted.runs else None,
                             json.dumps([list(o) for o in b.candidate.orders])
-                            if b.candidate else None))
+                            if b.candidate else None,
+                            b.accepted.regressions,
+                            b.accepted.last_regression))
         return out
 
     def delete(self, baseline_id: int) -> bool:

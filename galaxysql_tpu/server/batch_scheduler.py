@@ -318,12 +318,18 @@ class BatchScheduler:
             else:
                 self._execute(gkey, pp, pinned_ts, reqs)
                 self._bulk_finish(pp, reqs, flush_t)
-        except Exception:
+        except Exception as ex:
             # group-scope failure: every member re-executes sequentially and
             # gets its own error attribution there
             for r in reqs:
                 r.fallback = True
             self.fallbacks.inc(len(reqs))
+            from galaxysql_tpu.utils import events
+            events.publish("batch_fallback",
+                           f"batch group of {len(reqs)} fell back to the "
+                           f"sequential path: {type(ex).__name__}: {ex}",
+                           node=self.instance.node_id,
+                           group_size=len(reqs))
         finally:
             # unpark the NEXT group's leader before the followers: it starts
             # its stall-loop collecting while this group's members drain

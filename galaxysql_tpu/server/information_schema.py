@@ -70,6 +70,28 @@ _DEFS: Dict[str, List] = {
     "workers": [("host", _V), ("port", _I), ("breaker_state", _V),
                 ("fenced", _I), ("consec_failures", _I), ("retries", _I),
                 ("failures", _I), ("breaker_opens", _I), ("last_error", _V)],
+    # statement-digest store (meta/statement_summary.py): per digest x plan
+    # fingerprint aggregates — SHOW STATEMENT SUMMARY twin
+    "statement_summary": [
+        ("digest", _V), ("schema_name", _V), ("plan_fingerprint", _V),
+        ("engines", _V), ("exec_count", _I), ("error_count", _I),
+        ("avg_latency_ms", _D), ("p95_latency_ms", _D),
+        ("p99_latency_ms", _D), ("rows_returned", _I), ("rows_examined", _I),
+        ("retraces", _I), ("frag_cache_hits", _I), ("rf_rows_pruned", _I),
+        ("skew_activations", _I), ("rpc_retries", _I), ("peak_rss_kb", _I),
+        ("regressed", _I), ("join_order", _V), ("sample_sql", _V)],
+    # time-bucketed windows per digest x plan (SHOW STATEMENT SUMMARY
+    # HISTORY twin), newest bucket first
+    "statement_summary_history": [
+        ("digest", _V), ("schema_name", _V), ("plan_fingerprint", _V),
+        ("window_start", _I), ("exec_count", _I), ("error_count", _I),
+        ("avg_latency_ms", _D), ("min_latency_ms", _D),
+        ("max_latency_ms", _D), ("rows_returned", _I), ("rows_examined", _I),
+        ("retraces", _I), ("frag_cache_hits", _I), ("rf_rows_pruned", _I),
+        ("rpc_retries", _I), ("sample_sql", _V)],
+    # typed instance-event journal (utils/events.py; SHOW EVENTS twin)
+    "events": [("seq", _I), ("at", _D), ("kind", _V), ("severity", _V),
+               ("node", _V), ("detail", _V), ("attrs", _V)],
 }
 
 
@@ -186,3 +208,12 @@ def refresh(instance, session=None):
     fill("batch_stats", ([n, float(v)] for n, v in
                          (sched.stats_rows() if sched is not None else [])))
     fill("workers", (list(r) for r in instance.worker_rows()))
+    ss = getattr(instance, "stmt_summary", None)
+    fill("statement_summary",
+         (list(r) for r in (ss.rows() if ss is not None else [])))
+    fill("statement_summary_history",
+         (list(r) for r in (ss.history_rows() if ss is not None else [])))
+    from galaxysql_tpu.utils.events import EVENTS
+    fill("events", ([e.seq, round(e.at, 3), e.kind, e.severity, e.node,
+                     e.detail, _json.dumps(e.attrs, default=str)[:512]]
+                    for e in EVENTS.entries()))

@@ -109,6 +109,11 @@ class Instance:
         # last-N per-query runtime profiles (information_schema.query_stats,
         # SHOW FULL STATS, web /query/<trace_id>)
         self.profiles = ProfileRing()
+        # statement-digest workload-insight store (meta/statement_summary.py):
+        # per digest x plan fingerprint time-windowed aggregates + the
+        # plan-regression sentinel; fed by Session._finish_query
+        from galaxysql_tpu.meta.statement_summary import StatementSummaryStore
+        self.stmt_summary = StatementSummaryStore(self)
         # (schema, parameterized-sql) -> PointPlan: binder-free execution of
         # archetypal point SELECTs (DirectShardingKeyTableOperation analog)
         self.point_plans: Dict[tuple, object] = {}

@@ -529,12 +529,49 @@ class Session:
         return bool(self.instance.config.get("ENABLE_QUERY_TRACING",
                                              self.vars))
 
+    def _digest_of(self, sql: str, schema: str = "") -> str:
+        """Statement digest of a raw SQL text (memoized end-to-end: the
+        parameterize pass and the hash both cache by exact text)."""
+        if not sql or sql.startswith("<"):
+            return ""  # internal/synthetic statements have no digest
+        from galaxysql_tpu.meta import statement_summary as _ss
+        return _ss.digest_key((schema or self.schema or "").lower(),
+                              parameterize(sql).parameterized)
+
+    def _summary_record(self, sql: str, prof, workload: str, engine: str,
+                        rows: int, plan=None, error: bool = False):
+        """Feed the statement-summary store (meta/statement_summary.py) from
+        the query exit ramps.  Host-side adds only; the per-query counter
+        deltas come from the snapshot _run_query took at entry."""
+        if not sql or sql.startswith("<"):
+            return
+        from galaxysql_tpu.meta import statement_summary as _ss
+        ss = self.instance.stmt_summary
+        if not ss.on(self.vars):
+            return
+        p = parameterize(sql)
+        if engine in ("point", "batch"):
+            fp, orders = "point", ""  # both serve the cached PointPlan shape
+        elif error and plan is None:
+            fp, orders = "unknown", ""
+        else:
+            fp = _ss.plan_fingerprint(plan)
+            jo = getattr(plan, "join_orders", None) or []
+            orders = ";".join(">".join(o) for o in jo)
+        ss.record(prof.schema, p.parameterized, sql, fp, orders, workload,
+                  engine, prof.elapsed_ms, rows,
+                  rows_examined=int(getattr(plan, "scanned_rows", 0) or 0),
+                  error=error, peak_rss_kb=prof.peak_rss_kb,
+                  extras=None if error else
+                  _ss.counters_delta(getattr(self, "_ss0", None),
+                                     self.instance))
+
     def _finish_query(self, sql: str, elapsed: float, prof, workload: str,
-                      engine: str, rows: int, ctx=None):
+                      engine: str, rows: int, ctx=None, plan=None):
         """Every query's single exit ramp: fill + record the QueryProfile,
-        bump the metrics registry, and apply the slow-SQL gate (the one home
-        for the SLOW_SQL_MS check — point, local, and MPP paths all land
-        here)."""
+        bump the metrics registry, aggregate into the statement-summary
+        store, and apply the slow-SQL gate (the one home for the SLOW_SQL_MS
+        check — point, local, and MPP paths all land here)."""
         prof.workload = workload
         prof.engine = engine
         prof.rows = rows
@@ -564,11 +601,13 @@ class Session:
         q_wl.inc()
         q_eng.inc()
         tracing.GLOBAL_STATS.bump("queries")
+        self._summary_record(sql, prof, workload, engine, rows, plan)
         slow_ms = inst.config.get("SLOW_SQL_MS", self.vars)
         # 0 logs every query (MySQL long_query_time=0); negative disables
         if slow_ms is not None and slow_ms >= 0 and elapsed * 1000 >= slow_ms:
             tracing.SLOW_LOG.record(sql or "<stmt>", elapsed, self.conn_id,
-                            trace_id=prof.trace_id, workload=workload)
+                            trace_id=prof.trace_id, workload=workload,
+                            digest=self._digest_of(sql, prof.schema))
             tracing.GLOBAL_STATS.bump("slow")
             m.counter("slow_queries", "queries over SLOW_SQL_MS").inc()
 
@@ -578,6 +617,10 @@ class Session:
         prof = tracing.QueryProfile(trace_id=self.instance.trace_ids.next(),
                                     sql=(sql or "<stmt>")[:512], schema=schema,
                                     conn_id=self.conn_id, started_at=t0)
+        # statement-summary counter bracket: five host-side reads whose
+        # deltas attribute compile/cache/filter/retry work to this digest
+        from galaxysql_tpu.meta.statement_summary import counters_snapshot
+        self._ss0 = counters_snapshot(self.instance)
         if "information_schema" in (sql or "").lower() or \
                 schema.lower() == "information_schema":
             from galaxysql_tpu.server import information_schema
@@ -642,6 +685,9 @@ class Session:
         if isinstance(exc, _err.QueryTimeoutError):
             from galaxysql_tpu.utils.metrics import QUERY_TIMEOUTS
             QUERY_TIMEOUTS.inc()
+        # failed queries still owe the digest their error count + elapsed
+        self._summary_record(sql, prof, prof.workload or "TP",
+                             prof.engine, 0, error=True)
         self.last_trace = [f"trace-id {prof.trace_id}",
                            f"error {prof.error}",
                            f"elapsed={elapsed:.3f}s"]
@@ -649,7 +695,8 @@ class Session:
         if slow_ms is not None and slow_ms >= 0 and elapsed * 1000 >= slow_ms:
             tracing.SLOW_LOG.record(sql or "<stmt>", elapsed, self.conn_id,
                             trace_id=prof.trace_id, workload=prof.workload,
-                            error=type(exc).__name__)
+                            error=type(exc).__name__,
+                            digest=self._digest_of(sql, prof.schema))
             tracing.GLOBAL_STATS.bump("slow")
             inst.metrics.counter("slow_queries",
                                  "queries over SLOW_SQL_MS").inc()
@@ -893,15 +940,18 @@ class Session:
             raise req.error  # isolated to this session; group members proceed
         # the leader bulk-finished profile/ring/metrics at scatter
         # (BatchScheduler._bulk_finish): the woken member's serialized tail
-        # is only SHOW TRACE state, the per-session slow-SQL gate, and the
-        # ResultSet handover (req.rows is this request's own scatter slice)
+        # is only SHOW TRACE state, the statement-summary record, the
+        # per-session slow-SQL gate, and the ResultSet handover (req.rows is
+        # this request's own scatter slice)
         self.last_trace = prof.trace
+        self._summary_record(sql, prof, "TP", "batch", len(req.rows))
         slow_ms = self.instance.config.get("SLOW_SQL_MS", self.vars)
         if slow_ms is not None and slow_ms >= 0:
             elapsed = time.time() - t0
             if elapsed * 1000 >= slow_ms:
                 tracing.SLOW_LOG.record(sql, elapsed, self.conn_id,
-                                        trace_id=prof.trace_id, workload="TP")
+                                        trace_id=prof.trace_id, workload="TP",
+                                        digest=self._digest_of(sql, schema))
                 tracing.GLOBAL_STATS.bump("slow")
                 self.instance.metrics.counter(
                     "slow_queries", "queries over SLOW_SQL_MS").inc()
@@ -982,7 +1032,8 @@ class Session:
         self.last_trace = [f"trace-id {prof.trace_id}"] + ctx.trace + \
             [f"elapsed={elapsed:.3f}s workload={plan.workload}"]
         self._finish_query(sql, elapsed, prof, plan.workload,
-                           "mpp" if mpp_used else "local", len(rows), ctx)
+                           "mpp" if mpp_used else "local", len(rows), ctx,
+                           plan=plan)
         return ResultSet(plan.display_names, [t for _, t, _ in fields], rows,
                          batch=batch)
 
@@ -1537,9 +1588,14 @@ class Session:
             self.instance.register_table(tm)
             self.instance.metadb.save_schema(schema)
             self.instance.metadb.notify(f"table.{schema}.{tm.name}")
+            from galaxysql_tpu.utils import events
+            events.publish("ddl", f"CREATE TABLE {schema}.{tm.name}",
+                           node=self.instance.node_id, schema=schema,
+                           table=tm.name)
         return ok()
 
     def _run_drop_table(self, stmt: ast.DropTable) -> ResultSet:
+        from galaxysql_tpu.utils import events
         schema = self._require_schema()
         for name in stmt.names:
             s = name.schema or schema
@@ -1550,9 +1606,17 @@ class Session:
                 except errors.TddlError:
                     tm = None
                 if tm is not None and self.instance.recycle.drop(tm):
-                    continue  # parked in the bin (FLASHBACK can restore)
+                    # parked in the bin (FLASHBACK can restore)
+                    events.publish("ddl",
+                                   f"DROP TABLE {s}.{name.table} (recycled)",
+                                   node=self.instance.node_id, schema=s,
+                                   table=name.table)
+                    continue
             if self.instance.catalog.drop_table(s, name.table, stmt.if_exists):
                 self.instance.drop_store(s, name.table)
+                events.publish("ddl", f"DROP TABLE {s}.{name.table}",
+                               node=self.instance.node_id, schema=s,
+                               table=name.table)
         return ok()
 
     def _run_check_table(self, stmt: ast.CheckTable) -> ResultSet:
@@ -1762,7 +1826,7 @@ class Session:
                              f"rows_in={sp.rows_in} rows_out={sp.rows_out} "
                              f"compiled={sp.compiled} wall={sp.wall_ms}ms")
             self._finish_query(prof.sql, elapsed, prof, plan.workload,
-                               "local", rows, ctx)
+                               "local", rows, ctx, plan=plan)
         lines.append(f"-- workload: {plan.workload}")
         return ResultSet(["plan"], [dt.VARCHAR], [(l,) for l in lines])
 

@@ -83,13 +83,16 @@ def handle(session, stmt: ast.Show):
             [dt.BIGINT, dt.BIGINT, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR],
             rows)
     if kind == "baseline":
-        # SPM DAL (PlanManager.java DAL analog): one row per plan baseline
+        # SPM DAL (PlanManager.java DAL analog): one row per plan baseline;
+        # REGRESSIONS/LAST_REGRESSION carry the statement-summary sentinel's
+        # runtime verdict on the accepted plan
         rows = inst.planner.spm.rows()
         return ResultSet(
             ["BASELINE_ID", "SCHEMA_NAME", "PARAMETERIZED_SQL", "ACCEPTED_PLAN",
-             "ORIGIN", "RUNS", "AVG_MS", "CANDIDATE_PLAN"],
+             "ORIGIN", "RUNS", "AVG_MS", "CANDIDATE_PLAN", "REGRESSIONS",
+             "LAST_REGRESSION"],
             [dt.BIGINT, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR,
-             dt.BIGINT, dt.DOUBLE, dt.VARCHAR], rows)
+             dt.BIGINT, dt.DOUBLE, dt.VARCHAR, dt.BIGINT, dt.VARCHAR], rows)
     if kind == "create_table":
         schema = session.schema
         tm = inst.catalog.table(schema, stmt.target)
@@ -162,13 +165,15 @@ def handle(session, stmt: ast.Show):
         # information_schema.query_stats / web /query/<trace_id>); Error is
         # non-empty for queries that died mid-execution AFTER crossing the
         # slow gate — slow failures explain themselves here too
+        # Digest jumps a slow row straight to its SHOW STATEMENT SUMMARY
+        # aggregate (same digest key: schema + parameterized text)
         rows = [(e.conn_id, round(e.elapsed_s * 1000, 1), e.sql,
-                 e.trace_id, e.workload, e.error)
+                 e.trace_id, e.workload, e.error, e.digest)
                 for e in SLOW_LOG.entries()]
         return ResultSet(["Conn", "Elapsed_ms", "SQL", "Trace_id", "Workload",
-                          "Error"],
+                          "Error", "Digest"],
                          [dt.BIGINT, dt.DOUBLE, dt.VARCHAR, dt.BIGINT,
-                          dt.VARCHAR, dt.VARCHAR], rows)
+                          dt.VARCHAR, dt.VARCHAR, dt.VARCHAR], rows)
     if kind == "fragment" and (stmt.target or "").lower() == "cache":
         # SHOW FRAGMENT CACHE: one row per resident entry, MRU first, plus
         # the totals SHOW METRICS carries as frag_cache_* counters
@@ -185,6 +190,44 @@ def handle(session, stmt: ast.Show):
         rows = sched.stats_rows() if sched is not None else []
         return ResultSet(["Stat", "Value"], [dt.VARCHAR, dt.DOUBLE],
                          [(n, float(v)) for n, v in rows])
+    if kind == "statement_summary":
+        # SHOW STATEMENT SUMMARY [HISTORY]: the statement-digest store
+        # (meta/statement_summary.py) — per digest x plan aggregates, or the
+        # time-bucketed window history (information_schema twins)
+        ss = inst.stmt_summary
+        if (stmt.target or "").lower() == "history":
+            return ResultSet(
+                ["Digest", "Schema", "Plan", "Window_start", "Execs",
+                 "Errors", "Avg_ms", "Min_ms", "Max_ms", "Rows_returned",
+                 "Rows_examined", "Retraces", "Frag_hits", "Rf_rows_pruned",
+                 "Rpc_retries", "SQL"],
+                [dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.BIGINT, dt.BIGINT,
+                 dt.BIGINT, dt.DOUBLE, dt.DOUBLE, dt.DOUBLE, dt.BIGINT,
+                 dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.BIGINT,
+                 dt.VARCHAR], ss.history_rows())
+        return ResultSet(
+            ["Digest", "Schema", "Plan", "Engines", "Execs", "Errors",
+             "Avg_ms", "P95_ms", "P99_ms", "Rows_returned", "Rows_examined",
+             "Retraces", "Frag_hits", "Rf_rows_pruned", "Skew_activations",
+             "Rpc_retries", "Peak_rss_kb", "Regressed", "Join_order", "SQL"],
+            [dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.BIGINT,
+             dt.BIGINT, dt.DOUBLE, dt.DOUBLE, dt.DOUBLE, dt.BIGINT,
+             dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.BIGINT,
+             dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.VARCHAR, dt.VARCHAR],
+            ss.rows())
+    if kind == "events":
+        # SHOW EVENTS: the typed instance-event journal (utils/events.py) —
+        # DDL, breaker transitions, failovers, sync heals, skew decisions,
+        # batch fallbacks, plan regressions — newest first
+        import json as _json
+        from galaxysql_tpu.utils.events import EVENTS
+        rows = [(e.seq, round(e.at, 3), e.kind, e.severity, e.node, e.detail,
+                 _json.dumps(e.attrs, default=str)[:512])
+                for e in reversed(EVENTS.entries())]
+        return ResultSet(
+            ["Seq", "At", "Kind", "Severity", "Node", "Detail", "Attrs"],
+            [dt.BIGINT, dt.DOUBLE, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR,
+             dt.VARCHAR, dt.VARCHAR], rows)
     if kind == "workers":
         # SHOW WORKERS: attached worker endpoints with fence + circuit-breaker
         # state and lifetime retry/failure counters (the fault-tolerance

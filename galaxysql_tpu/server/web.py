@@ -10,6 +10,9 @@ resources served by the MPP coordinator's HTTP server).  Endpoints:
 - /baselines         SPM baselines (SHOW BASELINE as JSON)
 - /scheduler         background jobs + recent firings
 - /query-stats       last-N QueryProfile summaries (newest first)
+- /statements        statement-digest summary store: top digests (ranked by
+                     total time), per digest x plan rows, window history,
+                     and the recent instance-event journal
 - /query/<trace_id>  one query's full profile: per-operator rows/time,
                      fused-segment spans, trace tags (QueryStats analog)
 - /trace/<trace_id>  the query's span tree as Chrome-trace/Perfetto JSON
@@ -61,7 +64,7 @@ class WebConsole:
             slow = [{"sql": e.sql, "elapsed_s": e.elapsed_s,
                      "conn_id": e.conn_id, "at": e.at,
                      "trace_id": e.trace_id, "workload": e.workload,
-                     "error": e.error}
+                     "error": e.error, "digest": e.digest}
                     for e in SLOW_LOG.entries()]
             return {"sessions": sessions, "slow_queries": slow[-50:]}
         if path == "/cluster":
@@ -77,7 +80,8 @@ class WebConsole:
                     "size": len(c._map), "capacity": c.capacity}
         if path == "/baselines":
             cols = ["baseline_id", "schema", "sql", "accepted", "origin",
-                    "runs", "avg_ms", "candidate"]
+                    "runs", "avg_ms", "candidate", "regressions",
+                    "last_regression"]
             return {"baselines": [dict(zip(cols, r))
                                   for r in inst.planner.spm.rows()]}
         if path == "/scheduler":
@@ -94,6 +98,29 @@ class WebConsole:
                  "engine": p.engine, "elapsed_ms": p.elapsed_ms,
                  "rows": p.rows, "profiled": p.profiled, "sql": p.sql}
                 for p in reversed(inst.profiles.entries())]}
+        if path == "/statements":
+            from galaxysql_tpu.utils.events import EVENTS
+            ss = inst.stmt_summary
+            k = int(inst.config.get("STMT_SUMMARY_PROM_TOPK"))
+            sum_cols = ["digest", "schema", "plan", "engines", "execs",
+                        "errors", "avg_ms", "p95_ms", "p99_ms",
+                        "rows_returned", "rows_examined", "retraces",
+                        "frag_hits", "rf_rows_pruned", "skew_activations",
+                        "rpc_retries", "peak_rss_kb", "regressed",
+                        "join_order", "sql"]
+            hist_cols = ["digest", "schema", "plan", "window_start", "execs",
+                         "errors", "avg_ms", "min_ms", "max_ms",
+                         "rows_returned", "rows_examined", "retraces",
+                         "frag_hits", "rf_rows_pruned", "rpc_retries", "sql"]
+            return {"top": ss.top_digests(k),
+                    "statements": [dict(zip(sum_cols, r))
+                                   for r in ss.rows()],
+                    "history": [dict(zip(hist_cols, r))
+                                for r in ss.history_rows()[:200]],
+                    "events": [{"seq": e.seq, "at": e.at, "kind": e.kind,
+                                "severity": e.severity, "node": e.node,
+                                "detail": e.detail, "attrs": e.attrs}
+                               for e in EVENTS.entries()[-50:]]}
         if path.startswith("/query/"):
             try:
                 trace_id = int(path[len("/query/"):])
@@ -131,7 +158,46 @@ class WebConsole:
             scrape.gauge(f"instance_{name}",
                          "MatrixStatistics counter").set(value)
         return self.instance.metrics.prometheus_text() + \
-            scrape.prometheus_text()
+            scrape.prometheus_text() + self._insight_text()
+
+    def _insight_text(self) -> str:
+        """Workload-insight exposition: instance-event counters (a `kind`
+        label per event type) and the top-K statement digests' latency
+        summaries (a `digest` label, bounded cardinality — top-K by total
+        time only, K = STMT_SUMMARY_PROM_TOPK)."""
+        from galaxysql_tpu.utils.events import EVENTS
+        inst = self.instance
+        ns = inst.metrics.namespace
+        out = ["# HELP %s_events_total instance events by kind" % ns,
+               "# TYPE %s_events_total counter" % ns]
+        for kind, n in sorted(EVENTS.counts().items()):
+            out.append(f'{ns}_events_total{{kind="{kind}"}} {n}')
+        ss = getattr(inst, "stmt_summary", None)
+        if ss is not None:
+            # K=0 is a real setting (digest labels off), not "use default"
+            k = int(inst.config.get("STMT_SUMMARY_PROM_TOPK"))
+            tops = ss.top_digests(k) if k > 0 else []
+            if tops:
+                out.append(f"# HELP {ns}_stmt_latency_ms top-{k} statement "
+                           "digests, latency summary")
+                out.append(f"# TYPE {ns}_stmt_latency_ms summary")
+                for d in tops:
+                    lbl = f'digest="{d["digest"]}"'
+                    for q, key in ((0.5, "p50_ms"), (0.95, "p95_ms"),
+                                   (0.99, "p99_ms")):
+                        out.append(f'{ns}_stmt_latency_ms{{{lbl},'
+                                   f'quantile="{q}"}} {d[key]}')
+                    out.append(f'{ns}_stmt_latency_ms_sum{{{lbl}}} '
+                               f'{d["total_ms"]}')
+                    out.append(f'{ns}_stmt_latency_ms_count{{{lbl}}} '
+                               f'{d["execs"]}')
+                out.append(f"# HELP {ns}_stmt_errors_total top-{k} statement "
+                           "digests, failed executions")
+                out.append(f"# TYPE {ns}_stmt_errors_total counter")
+                for d in tops:
+                    out.append(f'{ns}_stmt_errors_total{{digest='
+                               f'"{d["digest"]}"}} {d["errors"]}')
+        return "\n".join(out) + "\n"
 
     # -- http ----------------------------------------------------------------
 

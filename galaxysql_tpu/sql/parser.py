@@ -1435,6 +1435,12 @@ class Parser:
             self.expect_kw("TABLE")
             stmt.kind = "create_table"
             stmt.target = self._table_name().table
+        elif kind == "STATEMENT":
+            # SHOW STATEMENT SUMMARY [HISTORY] (statement-digest store)
+            self.expect_kw("SUMMARY")
+            stmt.kind = "statement_summary"
+            if self.accept_kw("HISTORY"):
+                stmt.target = "history"
         elif kind in ("VARIABLES", "STATUS", "WARNINGS", "PROCESSLIST", "COLLATION",
                       "ENGINES", "CHARSET", "TRACE", "INDEX", "INDEXES", "KEYS"):
             if kind in ("INDEX", "INDEXES", "KEYS"):

@@ -69,6 +69,7 @@ class SlowEntry:
     trace_id: int = 0     # links SHOW SLOW rows to information_schema.query_stats
     workload: str = ""    # TP | AP
     error: str = ""       # non-empty: the query FAILED after elapsed_s
+    digest: str = ""      # statement digest: jumps to SHOW STATEMENT SUMMARY
 
 
 class SlowLog:
@@ -79,11 +80,12 @@ class SlowLog:
         self._lock = threading.Lock()
 
     def record(self, sql: str, elapsed_s: float, conn_id: int,
-               trace_id: int = 0, workload: str = "", error: str = ""):
+               trace_id: int = 0, workload: str = "", error: str = "",
+               digest: str = ""):
         with self._lock:
             self._ring.append(SlowEntry(sql[:512], elapsed_s, conn_id,
                                         time.time(), trace_id, workload,
-                                        error))
+                                        error, digest))
 
     def entries(self) -> List[SlowEntry]:
         with self._lock:

@@ -1,0 +1,451 @@
+"""Workload insight: statement-digest summary store, time-windowed telemetry,
+the instance-event journal, slow-log digest linkage, and the plan-regression
+sentinel.
+
+The `summary`-marked tests are the fast smoke target (`make summary-smoke`).
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from galaxysql_tpu.exec import operators as ops
+from galaxysql_tpu.meta import statement_summary as ssm
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.utils.events import EVENTS
+from galaxysql_tpu.utils.tracing import SLOW_LOG
+
+
+def _mk(schema="ws"):
+    inst = Instance()
+    s = Session(inst)
+    s.execute(f"CREATE DATABASE {schema}")
+    s.execute(f"USE {schema}")
+    return inst, s
+
+
+def _summary_rows(s, contains=None):
+    rows = s.execute("SHOW STATEMENT SUMMARY").rows
+    if contains is None:
+        return rows
+    return [r for r in rows if contains in r[-1]]
+
+
+# -- digest aggregation --------------------------------------------------------
+
+
+@pytest.mark.summary
+class TestDigestAggregation:
+    def test_digest_stable_across_literals(self):
+        inst, s = _mk()
+        s.execute("CREATE TABLE t (a BIGINT PRIMARY KEY, b BIGINT)")
+        for i in range(10):
+            s.execute(f"INSERT INTO t VALUES ({i}, {i * 10})")
+        for i in range(7):
+            s.execute(f"SELECT b FROM t WHERE a = {i}")
+        rows = _summary_rows(s, "SELECT b FROM t")
+        digests = {r[0] for r in rows}
+        assert len(digests) == 1, "literal values must share one digest"
+        assert sum(r[4] for r in rows) == 7
+        assert all(r[5] == 0 for r in rows)  # no errors
+        # the point fast path records under its own plan fingerprint
+        assert "point" in {r[2] for r in rows}
+        s.close()
+
+    def test_error_count_and_unknown_plan(self):
+        inst, s = _mk("wse")
+        s.execute("CREATE TABLE t (a BIGINT)")
+        s.execute("INSERT INTO t VALUES (1)")
+        for _ in range(3):
+            with pytest.raises(Exception):
+                s.execute("SELECT nope FROM t WHERE a = 1")
+        rows = _summary_rows(s, "SELECT nope")
+        assert rows and sum(r[5] for r in rows) == 3
+        assert sum(r[4] for r in rows) == 3
+        s.close()
+
+    def test_history_buckets_and_information_schema(self):
+        inst, s = _mk("wsh")
+        s.execute("CREATE TABLE t (a BIGINT, b BIGINT)")
+        inst.store("wsh", "t").insert_pylists(
+            {"a": list(range(500)), "b": list(range(500))},
+            inst.tso.next_timestamp())
+        for _ in range(4):
+            s.execute("SELECT count(*) FROM t WHERE a < 250")
+        hist = s.execute("SHOW STATEMENT SUMMARY HISTORY")
+        hrows = [r for r in hist.rows if "count" in r[-1]]
+        assert hrows
+        window = inst.config.get("STMT_SUMMARY_WINDOW_S")
+        assert all(r[3] % window == 0 for r in hrows)  # aligned bucket starts
+        assert sum(r[4] for r in hrows) == 4
+        # SQL-queryable twins (exercises the whole engine over the views)
+        r = s.execute("SELECT digest, exec_count FROM "
+                      "information_schema.statement_summary "
+                      "WHERE exec_count > 0")
+        assert r.rows
+        r = s.execute("SELECT digest, exec_count FROM "
+                      "information_schema.statement_summary_history")
+        assert r.rows
+        r = s.execute("SELECT kind FROM information_schema.events")
+        assert any(k == ("ddl",) for k in r.rows)
+        s.close()
+
+    def test_rows_and_counters_aggregate(self):
+        inst, s = _mk("wsr")
+        s.execute("CREATE TABLE t (a BIGINT)")
+        inst.store("wsr", "t").insert_pylists(
+            {"a": list(range(100))}, inst.tso.next_timestamp())
+        for _ in range(3):
+            s.execute("SELECT a FROM t WHERE a < 10")
+        rows = _summary_rows(s, "SELECT a FROM t")
+        assert sum(r[9] for r in rows) == 30  # rows_returned aggregated
+        assert all(r[10] >= 0 for r in rows)  # rows_examined estimate
+        s.close()
+
+
+# -- slow log linkage ----------------------------------------------------------
+
+
+@pytest.mark.summary
+class TestSlowLogDigest:
+    def test_slow_entry_carries_summary_digest(self):
+        inst, s = _mk("wsl")
+        s.execute("CREATE TABLE t (a BIGINT)")
+        s.execute("INSERT INTO t VALUES (1)")
+        SLOW_LOG.clear()
+        s.vars["SLOW_SQL_MS"] = 0  # log every query
+        s.execute("SELECT a FROM t WHERE a = 1")
+        slow = s.execute("SHOW SLOW")
+        assert slow.names[-1] == "Digest"
+        srow = [r for r in slow.rows if "SELECT a FROM t" in r[2]][-1]
+        digest = srow[-1]
+        assert digest
+        # the digest jumps straight to the summary row
+        assert any(r[0] == digest for r in _summary_rows(s))
+        s.close()
+
+
+# -- event journal -------------------------------------------------------------
+
+
+@pytest.mark.summary
+class TestEventJournal:
+    def test_ddl_events_published(self):
+        EVENTS.clear()
+        inst, s = _mk("wev")
+        s.execute("CREATE TABLE t (a BIGINT)")
+        s.execute("DROP TABLE t")
+        rs = s.execute("SHOW EVENTS")
+        kinds = [r[2] for r in rs.rows]
+        assert "ddl" in kinds
+        details = [r[5] for r in rs.rows if r[2] == "ddl"]
+        assert any("CREATE TABLE wev.t" in d for d in details)
+        assert any("DROP TABLE wev.t" in d for d in details)
+        # newest first, seq monotonic
+        seqs = [r[0] for r in rs.rows]
+        assert seqs == sorted(seqs, reverse=True)
+        # attrs are valid JSON
+        for r in rs.rows:
+            json.loads(r[6])
+        s.close()
+
+    def test_event_counters_in_prometheus(self):
+        EVENTS.clear()
+        inst, s = _mk("wpr")
+        s.execute("CREATE TABLE t (a BIGINT)")
+        from galaxysql_tpu.server.web import WebConsole
+        text = WebConsole(inst).metrics_text()
+        assert 'galaxysql_events_total{kind="ddl"}' in text
+        s.close()
+
+
+# -- Prometheus top-K + /statements -------------------------------------------
+
+
+@pytest.mark.summary
+class TestStatementSurfaces:
+    def test_prom_topk_bounded_cardinality(self):
+        inst, s = _mk("wpk")
+        s.execute("CREATE TABLE t (a BIGINT)")
+        inst.store("wpk", "t").insert_pylists(
+            {"a": list(range(50))}, inst.tso.next_timestamp())
+        for i in range(8):  # 8 distinct digests (structure, not literals)
+            cols = ", ".join(["a"] * (i + 1))
+            for _ in range(2):
+                s.execute(f"SELECT {cols} FROM t WHERE a < 10")
+        s.execute("SET GLOBAL STMT_SUMMARY_PROM_TOPK = 3")
+        from galaxysql_tpu.server.web import WebConsole
+        text = WebConsole(inst).metrics_text()
+        labeled = {ln.split('digest="')[1].split('"')[0]
+                   for ln in text.splitlines()
+                   if "stmt_latency_ms{" in ln}
+        assert 0 < len(labeled) <= 3  # top-K only: bounded label cardinality
+        s.execute("SET GLOBAL STMT_SUMMARY_PROM_TOPK = 0")  # labels OFF
+        text = WebConsole(inst).metrics_text()
+        assert "stmt_latency_ms{" not in text
+        s.close()
+
+    def test_statements_json_resource(self):
+        inst, s = _mk("wjs")
+        s.execute("CREATE TABLE t (a BIGINT)")
+        s.execute("INSERT INTO t VALUES (1)")
+        s.execute("SELECT a FROM t WHERE a = 1")
+        from galaxysql_tpu.server.web import WebConsole
+        body = WebConsole(inst).resource("/statements")
+        assert body and body["statements"]
+        json.dumps(body, default=str)  # serializable
+        top = body["top"]
+        assert top and {"digest", "execs", "p50_ms"} <= set(top[0])
+        assert any(st["digest"] == top[0]["digest"]
+                   for st in body["statements"])
+        s.close()
+
+
+# -- hatches + equivalence -----------------------------------------------------
+
+
+@pytest.mark.summary
+class TestHatches:
+    def test_param_off_stops_recording_and_results_identical(self):
+        inst, s = _mk("wha")
+        s.execute("CREATE TABLE t (a BIGINT, b BIGINT)")
+        inst.store("wha", "t").insert_pylists(
+            {"a": list(range(300)), "b": list(range(300))},
+            inst.tso.next_timestamp())
+        q = "SELECT a, b * 2 FROM t WHERE a < 100 ORDER BY a"
+        on = s.execute(q)
+        n0 = sum(r[4] for r in _summary_rows(s))
+        s.execute("SET ENABLE_STATEMENT_SUMMARY = 0")
+        off = s.execute(q)
+        assert off.rows == on.rows  # bit-identical with the layer off
+        assert sum(r[4] for r in _summary_rows(s)) == n0  # nothing recorded
+        s.execute("SET ENABLE_STATEMENT_SUMMARY = 1")
+        s.execute(q)
+        assert sum(r[4] for r in _summary_rows(s)) == n0 + 1
+        s.close()
+
+    def test_env_kill_switch_gates_store(self, monkeypatch):
+        inst, s = _mk("whe")
+        s.execute("CREATE TABLE t (a BIGINT)")
+        monkeypatch.setattr(ssm, "ENABLED", False)
+        s.execute("SELECT a FROM t WHERE a = 1")
+        assert not _summary_rows(s, "SELECT a FROM t")
+        monkeypatch.setattr(ssm, "ENABLED", True)
+        s.execute("SELECT a FROM t WHERE a = 1")
+        assert _summary_rows(s, "SELECT a FROM t")
+        s.close()
+
+
+# -- concurrency: race-free aggregation ---------------------------------------
+
+
+@pytest.mark.summary
+class TestConcurrentAggregation:
+    def test_multi_session_counts_exact_and_results_identical(self):
+        inst, s = _mk("wcc")
+        s.execute("CREATE TABLE t (a BIGINT PRIMARY KEY, b BIGINT)")
+        inst.store("wcc", "t").insert_pylists(
+            {"a": list(range(64)), "b": [i * 3 for i in range(64)]},
+            inst.tso.next_timestamp())
+        expect = s.execute("SELECT b FROM t WHERE a = 7").rows
+        N_THREADS, N_QUERIES = 8, 25
+        errs = []
+
+        def worker(tid):
+            sess = Session(inst, "wcc")
+            try:
+                for i in range(N_QUERIES):
+                    key = (tid * N_QUERIES + i) % 64
+                    r = sess.execute(f"SELECT b FROM t WHERE a = {key}")
+                    if key == 7 and r.rows != expect:
+                        errs.append((tid, i, r.rows))
+            except Exception as e:  # pragma: no cover - surfaced below
+                errs.append(e)
+            finally:
+                sess.close()
+
+        before = sum(r[4] for r in _summary_rows(s, "SELECT b FROM t"))
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(N_THREADS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        rows = _summary_rows(s, "SELECT b FROM t")
+        assert len({r[0] for r in rows}) == 1
+        total = sum(r[4] for r in rows) - before
+        assert total == N_THREADS * N_QUERIES  # no lost updates
+        s.close()
+
+
+# -- hot-path guard: summary on costs zero extra dispatches/syncs -------------
+
+
+@pytest.mark.summary
+class TestHotPathGuard:
+    def test_dispatch_count_unchanged_with_summary_on(self):
+        """The PR-1/PR-2 dispatch invariant survives the summary layer: the
+        same query pays the same device dispatches with the layer on vs
+        ENABLE_STATEMENT_SUMMARY=0 (zero extra device work, zero syncs —
+        summary updates are host-side adds)."""
+        inst, s = _mk("whp")
+        s.execute("CREATE TABLE t (a BIGINT, b BIGINT)")
+        inst.store("whp", "t").insert_pylists(
+            {"a": list(range(3000)), "b": list(range(3000))},
+            inst.tso.next_timestamp())
+        q = "SELECT a, b * 3 FROM t WHERE a < 1500"
+        s.execute(q)  # warmup: compile
+        from galaxysql_tpu.exec.device_cache import TRANSFER_STATS
+        ops.reset_dispatch_stats()
+        x0 = TRANSFER_STATS["transfers"]
+        on = s.execute(q)  # summary ON (default)
+        d_on = ops.DISPATCH_STATS["dispatches"]
+        x_on = TRANSFER_STATS["transfers"] - x0
+        s.execute("SET ENABLE_STATEMENT_SUMMARY = 0")
+        ops.reset_dispatch_stats()
+        x0 = TRANSFER_STATS["transfers"]
+        off = s.execute(q)
+        assert ops.DISPATCH_STATS["dispatches"] == d_on
+        assert TRANSFER_STATS["transfers"] - x0 == x_on
+        assert on.rows == off.rows
+        s.close()
+
+
+# -- the plan-regression sentinel ----------------------------------------------
+
+
+@pytest.mark.summary
+class TestPlanRegressionSentinel:
+    def test_stats_flip_regression_flagged_end_to_end(self):
+        """Acceptance scenario: a stats change flips the join order for a
+        known digest AND genuinely degrades latency (join-multiplicity
+        explosion).  The sentinel must flag it: typed event in SHOW EVENTS,
+        `plan_regressions` counter bumped, SPM PlanRecord annotated, summary
+        row marked regressed — with a NEW plan fingerprint distinct from the
+        baseline's."""
+        EVENTS.clear()
+        inst, s = _mk("wrg")
+        s.execute("CREATE TABLE big (id BIGINT PRIMARY KEY, k BIGINT, "
+                  "v BIGINT) PARTITION BY HASH(id) PARTITIONS 4")
+        s.execute("CREATE TABLE small (sid BIGINT PRIMARY KEY, k BIGINT, "
+                  "w BIGINT) PARTITION BY HASH(sid) PARTITIONS 4")
+        ts = inst.tso.next_timestamp
+        n = 5000
+        inst.store("wrg", "big").insert_arrays(
+            {"id": np.arange(n), "k": np.arange(n) % 100,
+             "v": np.arange(n)}, ts())
+        inst.store("wrg", "small").insert_arrays(
+            {"sid": np.arange(100), "k": np.arange(100),
+             "w": np.arange(100)}, ts())
+        s.execute("ANALYZE TABLE big, small")
+        # FRAGMENT_CACHE(OFF) keeps each run an honest execution (cached
+        # replay would hide the degradation); the hint is part of the text,
+        # so both phases share one digest, and it is not a plan-pinning hint
+        # — the SPM baseline still captures
+        q = ("/*+TDDL: FRAGMENT_CACHE(OFF)*/ SELECT count(*), "
+             "sum(big.v + small.w) FROM big, small WHERE big.k = small.k")
+        for _ in range(6):
+            s.execute(q)
+        base_rows = _summary_rows(s, "sum(big.v")
+        base_fps = {r[2] for r in base_rows}
+        assert len(base_fps) == 1
+        # DDL/DAL invalidates the pinned baseline, then the stats change
+        # (hot duplicate keys: every probe row now matches ~500 build rows)
+        # flips the greedy join order at replan
+        bid = s.execute("SHOW BASELINE").rows[0][0]
+        s.execute(f"BASELINE DELETE {bid}")
+        m = 50000
+        inst.store("wrg", "small").insert_arrays(
+            {"sid": np.arange(100, 100 + m), "k": np.arange(m) % 100,
+             "w": np.zeros(m, np.int64)}, ts())
+        inst.catalog.table("wrg", "small").bump_version()
+        inst.catalog.version += 1
+        s.execute("ANALYZE TABLE big, small")
+        for _ in range(6):
+            s.execute(q)
+        rows = _summary_rows(s, "sum(big.v")
+        fps = {r[2] for r in rows}
+        assert len(fps) == 2, f"expected a new plan fingerprint, got {fps}"
+        new_fp = (fps - base_fps).pop()
+        flagged = [r for r in rows if r[2] == new_fp]
+        assert flagged and flagged[0][17] == 1  # Regressed column
+        # typed event
+        evs = [r for r in s.execute("SHOW EVENTS").rows
+               if r[2] == "plan_regression"]
+        assert evs
+        attrs = json.loads(evs[0][6])
+        assert attrs["plan"] == new_fp and attrs["reason"] == "new_plan"
+        # counter
+        assert inst.metrics.counter("plan_regressions").value == 1
+        # SPM record annotated
+        brow = s.execute("SHOW BASELINE").rows[0]
+        assert brow[8] == 1 and new_fp in brow[9]
+        # information_schema twin carries the flag too
+        r = s.execute("SELECT plan_fingerprint FROM "
+                      "information_schema.statement_summary "
+                      "WHERE regressed = 1")
+        assert (new_fp,) in r.rows
+        s.close()
+
+    def test_recovered_window_rearms_and_default_path_guarded(self):
+        """A window back under the threshold clears the flag (no flapping
+        spam: one event per regression episode), and the uniform default
+        path keeps its dispatch count with the sentinel armed."""
+        inst, s = _mk("wrr")
+        s.execute("CREATE TABLE t (a BIGINT, b BIGINT)")
+        inst.store("wrr", "t").insert_pylists(
+            {"a": list(range(2000)), "b": list(range(2000))},
+            inst.tso.next_timestamp())
+        ss = inst.stmt_summary
+        # drive the store directly with synthetic latencies and a PINNED
+        # clock (one bucket, fully deterministic sentinel stream)
+        t = 1000.0
+
+        def rec(fp, v):
+            ss.record("wrr", "Q1", "Q1", fp, "", "AP", "local", v, 1, now=t)
+
+        for v in (10.0,) * 5:  # baseline forms at median 10ms
+            rec("p1", v)
+        for v in (40.0,) * 5:  # regressed window
+            rec("p2", v)
+        assert inst.metrics.counter("plan_regressions").value == 1
+        for v in (40.0,) * 3:  # still regressed: same episode, no re-fire
+            rec("p2", v)
+        assert inst.metrics.counter("plan_regressions").value == 1
+        # flood the window with fast runs until the median recovers
+        for v in (9.0,) * 20:
+            rec("p2", v)
+        agg = ss._entries[("wrr", "Q1")].plans["p2"]
+        assert not agg.flagged  # re-armed
+        for v in (50.0,) * 40:  # regresses again -> second event
+            rec("p2", v)
+        assert inst.metrics.counter("plan_regressions").value == 2
+        # default-path dispatch guard with the sentinel armed
+        q = "SELECT a, b + 1 FROM t WHERE a < 1000"
+        s.execute(q)  # warmup
+        ops.reset_dispatch_stats()
+        s.execute(q)
+        base = ops.DISPATCH_STATS["dispatches"]
+        ops.reset_dispatch_stats()
+        s.execute(q)
+        assert ops.DISPATCH_STATS["dispatches"] == base
+        s.close()
+
+
+# -- parser --------------------------------------------------------------------
+
+
+@pytest.mark.summary
+class TestShowParsing:
+    def test_show_statement_summary_forms(self):
+        from galaxysql_tpu.sql.parser import parse
+        st = parse("SHOW STATEMENT SUMMARY")
+        assert st.kind == "statement_summary" and st.target is None
+        st = parse("SHOW STATEMENT SUMMARY HISTORY")
+        assert st.kind == "statement_summary" and st.target == "history"
+        st = parse("SHOW EVENTS")
+        assert st.kind == "events"
