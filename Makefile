@@ -82,5 +82,15 @@ bench-skew:
 summary-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m summary -p no:cacheprovider
 
+# self-heal smoke: the quarantine state machine end-to-end — a genuine
+# stats-driven join-order regression auto-rolls-back, verifies over
+# PLAN_HEAL_VERIFY_EXECS executions, and promotes (bit-identical results,
+# one plan_rollback + one plan_promoted per episode); plus stats-drift
+# repair, flap damping / HEAL_FAILED park + ANALYZE re-arm, probation
+# resuming across a coordinator restart, the ENABLE_PLAN_AUTOHEAL /
+# GALAXYSQL_PLAN_AUTOHEAL=0 detect-only hatches, and the surfaces parity
+heal-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m selfheal -p no:cacheprovider
+
 .PHONY: tier1 fusion-smoke obs-smoke rf-smoke cache-smoke trace-smoke bench \
-	batch-smoke chaos-smoke skew-smoke bench-skew summary-smoke
+	batch-smoke chaos-smoke skew-smoke bench-skew summary-smoke heal-smoke

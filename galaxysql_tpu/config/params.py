@@ -141,6 +141,26 @@ PLAN_REGRESSION_MIN_EXECS = _p(
     "successful executions needed to freeze a digest's latency baseline "
     "(median of them), and per window before the sentinel will judge it")
 
+# --- self-healing plan management (plan/spm.py quarantine machine) -------------
+ENABLE_PLAN_AUTOHEAL = _p(
+    "ENABLE_PLAN_AUTOHEAL", True,
+    "act on sentinel-flagged plan regressions: quarantine the digest, roll "
+    "back to the frozen baseline plan (or repair drifted statistics), "
+    "verify over PLAN_HEAL_VERIFY_EXECS executions, then promote / evolve / "
+    "park; off = PR-9 detect-only behavior (annotate, never act)")
+PLAN_HEAL_VERIFY_EXECS = _p(
+    "PLAN_HEAL_VERIFY_EXECS", 5,
+    "probation length: executions whose median is judged against the frozen "
+    "latency baseline before a heal episode promotes or fails")
+PLAN_HEAL_MAX_ROLLBACKS = _p(
+    "PLAN_HEAL_MAX_ROLLBACKS", 3,
+    "flap damping: heal episodes a digest may burn before it parks in "
+    "HEAL_FAILED (breaker-style; ANALYZE/DDL re-arms with a fresh budget)")
+PLAN_HEAL_COOLDOWN_S = _p(
+    "PLAN_HEAL_COOLDOWN_S", 300,
+    "flap damping: minimum seconds between heal episodes of one digest; "
+    "regressions inside the window stay detect-only")
+
 # --- misc ---------------------------------------------------------------------
 SQL_SELECT_LIMIT = _p("SQL_SELECT_LIMIT", -1, "-1 = unlimited")
 SLOW_SQL_MS = _p("SLOW_SQL_MS", 1000, "slow query log threshold")

@@ -165,7 +165,12 @@ def fingerprint(node, ctx) -> Optional[FragKey]:
             # a scan in here is masked by a filter built from a table OUTSIDE
             # the subtree — the fingerprint cannot see that table's version
             raise _Uncacheable
-        fk = FragKey(("frag", key), frozenset(tables))
+        # self-heal pin: executions under a live quarantine episode get their
+        # own keyspace — rolled-back (probation) artifacts and regressed-plan
+        # artifacts must never cross, and probation timings stay honest
+        pin = getattr(ctx, "plan_pin", "")
+        fk = FragKey(("frag", pin, key) if pin else ("frag", key),
+                     frozenset(tables))
         hash(fk.key)  # unhashable literal (list param etc.): bypass
         return fk
     except (_Uncacheable, TypeError):

@@ -173,10 +173,16 @@ class Catalog:
         # must not invalidate plan baselines (PlanManager invalidates on schema
         # change only; `version` also moves on data changes for scan caches).
         self.schema_version = 0
+        # statistics epoch: bumped by ANALYZE, DDL, and heal-loop stats
+        # repair — but NOT by DML (`version` moves on every commit).  The
+        # HEAL_FAILED park re-arm keys on this: "re-arm only on ANALYZE/DDL"
+        # must not be defeated by an unrelated INSERT.
+        self.stats_version = 0
 
     def bump_schema(self):
         self.version += 1
         self.schema_version += 1
+        self.stats_version += 1
 
     def create_schema(self, name: str, if_not_exists: bool = False) -> SchemaMeta:
         key = name.lower()

@@ -23,8 +23,31 @@ Two consumers ride the store:
 - the Prometheus top-K exporter (server/web.py): per-digest latency
   summaries with a bounded-cardinality `digest` label.
 
+Round 10 closes the loop the sentinel opened — the store now ACTS on what it
+sees (self-healing plan management, ROADMAP item 1a/1b):
+
+- a regression under a **new plan fingerprint** opens a quarantine episode on
+  the SPM baseline (`PlanManager.begin_quarantine`): the digest's plan-cache
+  entry is retired, the next bind re-plans pinned to the frozen known-good
+  join orders (rollback), and the next `PLAN_HEAL_VERIFY_EXECS` executions
+  are judged against the frozen latency baseline — promote (HEALED) or, when
+  the old plan is slow now too, keep the new plan and re-freeze the baseline
+  on it (EVOLVED);
+- a regression under the **same fingerprint** (pure stats drift — no
+  alternative plan) triggers a targeted statistics repair
+  (`meta/statistics.repair_table_stats`: live store row counts + observed
+  scan cardinalities from profiled QueryProfile rings correct the drifted
+  row counts/NDVs/histograms), then re-enters verification unpinned so the
+  corrected stats can pick a better order; still slow => HEAL_FAILED, parked
+  until ANALYZE/DDL re-arms it;
+- flap damping is breaker-style (per-digest cooldown + max episodes) and the
+  whole state machine persists in the metadb, so a coordinator restart
+  resumes probation rather than re-thrashing.
+
 Escape hatches: `ENABLE_STATEMENT_SUMMARY` param (SET-able) and the
-`GALAXYSQL_STMT_SUMMARY=0` environment kill switch."""
+`GALAXYSQL_STMT_SUMMARY=0` environment kill switch; the heal loop has its own
+pair — `ENABLE_PLAN_AUTOHEAL` and `GALAXYSQL_PLAN_AUTOHEAL=0` — which restore
+the detect-only (annotate, never act) behavior."""
 
 from __future__ import annotations
 
@@ -41,6 +64,10 @@ from galaxysql_tpu.utils.metrics import Histogram
 # kill switch: GALAXYSQL_STMT_SUMMARY=0 disables recording entirely (surfaces
 # stay queryable, just empty) — read once at import like the other hatches
 ENABLED = os.environ.get("GALAXYSQL_STMT_SUMMARY", "1") != "0"
+
+# kill switch for the self-heal loop only: detection/annotation stays live,
+# the engine just never acts (the PR-9 detect-only behavior)
+AUTOHEAL_ENABLED = os.environ.get("GALAXYSQL_PLAN_AUTOHEAL", "1") != "0"
 
 
 # -- digests -------------------------------------------------------------------
@@ -63,6 +90,22 @@ def digest_key(schema: str, ptext: str) -> str:
         _DIGEST_CACHE.clear()
     _DIGEST_CACHE[k] = d
     return d
+
+
+def encode_orders(join_orders) -> str:
+    """Join-order text carried per _PlanAgg: forests joined by ';', labels
+    within a forest by '>'.  `parse_orders` is the exact inverse.  Labels
+    are lowercased dotted identifiers ('schema.table') or 'rel:'-prefixed
+    field-id digests (','-separated) — neither contains the separators, the
+    invariant both helpers rely on."""
+    return ";".join(">".join(o) for o in (join_orders or []))
+
+
+def parse_orders(orders: str):
+    """Inverse of encode_orders: [(label, ...)] per forest, or None."""
+    if not orders:
+        return None
+    return [tuple(seg.split(">")) for seg in orders.split(";") if seg]
 
 
 def plan_fingerprint(plan) -> str:
@@ -147,8 +190,8 @@ class _PlanAgg:
 
     __slots__ = ("fp", "orders", "engines", "workloads", "first_seen",
                  "last_seen", "execs", "errors", "total_ms", "latency",
-                 "buckets", "flagged", "rows_returned", "rows_examined",
-                 "peak_rss_kb", "extras")
+                 "buckets", "flagged", "flagged_at", "rows_returned",
+                 "rows_examined", "peak_rss_kb", "extras")
 
     def __init__(self, fp: str, orders: str, history: int):
         self.fp = fp
@@ -163,6 +206,7 @@ class _PlanAgg:
         self.latency = Histogram(f"stmt_{fp}", reservoir=256)
         self.buckets: collections.deque = collections.deque(maxlen=history)
         self.flagged = False          # sentinel: currently regressed
+        self.flagged_at = 0.0         # when the current episode was flagged
         # lifetime totals (the summary row): buckets roll off the bounded
         # history deque, so summing them would silently undercount
         self.rows_returned = 0
@@ -219,6 +263,15 @@ class StatementSummaryStore:
             "digests whose windowed latency regressed vs their plan baseline")
         self.recorded = instance.metrics.counter(
             "stmt_summary_recorded", "queries aggregated into the summary")
+        # self-heal loop outcome counters (Prometheus + SHOW METRICS)
+        self.heals = instance.metrics.counter(
+            "plan_heals",
+            "heal episodes that promoted a verified plan (rollback healed "
+            "or new plan evolved)")
+        self.heal_failures = instance.metrics.counter(
+            "plan_heal_failures",
+            "heal episodes parked in HEAL_FAILED (verification missed the "
+            "baseline, flap damping, or an internal heal error)")
 
     # -- config (read per call: SET-able hatches must apply live) ----------
 
@@ -299,7 +352,7 @@ class StatementSummaryStore:
                         bx[k] += v
                         ax[k] += v
             self.recorded.inc()
-            flagged = self._sentinel(e, agg, b, elapsed_ms) \
+            flagged = self._sentinel(e, agg, b, elapsed_ms, now) \
                 if not error else None
         if flagged is not None:
             # event publish + SPM annotation (a metadb write) happen OUTSIDE
@@ -310,7 +363,7 @@ class StatementSummaryStore:
     # -- plan-regression sentinel -------------------------------------------
 
     def _sentinel(self, e: _Entry, agg: _PlanAgg, b: _Bucket,
-                  elapsed_ms: float) -> Optional[float]:
+                  elapsed_ms: float, now: float) -> Optional[float]:
         """Judge this window under the store lock; returns the regressed
         window median when a NEW regression episode just started (the caller
         publishes after releasing the lock), else None."""
@@ -335,7 +388,19 @@ class StatementSummaryStore:
         if cur > factor * e.baseline_ms:
             if not agg.flagged:
                 agg.flagged = True
+                agg.flagged_at = now
                 return cur  # new episode: caller publishes outside the lock
+            # SUSTAINED regression: the latched flag would otherwise pin a
+            # continuously slow digest in detect-only forever once one heal
+            # attempt was swallowed by the episode cooldown — re-fire once
+            # per cooldown period so the heal loop gets its retry (and the
+            # journal gets a still-regressed heartbeat).  Detect-only mode
+            # keeps the PR-9 one-event-per-episode semantics.
+            if self.autoheal_on():
+                cooldown = float(self._cfg("PLAN_HEAL_COOLDOWN_S", 300))
+                if now - agg.flagged_at >= cooldown > 0:
+                    agg.flagged_at = now
+                    return cur
         else:
             agg.flagged = False  # window recovered: re-arm the sentinel
         return None
@@ -360,6 +425,221 @@ class StatementSummaryStore:
             (e.schema, e.ptext),
             f"{reason}: plan {agg.fp} {cur_ms:.1f}ms vs baseline "
             f"{e.baseline_fp} {e.baseline_ms:.1f}ms")
+        # act on it: the self-heal loop (quarantine + rollback/stats repair).
+        # A heal bug must never fail the user query riding this exit ramp.
+        if self.autoheal_on():
+            try:
+                self._autoheal(e, agg, cur_ms, reason)
+            except Exception as exc:  # pragma: no cover - defensive
+                self.heal_failures.inc()
+                events.publish(
+                    "plan_heal_failed",
+                    f"digest {e.digest}: heal loop error {exc!r}",
+                    node=inst.node_id, digest=e.digest,
+                    reason="internal_error")
+
+    # -- self-heal loop ------------------------------------------------------
+
+    def autoheal_on(self, session_vars: Optional[dict] = None) -> bool:
+        return AUTOHEAL_ENABLED and bool(self.instance.config.get(
+            "ENABLE_PLAN_AUTOHEAL", session_vars))
+
+    _parse_orders = staticmethod(parse_orders)
+
+    def _autoheal(self, e: _Entry, agg: _PlanAgg, cur_ms: float, reason: str):
+        """Open a quarantine episode for a freshly flagged digest: rollback
+        for a new-plan regression, targeted stats repair for same-plan drift.
+        Runs outside the store lock (metadb writes + ANALYZE-grade work)."""
+        inst = self.instance
+        key = (e.schema, e.ptext)
+        rollback_orders = None
+        if reason == "new_plan":
+            base_agg = e.plans.get(e.baseline_fp)
+            if base_agg is not None:
+                rollback_orders = self._parse_orders(base_agg.orders)
+        mode = "rollback" if rollback_orders else "repair"
+        if mode == "repair" and not self._parse_orders(agg.orders):
+            return  # joinless/point digests have no plan decision to heal
+        action = inst.planner.spm.begin_quarantine(
+            key, mode, reason, rollback_orders,
+            baseline_ms=e.baseline_ms,
+            factor=float(self._cfg("PLAN_REGRESSION_FACTOR", 1.5)),
+            verify_execs=int(self._cfg("PLAN_HEAL_VERIFY_EXECS", 5)),
+            max_rollbacks=int(self._cfg("PLAN_HEAL_MAX_ROLLBACKS", 3)),
+            cooldown_s=float(self._cfg("PLAN_HEAL_COOLDOWN_S", 300)),
+            stats_version=inst.catalog.stats_version,
+            regressed_ms=cur_ms)
+        if action is None:
+            return  # no baseline / episode live / parked / cooling down
+        from galaxysql_tpu.utils import events
+        if action["action"] == "damped":
+            self.heal_failures.inc()
+            events.publish(
+                "plan_heal_failed",
+                f"digest {e.digest}: flap damping cap hit after "
+                f"{action['rollbacks']} episodes; parked until ANALYZE/DDL",
+                node=inst.node_id, digest=e.digest, schema=e.schema,
+                reason="flap_damped", baseline_id=action["baseline_id"],
+                rollbacks=action["rollbacks"])
+            return
+        if action["action"] == "repair":
+            # repair FIRST, then arm the (inert) episode, then retire the
+            # cached plan: a concurrent bind racing the repair keeps the
+            # pinned plan instead of anchoring probation on drifted stats
+            try:
+                self._repair_stats(e, agg, action)
+            except Exception:
+                # an unarmed episode nothing will ever arm is a permanent
+                # wedge — abort it (un-parked: the sentinel may retry after
+                # the cooldown) and let _flag's handler publish the error
+                inst.planner.spm.abort_heal(key, "stats repair failed")
+                raise
+            inst.planner.spm.arm_heal(key)
+            inst.planner.cache.invalidate(key)
+            return
+        # retire the regressed cached plan: the next bind enters probation
+        inst.planner.cache.invalidate(key)
+        events.publish(
+            "plan_rollback",
+            f"digest {e.digest}: rolled back to baseline plan "
+            f"{e.baseline_fp} for verification ({cur_ms:.1f}ms vs "
+            f"{e.baseline_ms:.1f}ms)",
+            node=inst.node_id, digest=e.digest, schema=e.schema,
+            reason=reason, plan=agg.fp, baseline_plan=e.baseline_fp,
+            baseline_id=action["baseline_id"], rollbacks=action["rollbacks"],
+            window_ms=round(cur_ms, 2), baseline_ms=round(e.baseline_ms, 2))
+
+    def _observed_scan_floor(self, e: _Entry) -> int:
+        """Largest materialized Scan cardinality any PROFILED run of this
+        digest left in the QueryProfile ring — runtime evidence of drift the
+        store row count may not yet reflect (0 when nothing was profiled)."""
+        floor = 0
+        profiles = getattr(self.instance, "profiles", None)
+        if profiles is None:
+            return 0
+        from galaxysql_tpu.sql.parameterize import parameterize
+        for p in profiles.entries():
+            if not p.op_stats or not p.sql or p.sql.startswith("<"):
+                continue
+            try:
+                if digest_key((p.schema or "").lower(),
+                              parameterize(p.sql).parameterized) != e.digest:
+                    continue
+            except Exception:
+                continue
+            for st in p.op_stats:
+                if st.get("operator") == "Scan":
+                    floor = max(floor, int(st.get("rows_out", 0)))
+        return floor
+
+    def _repair_stats(self, e: _Entry, agg: _PlanAgg, action: dict):
+        """Same-plan drift: correct the drifted statistics of the digest's
+        tables from runtime truth, then let probation re-plan unpinned.
+
+        Deliberately SYNCHRONOUS on the flagging query's exit ramp: the very
+        next bind of this digest must see the corrected stats, or probation
+        would verify the same broken plan.  The cost is bounded in practice —
+        at most one episode per digest per cooldown window, only the tables
+        whose sketch/live row gap exceeds STATS_DRIFT_TOLERANCE are rebuilt,
+        and the flagging query was already regressed.  Continuous BACKGROUND
+        repair (decoupled from heal episodes) is the roadmap follow-up."""
+        from galaxysql_tpu.meta.statistics import repair_table_stats
+        from galaxysql_tpu.utils import events
+        inst = self.instance
+        labels = [lab for forest in (self._parse_orders(agg.orders) or [])
+                  for lab in forest if "." in lab and
+                  not lab.startswith("rel:")]
+        floor = self._observed_scan_floor(e)
+        targets = []
+        for lab in dict.fromkeys(labels):  # de-dup, keep order
+            schema, _, table = lab.partition(".")
+            try:
+                targets.append((inst.catalog.table(schema, table),
+                                inst.store(schema, table)))
+            except Exception:
+                continue  # dropped since the plan ran
+        # the observed scan floor corroborates the LARGEST table (a scan
+        # never returns more rows than its table holds)
+        biggest = max(targets, key=lambda t: t[1].row_count(), default=None)
+        repaired = []
+        for tm, store in targets:
+            delta = repair_table_stats(
+                tm, store,
+                observed_rows=floor if biggest is not None and
+                tm is biggest[0] else None)
+            if delta is not None:
+                repaired.append(delta)
+        if repaired:
+            # corrected stats must reach every cached plan, exactly like
+            # ANALYZE (catalog.version keys the plan cache; stats_version
+            # re-arms HEAL_FAILED-parked digests over the repaired tables)
+            inst.catalog.version += 1
+            inst.catalog.stats_version += 1
+        events.publish(
+            "stats_repair",
+            f"digest {e.digest}: repaired {len(repaired)} drifted table(s) "
+            + (", ".join(f"{d['table']} sketched "
+                         f"{d['analyzed_rows_before']}->"
+                         f"{d['analyzed_rows_after']}" for d in repaired)
+               if repaired else "(no drift found; re-verifying)"),
+            node=inst.node_id, digest=e.digest, schema=e.schema,
+            plan=agg.fp, baseline_id=action["baseline_id"],
+            observed_scan_rows=floor, repaired=repaired)
+
+    def apply_heal_verdict(self, verdict: dict):
+        """Close out a probation episode judged by
+        PlanManager.record_execution: publish the typed outcome event, bump
+        the heal counters, retire the probation-pinned cached plan, and (for
+        EVOLVED) re-freeze the digest's latency baseline on the new plan."""
+        from galaxysql_tpu.utils import events
+        inst = self.instance
+        key = tuple(verdict["key"])
+        dg = digest_key(key[0], key[1])
+        inst.planner.cache.invalidate(key)
+        kind = verdict["kind"]
+        detail = (f"digest {dg}: probation median {verdict['median_ms']}ms "
+                  f"vs baseline {verdict['baseline_ms']}ms "
+                  f"(x{verdict['factor']})")
+        if kind in ("promoted", "evolved"):
+            self.heals.inc()
+            events.publish(
+                "plan_promoted",
+                f"{detail} — " + ("rollback promoted (HEALED)"
+                                  if kind == "promoted" else
+                                  "new plan kept as evolved baseline "
+                                  "(EVOLVED)"),
+                node=inst.node_id, digest=dg, schema=key[0], outcome=kind,
+                reason=verdict["reason"], mode=verdict["mode"],
+                baseline_id=verdict["baseline_id"],
+                median_ms=verdict["median_ms"],
+                baseline_ms=verdict["baseline_ms"])
+            self._reset_baseline(key, refreeze=verdict.get("refreeze", False))
+        else:
+            self.heal_failures.inc()
+            events.publish(
+                "plan_heal_failed",
+                f"{detail} — still regressed after "
+                f"{verdict['mode']}; parked until ANALYZE/DDL",
+                node=inst.node_id, digest=dg, schema=key[0],
+                reason=verdict["reason"], mode=verdict["mode"],
+                baseline_id=verdict["baseline_id"],
+                median_ms=verdict["median_ms"],
+                baseline_ms=verdict["baseline_ms"])
+
+    def _reset_baseline(self, key: Tuple[str, str], refreeze: bool):
+        """Clear the episode's sentinel flags; `refreeze` additionally drops
+        the frozen latency baseline so it re-forms on the (evolved) plan the
+        digest now runs — the new normal becomes the new yardstick."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return
+            for a in e.plans.values():
+                a.flagged = False
+            if refreeze:
+                e.baseline_fp = None
+                e.baseline_ms = None
+                e.baseline_samples = []
 
     # -- surfaces ------------------------------------------------------------
 

@@ -281,6 +281,55 @@ def analyze_store(tm, store, sample_cap: int = 262144):
             tm.stats.histograms[c.name] = Histogram.build(vals, ndv)
 
 
+# stats-drift repair tolerance: a table whose live row count is within this
+# factor of its ANALYZE-time row count is considered healthy (no repair)
+STATS_DRIFT_TOLERANCE = 1.5
+
+
+def analyzed_rows(tm) -> int:
+    """Rows the last ANALYZE folded into this table's sketches (0 = never
+    analyzed).  `stats.row_count` tracks inserts/deletes live, but the
+    NDV/histogram/heavy-hitter sketches only move on ANALYZE — the gap
+    between the two IS the statistics drift."""
+    return max((hh.total for hh in tm.stats.heavy.values()), default=0)
+
+
+def repair_table_stats(tm, store, observed_rows: Optional[int] = None,
+                       tolerance: float = STATS_DRIFT_TOLERANCE
+                       ) -> Optional[dict]:
+    """Targeted stats-drift repair, driven by runtime truth instead of a DBA.
+
+    The self-heal loop (plan/spm.py + meta/statement_summary.py) calls this
+    when a digest regresses under the SAME plan fingerprint — no alternative
+    plan exists, so the plan is innocent and the statistics that justified it
+    have drifted.  Evidence of drift: the live store row count (host-resident,
+    O(partitions)) and any observed operator cardinality from profiled
+    QueryProfile rings, compared against the row count the last ANALYZE
+    actually sketched (`analyzed_rows`).  Beyond `tolerance`, the table's
+    statistics are rebuilt in place (the same per-partition sketch fold
+    ANALYZE runs, scoped to just this table) so NDVs, histograms, and
+    heavy-hitter sets match reality again.
+
+    Returns a delta dict when a repair ran, None when stats were within
+    tolerance (the common case — repair must be idempotent-cheap)."""
+    seen = float(analyzed_rows(tm))
+    truth = float(store.row_count())
+    if observed_rows:
+        # a profiled scan that materialized more rows than the store reports
+        # (e.g. mid-ingest) is still evidence of drift
+        truth = max(truth, float(observed_rows))
+    if truth <= 0 and seen <= 0:
+        return None  # empty and never analyzed: nothing to repair
+    if seen > 0 and truth > 0 and \
+            (1.0 / tolerance) <= truth / seen <= tolerance:
+        return None
+    analyze_store(tm, store)
+    return {"table": f"{tm.schema}.{tm.name}",
+            "analyzed_rows_before": int(seen),
+            "analyzed_rows_after": int(analyzed_rows(tm)),
+            "observed_rows": int(observed_rows or 0)}
+
+
 # minimum live build rows before a runtime observation is worth folding in: a
 # tiny (or heavily filtered) build side says nothing about column skew
 RUNTIME_HH_MIN_ROWS = 4096

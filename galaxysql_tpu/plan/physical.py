@@ -80,6 +80,10 @@ class ExecContext:
         # checked at operator drain / fused-segment / MPP-stage boundaries and
         # propagated to workers as the remaining budget in RPC headers
         self.deadline: Optional[float] = None
+        # self-heal pin (plan/spm.py heal_pin): non-empty while the plan's
+        # digest has a live quarantine episode; salts fragment-cache
+        # fingerprints so probation and regressed artifacts never cross
+        self.plan_pin = ""
 
     def check_deadline(self):
         """Raise a typed QueryTimeoutError once the deadline passes.  Called

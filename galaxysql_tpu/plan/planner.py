@@ -40,6 +40,7 @@ class ExecutionPlan:
         self.spm_key = None          # set when planned through the cache path
         self.join_orders: List[Tuple[str, ...]] = []
         self.hints: Dict[str, object] = {}
+        self.heal_pin = ""           # fragment-cache salt while healing
 
     def fields(self) -> List[L.Field]:
         return self.rel.fields()
@@ -105,6 +106,12 @@ class PlanCache:
             self._map.move_to_end(key)
             while len(self._map) > self.capacity:
                 self._map.popitem(last=False)
+
+    def invalidate(self, key: Tuple[str, str]):
+        """Drop ONE digest's cached plan (the self-heal loop retires a
+        regressed or probation plan without a fleet-wide replan storm)."""
+        with self._lock:
+            self._map.pop(key, None)
 
     def invalidate_all(self):
         with self._lock:
@@ -186,6 +193,11 @@ class Planner:
         plan.spm_key = None if hinted else spm_key
         plan.join_orders = list(spm_ctx.chosen)
         plan.hints = hints
+        # self-heal salt: plans bound while this digest's heal episode is live
+        # carry a pin that re-keys fragment-cache fingerprints, so probation
+        # and regressed artifacts never cross (zero-episode path: one compare)
+        plan.heal_pin = self.spm.heal_pin(spm_key) \
+            if plan.spm_key is not None else ""
         return plan
 
 

@@ -389,7 +389,36 @@ def build_join_tree(node: L.RelNode, spm=None) -> L.RelNode:
             steps.append((set(ma), set(mb)))
             comps = [c for i, c in enumerate(comps) if i not in (x, y)]
             comps.append((est, ma | mb, la + lb))
-        return steps, tuple(comps[0][2])
+        # the reported label order is the MERGE order (first merged pair
+        # first, later-joined relations appended), NOT the lead-concat
+        # display order: an SPM baseline replays its order as a left-deep
+        # chain, and only the merge sequence makes that replay reproduce the
+        # join tree GOO actually built — concat order can turn a healthy
+        # bushy plan into an m:n-first blowup on replay.  (Plan fingerprints
+        # ARE order-sensitive within a forest, so persisted pre-merge-order
+        # baselines are dropped by the SPM kv-format version bump.)  Within
+        # a step, members connected by an edge to the already-placed prefix
+        # go first: a bushy-bushy merge flattened naively could put an
+        # edge-less member next and hand the replay a cross join the
+        # original never ran.
+        def _connected(i: int, group: Set[int]) -> bool:
+            return any((a == i and bb in group) or (bb == i and a in group)
+                       for a, bb, _ea, _eb in edges)
+
+        seq: List[str] = []
+        placed: Set[int] = set()
+        for ma, mb in steps:
+            fresh = sorted(ma - placed) + sorted(mb - placed)
+            while fresh:
+                nxt = next((i for i in fresh if placed and
+                            _connected(i, placed)), fresh[0])
+                seq.append(labels[nxt])
+                placed.add(nxt)
+                fresh.remove(nxt)
+        for i in range(len(relinfos)):
+            if i not in placed:  # defensive: unmerged singleton
+                seq.append(labels[i])
+        return steps, tuple(seq)
 
     if forced_seq is not None:
         # SPM baseline: replay the pinned order verbatim as a left-deep chain

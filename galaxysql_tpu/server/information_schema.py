@@ -92,6 +92,13 @@ _DEFS: Dict[str, List] = {
     # typed instance-event journal (utils/events.py; SHOW EVENTS twin)
     "events": [("seq", _I), ("at", _D), ("kind", _V), ("severity", _V),
                ("node", _V), ("detail", _V), ("attrs", _V)],
+    # SPM plan baselines incl. the self-heal quarantine machine
+    # (plan/spm.py; SHOW BASELINE twin)
+    "plan_baselines": [
+        ("baseline_id", _I), ("schema_name", _V), ("parameterized_sql", _V),
+        ("accepted_plan", _V), ("origin", _V), ("runs", _I), ("avg_ms", _D),
+        ("candidate_plan", _V), ("regressions", _I), ("last_regression", _V),
+        ("state", _V), ("rollbacks", _I), ("last_heal", _V)],
 }
 
 
@@ -217,3 +224,4 @@ def refresh(instance, session=None):
     fill("events", ([e.seq, round(e.at, 3), e.kind, e.severity, e.node,
                      e.detail, _json.dumps(e.attrs, default=str)[:512]]
                     for e in EVENTS.entries()))
+    fill("plan_baselines", (list(r) for r in instance.planner.spm.rows()))

@@ -9,6 +9,7 @@ persisted partitions, then resume interrupted DDL jobs (§3.5 crash recovery).
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import uuid
@@ -157,7 +158,6 @@ class Instance:
     def _reload_global_config(self, *_):
         """Pull persisted SET GLOBAL values from the shared metadb (fired by
         the config listener when a peer coordinator changes one)."""
-        import json
         for k, v in self.metadb.kv_scan("config.param."):
             try:
                 self.config.set_instance(k[len("config.param."):], json.loads(v))
@@ -176,6 +176,24 @@ class Instance:
                 d = os.path.join(self.data_dir, tm.schema.lower(), tm.name.lower())
                 if os.path.isdir(d):
                     store.load(d)
+        # restore the checkpointed catalog counters: replaying schema loads
+        # re-derives schema_version differently than the live history did,
+        # which would silently invalidate every persisted SPM baseline (and
+        # with them the self-heal quarantine state) on restart.  max() so the
+        # counters never run backwards past the replayed DDL.
+        v = self.metadb.kv_get("catalog.versions")
+        if v:
+            try:
+                parts = json.loads(v)
+                self.catalog.version = max(self.catalog.version,
+                                           int(parts[0]))
+                self.catalog.schema_version = max(self.catalog.schema_version,
+                                                  int(parts[1]))
+                if len(parts) > 2:  # added with the self-heal stats epoch
+                    self.catalog.stats_version = max(
+                        self.catalog.stats_version, int(parts[2]))
+            except Exception:
+                pass  # a corrupt counter record must not poison boot
         self.archive.attach(self.metadb)
         # resolve provisional ±txn_id MVCC stamps left by a crash against the
         # durable tx log BEFORE anything reads the loaded partitions
@@ -218,6 +236,11 @@ class Instance:
             store.save(os.path.join(self.data_dir, key.replace(".", os.sep)))
             self.metadb.save_table(store.table)
         self.metadb.kv_put("last_checkpoint_at", repr(t0))
+        # catalog counters ride the checkpoint so a restarted coordinator
+        # keeps its persisted SPM baselines + heal state valid (see boot())
+        self.metadb.kv_put("catalog.versions", json.dumps(
+            [self.catalog.version, self.catalog.schema_version,
+             self.catalog.stats_version]))
 
     def allocate_conn_id(self) -> int:
         with self.lock:

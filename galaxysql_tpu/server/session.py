@@ -556,8 +556,7 @@ class Session:
             fp, orders = "unknown", ""
         else:
             fp = _ss.plan_fingerprint(plan)
-            jo = getattr(plan, "join_orders", None) or []
-            orders = ";".join(">".join(o) for o in jo)
+            orders = _ss.encode_orders(getattr(plan, "join_orders", None))
         ss.record(prof.schema, p.parameterized, sql, fp, orders, workload,
                   engine, prof.elapsed_ms, rows,
                   rows_examined=int(getattr(plan, "scanned_rows", 0) or 0),
@@ -735,6 +734,10 @@ class Session:
         # instance scope)
         from galaxysql_tpu.exec import skew as _skew
         ctx.skew_modes = _skew.exec_modes(ctx.hints, self.instance, self.vars)
+        # self-heal pin: plans bound under a live quarantine episode salt the
+        # fragment-cache fingerprints so probation and regressed artifacts
+        # never cross ('' steady state)
+        ctx.plan_pin = getattr(plan, "heal_pin", "")
         # MAX_EXECUTION_TIME deadline: the hint form overrides the session
         # param for this statement (MySQL optimizer-hint semantics)
         hint_ms = getattr(plan, "hints", {}).get("max_execution_time")
@@ -1026,9 +1029,32 @@ class Session:
             self._register_point_plan(plan, batch)
         elapsed = time.time() - t0
         if getattr(plan, "spm_key", None) is not None:
-            self.instance.planner.spm.record_execution(
-                plan.spm_key, elapsed * 1000.0,
-                getattr(plan, "bound_params", None))
+            # during PROBATION this execution is a heal verification sample;
+            # a filled sample quota returns the episode's verdict (None on
+            # the steady-state path — one extra attribute compare).  Heal
+            # bookkeeping must never fail the user query riding this ramp:
+            # the result set is already computed.
+            try:
+                heal_verdict = self.instance.planner.spm.record_execution(
+                    plan.spm_key, elapsed * 1000.0,
+                    getattr(plan, "bound_params", None),
+                    orders=plan.join_orders,
+                    stats_version=self.instance.catalog.stats_version)
+                if heal_verdict is not None:
+                    self.instance.stmt_summary.apply_heal_verdict(
+                        heal_verdict)
+            except Exception as heal_exc:  # pragma: no cover - defensive
+                try:
+                    from galaxysql_tpu.utils import events as _events
+                    self.instance.stmt_summary.heal_failures.inc()
+                    self.instance.planner.spm.abort_heal(
+                        plan.spm_key, f"verdict error {heal_exc!r}")
+                    _events.publish(
+                        "plan_heal_failed",
+                        f"heal verdict error {heal_exc!r}",
+                        node=self.instance.node_id, reason="internal_error")
+                except Exception:
+                    pass
         self.last_trace = [f"trace-id {prof.trace_id}"] + ctx.trace + \
             [f"elapsed={elapsed:.3f}s workload={plan.workload}"]
         self._finish_query(sql, elapsed, prof, plan.workload,
@@ -1686,6 +1712,8 @@ class Session:
             analyze_store(tm, store)
             rows.append((f"{tm.schema}.{tm.name}", "analyze", "status", "OK"))
         self.instance.catalog.version += 1
+        # fresh statistics re-arm HEAL_FAILED-parked plan baselines
+        self.instance.catalog.stats_version += 1
         return ResultSet(["Table", "Op", "Msg_type", "Msg_text"],
                          [dt.VARCHAR] * 4, rows)
 

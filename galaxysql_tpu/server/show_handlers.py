@@ -85,14 +85,17 @@ def handle(session, stmt: ast.Show):
     if kind == "baseline":
         # SPM DAL (PlanManager.java DAL analog): one row per plan baseline;
         # REGRESSIONS/LAST_REGRESSION carry the statement-summary sentinel's
-        # runtime verdict on the accepted plan
+        # runtime verdict on the accepted plan, STATE/ROLLBACKS/LAST_HEAL the
+        # self-heal quarantine machine (HEALTHY -> REGRESSED -> PROBATION ->
+        # HEALED | EVOLVED | HEAL_FAILED)
         rows = inst.planner.spm.rows()
         return ResultSet(
             ["BASELINE_ID", "SCHEMA_NAME", "PARAMETERIZED_SQL", "ACCEPTED_PLAN",
              "ORIGIN", "RUNS", "AVG_MS", "CANDIDATE_PLAN", "REGRESSIONS",
-             "LAST_REGRESSION"],
+             "LAST_REGRESSION", "STATE", "ROLLBACKS", "LAST_HEAL"],
             [dt.BIGINT, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR,
-             dt.BIGINT, dt.DOUBLE, dt.VARCHAR, dt.BIGINT, dt.VARCHAR], rows)
+             dt.BIGINT, dt.DOUBLE, dt.VARCHAR, dt.BIGINT, dt.VARCHAR,
+             dt.VARCHAR, dt.BIGINT, dt.VARCHAR], rows)
     if kind == "create_table":
         schema = session.schema
         tm = inst.catalog.table(schema, stmt.target)

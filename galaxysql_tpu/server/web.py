@@ -81,7 +81,7 @@ class WebConsole:
         if path == "/baselines":
             cols = ["baseline_id", "schema", "sql", "accepted", "origin",
                     "runs", "avg_ms", "candidate", "regressions",
-                    "last_regression"]
+                    "last_regression", "state", "rollbacks", "last_heal"]
             return {"baselines": [dict(zip(cols, r))
                                   for r in inst.planner.spm.rows()]}
         if path == "/scheduler":

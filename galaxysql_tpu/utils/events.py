@@ -29,11 +29,15 @@ KINDS = (
     "ddl", "breaker_open", "breaker_close", "worker_failover",
     "sync_failure", "sync_heal", "skew_activate", "skew_deactivate",
     "batch_fallback", "plan_regression",
+    # self-heal loop (plan/spm.py quarantine machine, driven by the
+    # statement-summary sentinel): quarantine opened with a rollback pin /
+    # targeted statistics repair, probation verdicts
+    "plan_rollback", "stats_repair", "plan_promoted", "plan_heal_failed",
 )
 
 _WARN_KINDS = frozenset({
     "breaker_open", "worker_failover", "sync_failure", "batch_fallback",
-    "plan_regression",
+    "plan_regression", "plan_rollback", "plan_heal_failed",
 })
 
 

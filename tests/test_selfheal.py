@@ -1,0 +1,553 @@
+"""Self-healing plan management: sentinel-triggered quarantine, verified
+rollback, stats-drift repair, flap damping, restart-resumed probation, and the
+detect-only escape hatches.
+
+The heal loop under test (plan/spm.py quarantine machine, driven by the
+statement-summary sentinel in meta/statement_summary.py):
+
+    HEALTHY --sentinel--> REGRESSED --bind--> PROBATION --> HEALED
+                                                        --> EVOLVED
+                                                        --> HEAL_FAILED
+
+The end-to-end fixture induces a GENUINE join-order regression (no synthetic
+sleeps): a 3-table star query whose m:n dim-dim edge (cust.nk = supp.nk, the
+TPC-H Q5 nation-key trap the GOO planner exists to avoid) explodes when a
+stats change makes the cost model merge the two dims first.
+
+The `selfheal`-marked tests are the fast smoke target (`make heal-smoke`).
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from galaxysql_tpu.exec import operators as ops
+from galaxysql_tpu.meta import statement_summary as ssm
+from galaxysql_tpu.meta.statistics import analyzed_rows
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.utils.events import EVENTS
+
+Q = ("/*+TDDL: FRAGMENT_CACHE(OFF)*/ SELECT count(*), "
+     "sum(fact.val + cust.cv + supp.sv) FROM fact, cust, supp "
+     "WHERE fact.ck = cust.ck AND fact.sk = supp.sk AND cust.nk = supp.nk")
+
+N_FACT, N_DIM = 40000, 2000
+
+
+def _star(schema, data_dir=None, n_fact=N_FACT, n_dim=N_DIM):
+    """Fact + two dims whose nk edge is the m:n trap; accurate ANALYZE stats
+    make GOO route the nk edge through the fact table (fast plan)."""
+    inst = Instance(data_dir=data_dir)
+    s = Session(inst)
+    s.execute(f"CREATE DATABASE {schema}")
+    s.execute(f"USE {schema}")
+    s.execute("CREATE TABLE fact (fid BIGINT PRIMARY KEY, ck BIGINT, "
+              "sk BIGINT, val BIGINT) PARTITION BY HASH(fid) PARTITIONS 4")
+    s.execute("CREATE TABLE cust (cid BIGINT PRIMARY KEY, ck BIGINT, "
+              "nk BIGINT, cv BIGINT)")
+    s.execute("CREATE TABLE supp (sid BIGINT PRIMARY KEY, sk BIGINT, "
+              "nk BIGINT, sv BIGINT)")
+    ts = inst.tso.next_timestamp
+    rng = np.random.default_rng(7)
+    inst.store(schema, "fact").insert_arrays(
+        {"fid": np.arange(n_fact), "ck": rng.integers(0, n_dim, n_fact),
+         "sk": rng.integers(0, n_dim, n_fact),
+         "val": np.arange(n_fact) % 97}, ts())
+    inst.store(schema, "cust").insert_arrays(
+        {"cid": np.arange(n_dim), "ck": np.arange(n_dim),
+         "nk": np.arange(n_dim) % 4, "cv": np.arange(n_dim) % 13}, ts())
+    inst.store(schema, "supp").insert_arrays(
+        {"sid": np.arange(n_dim), "sk": np.arange(n_dim),
+         "nk": np.arange(n_dim) % 4, "sv": np.arange(n_dim) % 11}, ts())
+    s.execute("ANALYZE TABLE fact, cust, supp")
+    # warm the engine (cold-interpreter jax/compile inflation must not leak
+    # into the frozen latency baseline), then clear the summary store so the
+    # baseline re-forms from warm executions only
+    s.execute(Q)
+    s.execute(Q)
+    inst.stmt_summary.clear()
+    return inst, s
+
+
+def _flip_stats(inst, s, schema, n_dim=N_DIM):
+    """The stats change that flips the greedy join order: ingest distinct-nk
+    dim rows (disjoint key domains — query RESULTS don't change) and ANALYZE.
+    ndv(nk) jumps from 4 to ~n_dim, so the System-R estimate of the dim-dim
+    merge collapses and GOO now merges the m:n edge FIRST — a genuine
+    latency blow-up on the same data."""
+    ts = inst.tso.next_timestamp
+    inst.store(schema, "cust").insert_arrays(
+        {"cid": np.arange(n_dim, 2 * n_dim),
+         "ck": np.arange(n_dim, 2 * n_dim),
+         "nk": np.arange(10_000, 10_000 + n_dim),
+         "cv": np.zeros(n_dim, np.int64)}, ts())
+    inst.store(schema, "supp").insert_arrays(
+        {"sid": np.arange(n_dim, 2 * n_dim),
+         "sk": np.arange(n_dim, 2 * n_dim),
+         "nk": np.arange(20_000, 20_000 + n_dim),
+         "sv": np.zeros(n_dim, np.int64)}, ts())
+    s.execute("ANALYZE TABLE fact, cust, supp")
+
+
+def _timed(s, n):
+    out = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        rs = s.execute(Q)
+        out.append(((time.perf_counter() - t0) * 1000.0,
+                    tuple(map(tuple, rs.rows))))
+    return out
+
+
+def _heal_events(kind=None):
+    evs = [e for e in EVENTS.entries()
+           if e.kind in ("plan_rollback", "plan_promoted",
+                         "plan_heal_failed", "stats_repair")]
+    return [e for e in evs if e.kind == kind] if kind else evs
+
+
+def _spm_key(inst):
+    return next(iter(inst.planner.spm._baselines))
+
+
+# -- the acceptance scenario ---------------------------------------------------
+
+
+@pytest.mark.selfheal
+class TestSelfHealEndToEnd:
+    def test_regression_rolls_back_verifies_and_promotes(self):
+        """A stats-driven join-order regression is detected, rolled back,
+        verified, and promoted with ZERO human intervention: one
+        plan_rollback + one plan_promoted per episode, bit-identical results
+        throughout, post-heal median back within PLAN_REGRESSION_FACTOR of
+        the frozen baseline, steady-state retraces 0."""
+        EVENTS.clear()
+        inst, s = _star("hz")
+        p1 = _timed(s, 6)  # baseline freezes on the first plan's median
+        key = _spm_key(inst)
+        b = inst.planner.spm._baselines[key]
+        good_orders = list(b.accepted.orders)
+        entry = inst.stmt_summary._entries[key]
+        base_ms = entry.baseline_ms
+        base_fp = entry.baseline_fp
+        assert base_ms is not None and b.state == "HEALTHY"
+        factor = float(inst.config.get("PLAN_REGRESSION_FACTOR"))
+
+        # the DBA deletes the baseline (PR-9 workflow) and the stats change
+        # flips the replan into the m:n-first order — a real blow-up
+        bid = s.execute("SHOW BASELINE").rows[0][0]
+        s.execute(f"BASELINE DELETE {bid}")
+        _flip_stats(inst, s, "hz")
+        # the sentinel fires once a window bucket holds PLAN_REGRESSION_MIN_
+        # EXECS regressed runs (5 + a couple extra if a 60s window boundary
+        # happens to split them)
+        p2 = []
+        for _ in range(12):
+            p2 += _timed(s, 1)
+            b = inst.planner.spm._baselines[key]
+            if b.state != "HEALTHY":
+                break
+        assert b.state == "REGRESSED" and b.heal is not None
+        assert b.heal.mode == "rollback"
+        assert [tuple(o) for o in b.heal.rollback_orders] == good_orders
+        # the regression was genuine: the flagged window median really blew up
+        assert sorted(d for d, _ in p2)[len(p2) // 2] > factor * base_ms
+        assert len(_heal_events("plan_rollback")) == 1
+
+        # probation: the next bind re-plans pinned to the frozen baseline
+        # plan; PLAN_HEAL_VERIFY_EXECS executions verify it
+        p3 = _timed(s, int(inst.config.get("PLAN_HEAL_VERIFY_EXECS")))
+        b = inst.planner.spm._baselines[key]
+        assert b.state == "HEALED"
+        assert b.accepted.origin == "healed"
+        assert list(b.accepted.orders) == good_orders
+        assert len(_heal_events("plan_promoted")) == 1
+        assert not _heal_events("plan_heal_failed")
+        assert inst.metrics.counter("plan_heals").value == 1
+        assert inst.metrics.counter("plan_heal_failures").value == 0
+
+        # post-heal: median back within the sentinel factor of the frozen
+        # baseline, results bit-identical through every phase
+        p4 = _timed(s, 5)
+        assert sorted(d for d, _ in p4)[2] <= factor * base_ms
+        assert len({rows for _, rows in p1 + p2 + p3 + p4}) == 1
+        # the healed plan runs under the baseline fingerprint again
+        rows = [r for r in s.execute("SHOW STATEMENT SUMMARY").rows
+                if "fact.val" in r[-1]]
+        assert base_fp in {r[2] for r in rows}
+
+        # surfaces: SHOW BASELINE carries the heal machine columns
+        brow = s.execute("SHOW BASELINE").rows[0]
+        assert brow[10] == "HEALED" and brow[11] == 1 and "healed" in brow[12]
+
+        # steady state afterwards: no retraces, unchanged dispatch counts
+        from galaxysql_tpu.exec.operators import COMPILE_STATS
+        s.execute(Q)
+        r0 = COMPILE_STATS["retraces"]
+        ops.reset_dispatch_stats()
+        s.execute(Q)
+        d0 = ops.DISPATCH_STATS["dispatches"]
+        ops.reset_dispatch_stats()
+        s.execute(Q)
+        assert ops.DISPATCH_STATS["dispatches"] == d0
+        assert COMPILE_STATS["retraces"] == r0
+        s.close()
+
+
+# -- restart: quarantine state persists, probation resumes --------------------
+
+
+@pytest.mark.selfheal
+class TestRestartResume:
+    def test_probation_survives_coordinator_restart(self, tmp_path):
+        EVENTS.clear()
+        inst, s = _star("hr", data_dir=str(tmp_path / "hr"))
+        _timed(s, 6)
+        key = _spm_key(inst)
+        bid = s.execute("SHOW BASELINE").rows[0][0]
+        s.execute(f"BASELINE DELETE {bid}")
+        _flip_stats(inst, s, "hr")
+        for _ in range(12):  # sentinel fires -> REGRESSED
+            s.execute(Q)
+            if inst.planner.spm._baselines[key].state != "HEALTHY":
+                break
+        assert inst.planner.spm._baselines[key].state == "REGRESSED"
+        _timed(s, 2)  # 2 of PLAN_HEAL_VERIFY_EXECS probation samples
+        b = inst.planner.spm._baselines[key]
+        assert b.state == "PROBATION" and len(b.heal.samples) == 2
+        inst.save()
+        s.close()
+
+        # coordinator restart: probation resumes from the persisted record
+        # instead of re-detecting and re-thrashing
+        inst2 = Instance(data_dir=str(tmp_path / "hr"))
+        b2 = inst2.planner.spm._baselines[key]
+        assert b2.state == "PROBATION"
+        assert len(b2.heal.samples) == 2
+        assert b2.heal.mode == "rollback" and b2.rollbacks == 1
+        s2 = Session(inst2, schema="hr")
+        for _ in range(3):  # the remaining verification samples
+            s2.execute(Q)
+        b2 = inst2.planner.spm._baselines[key]
+        assert b2.state == "HEALED" and b2.accepted.origin == "healed"
+        # exactly one rollback + one promote across the whole episode,
+        # restart included
+        assert len(_heal_events("plan_rollback")) == 1
+        assert len(_heal_events("plan_promoted")) == 1
+        s2.close()
+
+
+# -- same-plan drift: stats repair path ----------------------------------------
+
+
+@pytest.mark.selfheal
+class TestStatsDriftRepair:
+    def test_drift_repairs_statistics_and_episode_concludes(self):
+        """The same-fingerprint path: the dim gains many duplicate join-key
+        rows per value under a pinned, cached plan (no ANALYZE — classic
+        stats drift), so latency genuinely degrades with NO plan change.
+        The heal loop must repair the drifted statistics from the store
+        truth (targeted, not a DBA-run ANALYZE), re-enter verification
+        unpinned, and close the episode with exactly one typed outcome.
+        (The individual verdict branches — HEALED / HEAL_FAILED + park +
+        ANALYZE re-arm — are pinned deterministically in TestFlapDamping.)"""
+        EVENTS.clear()
+        inst, s = _star("hd", n_fact=4000, n_dim=500)
+        _timed(s, 6)
+        key = _spm_key(inst)
+        cust_tm = inst.catalog.table("hd", "cust")
+        assert analyzed_rows(cust_tm) == 500
+        # ingest 40 duplicate cust rows per ck value (a genuine
+        # join-multiplicity blowup: every fact row now matches 41 cust
+        # rows); the sketches still describe the 500-row dim
+        ts = inst.tso.next_timestamp
+        n = 20000
+        inst.store("hd", "cust").insert_arrays(
+            {"cid": np.arange(500, 500 + n), "ck": np.arange(n) % 500,
+             "nk": (np.arange(n) % 500) % 4,
+             "cv": np.zeros(n, np.int64)}, ts())
+        # same cached plan: the window median crosses the threshold once
+        # enough drifted samples displace the fast ones
+        p2 = []
+        for _ in range(20):
+            p2 += _timed(s, 1)
+            b = inst.planner.spm._baselines[key]
+            if b.state != "HEALTHY":
+                break
+        assert b.state in ("REGRESSED", "PROBATION")
+        assert b.heal is not None and b.heal.mode == "repair"
+        assert b.heal.reason == "plan_drift"
+        # the flag really was same-fingerprint drift, not a plan change
+        regs = [e for e in EVENTS.entries() if e.kind == "plan_regression"]
+        assert regs and regs[-1].attrs["reason"] == "plan_drift"
+        # the repair corrected the drifted sketches to the live row count
+        assert analyzed_rows(cust_tm) >= 500 + n
+        reps = _heal_events("stats_repair")
+        assert len(reps) == 1
+        assert any(d["table"] == "hd.cust"
+                   for d in reps[0].attrs["repaired"])
+
+        # probation re-verifies on repaired statistics and the episode ends
+        # in exactly one typed verdict
+        p3 = []
+        for _ in range(12):
+            if inst.planner.spm._baselines[key].state not in (
+                    "REGRESSED", "PROBATION"):
+                break
+            p3 += _timed(s, 1)
+        b = inst.planner.spm._baselines[key]
+        assert b.state in ("HEALED", "HEAL_FAILED")
+        outcomes = _heal_events("plan_promoted") + \
+            _heal_events("plan_heal_failed")
+        assert len(outcomes) == 1
+        assert inst.metrics.counter("plan_heals").value + \
+            inst.metrics.counter("plan_heal_failures").value == 1
+        assert s.execute("SHOW BASELINE").rows[0][10] == b.state
+        # results stayed bit-identical through detection, repair, probation
+        p4 = _timed(s, 2)
+        assert len({rows for _, rows in p2 + p3 + p4}) == 1
+        s.close()
+
+
+# -- flap damping (breaker-style) ----------------------------------------------
+
+
+@pytest.mark.selfheal
+class TestFlapDamping:
+    def _mk_pm(self):
+        from galaxysql_tpu.plan.spm import PlanManager
+        pm = PlanManager()
+        key = ("s", "select ?")
+        pm.capture(key, [("s.a", "s.b")], catalog_version=1,
+                   followed_baseline=False)
+        return pm, key
+
+    def _episode(self, pm, key, sample_ms, n=1, now=0.0):
+        action = pm.begin_quarantine(
+            key, "rollback", "new_plan", [("s.b", "s.a")], baseline_ms=10.0,
+            factor=1.5, verify_execs=n, max_rollbacks=3, cooldown_s=0.0,
+            stats_version=7, regressed_ms=100.0, now=now)
+        if action is None or action["action"] == "damped":
+            return action, None
+        pm.choose(key, 1)  # the bind that enters PROBATION
+        verdict = None
+        for _ in range(n):
+            # probation samples carry the plan they ran (the pinned orders);
+            # samples from other plans are rejected as stragglers
+            verdict = pm.record_execution(key, sample_ms,
+                                          orders=[("s.b", "s.a")],
+                                          stats_version=7)
+        return action, verdict
+
+    def test_max_rollbacks_cap_parks_and_analyze_rearms(self):
+        pm, key = self._mk_pm()
+        for i in range(3):  # burn the episode budget (verdicts: promoted)
+            action, verdict = self._episode(pm, key, 9.0, now=float(i))
+            assert action["action"] == "rollback"
+            assert verdict["kind"] == "promoted"
+        action, _ = self._episode(pm, key, 9.0, now=99.0)
+        assert action["action"] == "damped"
+        b = pm._baselines[key]
+        assert b.state == "HEAL_FAILED" and "flap_damped" in b.last_heal
+        # parked against the SAME catalog version: nothing may start
+        assert pm.begin_quarantine(
+            key, "rollback", "new_plan", [("s.b", "s.a")], baseline_ms=10.0,
+            factor=1.5, verify_execs=1, max_rollbacks=3, cooldown_s=0.0,
+            stats_version=7, now=100.0) is None
+        # ANALYZE/DDL moved the catalog version: re-armed, budget reset
+        action = pm.begin_quarantine(
+            key, "rollback", "new_plan", [("s.b", "s.a")], baseline_ms=10.0,
+            factor=1.5, verify_execs=1, max_rollbacks=3, cooldown_s=0.0,
+            stats_version=8, now=101.0)
+        assert action is not None and action["action"] == "rollback"
+        assert pm._baselines[key].rollbacks == 1
+
+    def test_cooldown_blocks_back_to_back_episodes(self):
+        pm, key = self._mk_pm()
+        action = pm.begin_quarantine(
+            key, "rollback", "new_plan", [("s.b", "s.a")], baseline_ms=10.0,
+            factor=1.5, verify_execs=1, max_rollbacks=10, cooldown_s=60.0,
+            stats_version=7, now=1000.0)
+        assert action is not None
+        pm.choose(key, 1)
+        pm.record_execution(key, 9.0, orders=[("s.b", "s.a")],
+                            stats_version=7)  # -> HEALED
+        # within the cooldown: detect-only
+        assert pm.begin_quarantine(
+            key, "rollback", "new_plan", [("s.b", "s.a")], baseline_ms=10.0,
+            factor=1.5, verify_execs=1, max_rollbacks=10, cooldown_s=60.0,
+            stats_version=7, now=1030.0) is None
+        # after it elapses: a new episode may start
+        assert pm.begin_quarantine(
+            key, "rollback", "new_plan", [("s.b", "s.a")], baseline_ms=10.0,
+            factor=1.5, verify_execs=1, max_rollbacks=10, cooldown_s=60.0,
+            stats_version=7, now=1061.0) is not None
+
+    def test_repair_failure_parks_then_analyze_rearms(self):
+        """Repair-mode probation that stays regressed parks in HEAL_FAILED
+        against the CURRENT catalog version; only ANALYZE/DDL (a catalog
+        version move) re-arms the digest."""
+        pm, key = self._mk_pm()
+        action = pm.begin_quarantine(
+            key, "repair", "plan_drift", None, baseline_ms=10.0, factor=1.5,
+            verify_execs=2, max_rollbacks=3, cooldown_s=0.0,
+            stats_version=7, regressed_ms=30.0, now=0.0)
+        assert action["action"] == "repair"
+        # UNARMED (repair still running): binds keep the pinned plan
+        assert pm.choose(key, 1) == [("s.a", "s.b")]
+        assert pm._baselines[key].state == "REGRESSED"
+        pm.arm_heal(key)  # the stats repair finished
+        # now probation is UNPINNED: the corrected stats pick the plan
+        assert pm.choose(key, 1) is None
+        # executions BEFORE the probation bind anchors the episode are
+        # unattributable stragglers — never verification samples
+        assert pm.record_execution(key, 500.0, orders=[("s.z", "s.a")],
+                                   stats_version=7) is None
+        assert not pm._baselines[key].heal.samples
+        # the probation BIND (capture) anchors the episode's plan identity
+        pm.capture(key, [("s.a", "s.b")], 1, followed_baseline=False)
+        assert pm.record_execution(key, 28.0, orders=[("s.a", "s.b")],
+                                   stats_version=7) is None  # 1 of 2
+        # a straggler of a DIFFERENT plan (bound pre-repair) is rejected
+        assert pm.record_execution(key, 500.0, orders=[("s.z", "s.a")],
+                                   stats_version=7) is None
+        assert len(pm._baselines[key].heal.samples) == 1
+        # 28ms median: misses the 15ms baseline gate and does not clearly
+        # beat the 30ms regressed window either -> park
+        verdict = pm.record_execution(key, 28.0, orders=[("s.a", "s.b")],
+                                      stats_version=7)
+        assert verdict["kind"] == "failed"
+        b = pm._baselines[key]
+        assert b.state == "HEAL_FAILED" and b.park_version == 7
+        # parked: the same catalog version may not start another episode
+        assert pm.begin_quarantine(
+            key, "repair", "plan_drift", None, baseline_ms=10.0, factor=1.5,
+            verify_execs=1, max_rollbacks=3, cooldown_s=0.0,
+            stats_version=7, now=1.0) is None
+        # after HEAL_FAILED the digest runs its accepted plan again
+        assert pm.choose(key, 1) == [("s.a", "s.b")]
+        # ANALYZE/DDL moved the catalog version: re-armed
+        action = pm.begin_quarantine(
+            key, "repair", "plan_drift", None, baseline_ms=10.0, factor=1.5,
+            verify_execs=1, max_rollbacks=3, cooldown_s=0.0,
+            stats_version=8, now=2.0)
+        assert action is not None and action["action"] == "repair"
+
+    def test_evolved_when_rollback_slow_but_baseline_far(self):
+        """Rollback misses the baseline AND does not clearly beat the
+        regressed plan: the new plan is kept as the evolved baseline and the
+        latency yardstick re-freezes."""
+        pm, key = self._mk_pm()
+        accepted_before = list(pm._baselines[key].accepted.orders)
+        _, verdict = self._episode(pm, key, sample_ms=90.0)  # regressed=100
+        assert verdict["kind"] == "evolved" and verdict["refreeze"]
+        b = pm._baselines[key]
+        assert b.state == "EVOLVED" and b.accepted.origin == "evolved"
+        assert list(b.accepted.orders) == accepted_before
+
+    def test_promoted_with_refreeze_when_rollback_beats_regressed(self):
+        """The baseline is unreachable (data grew) but the rollback still
+        clearly beats the regressed plan: promote it and re-freeze."""
+        pm, key = self._mk_pm()
+        _, verdict = self._episode(pm, key, sample_ms=40.0)  # 40*1.5 <= 100
+        assert verdict["kind"] == "promoted" and verdict["refreeze"]
+        b = pm._baselines[key]
+        assert b.state == "HEALED"
+        assert list(b.accepted.orders) == [("s.b", "s.a")]
+
+
+# -- hatches + hot path --------------------------------------------------------
+
+
+@pytest.mark.selfheal
+class TestHatches:
+    def test_param_off_restores_detect_only(self):
+        EVENTS.clear()
+        inst, s = _star("hh", n_fact=4000, n_dim=500)
+        inst.config.set_instance("ENABLE_PLAN_AUTOHEAL", 0)
+        _timed(s, 6)
+        key = _spm_key(inst)
+        bid = s.execute("SHOW BASELINE").rows[0][0]
+        s.execute(f"BASELINE DELETE {bid}")
+        _flip_stats(inst, s, "hh", n_dim=500)
+        _timed(s, 6)
+        # detection stayed live, the engine never acted
+        assert [e.kind for e in EVENTS.entries()
+                if e.kind == "plan_regression"]
+        assert not _heal_events()
+        b = inst.planner.spm._baselines[key]
+        assert b.state == "HEALTHY" and b.rollbacks == 0
+        assert b.accepted.regressions >= 1  # PR-9 annotation still works
+        s.close()
+
+    def test_env_kill_switch(self, monkeypatch):
+        EVENTS.clear()
+        inst, s = _star("he", n_fact=4000, n_dim=500)
+        monkeypatch.setattr(ssm, "AUTOHEAL_ENABLED", False)
+        _timed(s, 6)
+        key = _spm_key(inst)
+        bid = s.execute("SHOW BASELINE").rows[0][0]
+        s.execute(f"BASELINE DELETE {bid}")
+        _flip_stats(inst, s, "he", n_dim=500)
+        _timed(s, 6)
+        assert not _heal_events()
+        assert inst.planner.spm._baselines[key].state == "HEALTHY"
+        s.close()
+
+    def test_hot_path_dispatch_unchanged_autoheal_on_vs_off(self):
+        """A healthy digest pays nothing for the armed heal loop: same
+        device dispatches and zero retraces with the hatch on vs off."""
+        inst, s = _star("hp", n_fact=4000, n_dim=500)
+        from galaxysql_tpu.exec.operators import COMPILE_STATS
+        _timed(s, 2)  # warm
+        ops.reset_dispatch_stats()
+        on = s.execute(Q)
+        d_on = ops.DISPATCH_STATS["dispatches"]
+        inst.config.set_instance("ENABLE_PLAN_AUTOHEAL", 0)
+        r0 = COMPILE_STATS["retraces"]
+        ops.reset_dispatch_stats()
+        off = s.execute(Q)
+        assert ops.DISPATCH_STATS["dispatches"] == d_on
+        assert COMPILE_STATS["retraces"] == r0
+        assert on.rows == off.rows
+        s.close()
+
+
+# -- surfaces ------------------------------------------------------------------
+
+
+@pytest.mark.selfheal
+class TestSurfaces:
+    def test_show_baseline_web_and_information_schema_parity(self):
+        inst, s = _star("hs", n_fact=4000, n_dim=500)
+        s.execute(Q)
+        show = s.execute("SHOW BASELINE")
+        assert show.names[-3:] == ["STATE", "ROLLBACKS", "LAST_HEAL"]
+        from galaxysql_tpu.server.web import WebConsole
+        body = WebConsole(inst).resource("/baselines")
+        jb = body["baselines"][0]
+        # JSON parity: same values under the documented keys
+        row = show.rows[0]
+        assert jb["state"] == row[10] == "HEALTHY"
+        assert jb["rollbacks"] == row[11] == 0
+        assert jb["last_heal"] == row[12] == ""
+        assert jb["regressions"] == row[8]
+        json.dumps(body, default=str)
+        # SQL-queryable twin
+        r = s.execute("SELECT state, rollbacks FROM "
+                      "information_schema.plan_baselines")
+        assert ("HEALTHY", 0) in r.rows
+        s.close()
+
+    def test_heal_counters_in_metrics_and_prometheus(self):
+        inst, s = _star("hm", n_fact=4000, n_dim=500)
+        names = {r[0] for r in s.execute("SHOW METRICS").rows}
+        assert {"plan_heals", "plan_heal_failures"} <= names
+        from galaxysql_tpu.server.web import WebConsole
+        text = WebConsole(inst).metrics_text()
+        assert "galaxysql_plan_heals" in text
+        assert "galaxysql_plan_heal_failures" in text
+        s.close()

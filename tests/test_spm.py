@@ -45,13 +45,15 @@ class TestSpm:
         rows = session.execute("SHOW BASELINE").rows
         assert len(rows) == 1
         (bid, schema, psql, accepted, origin, runs, avg_ms, cand,
-         regressions, last_regression) = rows[0]
+         regressions, last_regression, state, rollbacks, last_heal) = rows[0]
         assert schema == "sp"
         assert "big" in psql and "?" in psql  # parameterized text is the key
         assert origin == "cost"
         assert runs >= 1 and avg_ms is not None
         assert cand is None
         assert regressions == 0 and last_regression == ""
+        # self-heal quarantine machine starts idle
+        assert state == "HEALTHY" and rollbacks == 0 and last_heal == ""
 
     def test_accepted_plan_overrides_cost_drift(self, session):
         session.execute(Q)
