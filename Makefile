@@ -41,6 +41,22 @@ cache-smoke:
 trace-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m tracing -p no:cacheprovider
 
+# overload smoke: the resource-governance plane under sustained load — the
+# workload-class admission gate (AIMD per-class limits, deadline-aware
+# shedding, typed ServerOverloadError with retry-after), memory-pressure
+# tiers (fragment-cache shrink, CRITICAL AP refusal + largest-query revoke),
+# retry budgets + worker slow-drain backpressure piggyback, the CCL SQL
+# surface (CREATE/DROP CCL_RULE) and CclManager concurrency stress, and the
+# end-to-end proof: TP keeps bounded p99 and nonzero goodput while an AP
+# flood sheds typed, with zero hangs and bit-identical admitted results
+overload-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m overload -p no:cacheprovider
+
+# overload bench: closed-loop TP point serving with and without a concurrent
+# AP flood (admission on), reporting TP QPS/p99 deltas + shed rate
+bench-overload:
+	JAX_PLATFORMS=cpu $(PY) bench.py --overload-only
+
 bench:
 	$(PY) bench.py
 
@@ -93,4 +109,5 @@ heal-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m selfheal -p no:cacheprovider
 
 .PHONY: tier1 fusion-smoke obs-smoke rf-smoke cache-smoke trace-smoke bench \
-	batch-smoke chaos-smoke skew-smoke bench-skew summary-smoke heal-smoke
+	batch-smoke chaos-smoke skew-smoke bench-skew summary-smoke heal-smoke \
+	overload-smoke bench-overload

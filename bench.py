@@ -947,6 +947,91 @@ def main():
         print(json.dumps(out))
 
 
+def overload_bench(inst, s, data, platform):
+    """Overload driver (PR 12 admission-control plane): closed-loop TP point
+    serving measured alone, then again with a concurrent AP flood hammering
+    a heavy aggregation while admission limits bite.  Reports TP QPS/p99
+    with and without the flood, AP goodput, and the typed shed rate — the
+    numbers that show the box degrading instead of collapsing."""
+    import threading
+    from galaxysql_tpu.utils import errors as _errors
+
+    okeys = data["orders"]["o_orderkey"]
+    keys = [int(k) for k in okeys[:: max(1, len(okeys) // 2048)]]
+    tpl = "select o_totalprice from orders where o_orderkey = %d"
+    ap_q = ("select l_orderkey, sum(l_extendedprice * (1 - l_discount)) "
+            "from lineitem group by l_orderkey order by 2 desc limit 10")
+    s.execute(tpl % keys[0])  # register + warm the PointPlan
+    s.execute(ap_q)           # warm the AP plan + classify the digest
+    n_tp = int(os.environ.get("BENCH_OVERLOAD_TP_SESSIONS", "32"))
+    per = int(os.environ.get("BENCH_OVERLOAD_PER_SESSION", "40"))
+    n_ap = int(os.environ.get("BENCH_OVERLOAD_AP_THREADS", "8"))
+    inst.config.set_instance("ADMISSION_AP_LIMIT", 2)
+    inst.config.set_instance("ADMISSION_QUEUE_SIZE", 1)
+    inst.config.set_instance("ADMISSION_WAIT_MS", 100)
+    inst.admission._limit.clear()
+
+    qps0, p99_0, errs = _closed_loop_point(inst, tpl, keys, n_tp, per)
+    if errs:
+        raise errs[0]
+
+    stop = threading.Event()
+    counts = {"ok": 0, "shed": 0, "other": 0}
+    lock = threading.Lock()
+
+    def flood():
+        sx = Session(inst, schema="tpch")
+        while not stop.is_set():
+            try:
+                sx.execute(ap_q)
+                with lock:
+                    counts["ok"] += 1
+            except (_errors.ServerOverloadError, _errors.CclRejectError):
+                with lock:
+                    counts["shed"] += 1
+                time.sleep(0.001)
+            except Exception:
+                with lock:
+                    counts["other"] += 1
+        sx.close()
+
+    floods = [threading.Thread(target=flood, daemon=True)
+              for _ in range(n_ap)]
+    for t in floods:
+        t.start()
+    time.sleep(0.3)  # flood established before the measured TP pass
+    qps1, p99_1, errs = _closed_loop_point(inst, tpl, keys, n_tp, per)
+    stop.set()
+    for t in floods:
+        t.join(timeout=60)
+    if errs:
+        raise errs[0]
+    total_ap = counts["ok"] + counts["shed"] + counts["other"]
+    return [{
+        "metric": f"tp_point_qps_under_ap_flood_{n_tp}_sessions",
+        "value": round(qps1, 1), "unit": "qps",
+        "vs_baseline": round(qps1 / max(qps0, 1e-9), 3),
+        "p99_ms": round(p99_1, 3),
+        "no_flood_qps": round(qps0, 1),
+        "no_flood_p99_ms": round(p99_0, 3),
+        "ap_flood_threads": n_ap,
+        "ap_completed": counts["ok"],
+        "ap_shed_typed": counts["shed"],
+        "ap_untyped_failures": counts["other"],
+        "ap_shed_rate": round(counts["shed"] / max(total_ap, 1), 3),
+        "platform": platform,
+    }]
+
+
+def overload_only_main():
+    """`bench.py --overload-only` (make bench-overload): TP serving under an
+    AP flood with admission control engaged, on a small TPC-H load."""
+    sf = float(os.environ.get("BENCH_SF", "0.1"))
+    inst, s, data = load(sf)
+    for out in overload_bench(inst, s, data, jax.devices()[0].platform):
+        print(json.dumps(out))
+
+
 def batch_only_main():
     """`bench.py --batch-only` (make batch-smoke): just the closed-loop
     multi-session serving bench, on a small TPC-H load."""
@@ -961,5 +1046,7 @@ if __name__ == "__main__":
         batch_only_main()
     elif "--skew-only" in sys.argv:
         skew_only_main()
+    elif "--overload-only" in sys.argv:
+        overload_only_main()
     else:
         main()

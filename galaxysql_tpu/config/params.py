@@ -90,6 +90,55 @@ CCL_MAX_CONCURRENCY = _p("CCL_MAX_CONCURRENCY", 0, "0 = unlimited")
 CCL_WAIT_QUEUE_SIZE = _p("CCL_WAIT_QUEUE_SIZE", 64, "")
 CCL_WAIT_TIMEOUT = _p("CCL_WAIT_TIMEOUT", 10_000, "ms")
 
+# --- admission control / resource governance (server/admission.py) -------------
+ENABLE_ADMISSION_CONTROL = _p(
+    "ENABLE_ADMISSION_CONTROL", True,
+    "workload-class admission gate in front of every query: adaptive (AIMD) "
+    "per-class TP/AP concurrency limits, deadline-aware shedding, and "
+    "memory-pressure-driven AP refusal; refusals are typed "
+    "ServerOverloadError with retry-after — never a hang.  The idle fast "
+    "path is lock-free (token-list reads only)")
+ADMISSION_TP_LIMIT = _p(
+    "ADMISSION_TP_LIMIT", 256,
+    "initial concurrent-TP admission limit (AIMD adjusts between "
+    "ADMISSION_MIN_LIMIT and this starting point x4)")
+ADMISSION_AP_LIMIT = _p(
+    "ADMISSION_AP_LIMIT", 8,
+    "initial concurrent-AP admission limit (AIMD-adjusted; AP work is the "
+    "load that starves TP under flood, so its limit starts low)")
+ADMISSION_MIN_LIMIT = _p(
+    "ADMISSION_MIN_LIMIT", 1,
+    "floor for AIMD multiplicative decrease — goodput never reaches zero")
+ADMISSION_TARGET_TP_MS = _p(
+    "ADMISSION_TARGET_TP_MS", 100,
+    "per-class latency target: TP EWMA above this drives multiplicative "
+    "decrease of the TP admission limit")
+ADMISSION_TARGET_AP_MS = _p(
+    "ADMISSION_TARGET_AP_MS", 5_000,
+    "per-class latency target for the AP admission limit (AIMD)")
+ADMISSION_QUEUE_SIZE = _p(
+    "ADMISSION_QUEUE_SIZE", 64,
+    "bounded per-class wait queue in front of a full admission limit; "
+    "overflow sheds typed (ServerOverloadError) instead of queuing unbounded")
+ADMISSION_WAIT_MS = _p(
+    "ADMISSION_WAIT_MS", 1_000,
+    "max wait for an admission slot before the query is shed typed")
+MEM_ELEVATED_PCT = _p(
+    "MEM_ELEVATED_PCT", 70,
+    "root-pool usage percent at which the memory governor enters ELEVATED "
+    "(fragment-cache budget halves, spill thresholds drop 4x)")
+MEM_CRITICAL_PCT = _p(
+    "MEM_CRITICAL_PCT", 90,
+    "root-pool usage percent at which the governor enters CRITICAL: new AP "
+    "admissions refuse typed and the largest revocable query is revoked "
+    "(spilled) rather than dying on OOM")
+QUERY_MEM_BYTES = _p(
+    "QUERY_MEM_BYTES", 4 << 30,
+    "per-query memory-pool limit: hash-join build / agg partial / sort slab "
+    "reservations charge a child pool of the global pool; exhaustion spills "
+    "first and fails typed (MemoryLimitExceeded) only when spilling cannot "
+    "cover it")
+
 # --- fault tolerance ----------------------------------------------------------
 MAX_EXECUTION_TIME = _p(
     "MAX_EXECUTION_TIME", 0,
@@ -111,6 +160,14 @@ BREAKER_COOLDOWN_MS = _p(
     "BREAKER_COOLDOWN_MS", 1000,
     "open-state hold before the breaker half-opens (one ping probe decides "
     "closed vs re-open); while open, requests fast-fail typed")
+RPC_RETRY_BUDGET = _p(
+    "RPC_RETRY_BUDGET", 64,
+    "per-worker retry token bucket capacity: each retry attempt takes one "
+    "token; an empty bucket fails the RPC typed instead of retrying — under "
+    "saturation retries must not amplify load into a metastable storm")
+RPC_RETRY_REFILL_PER_S = _p(
+    "RPC_RETRY_REFILL_PER_S", 8,
+    "retry-budget token refill rate per second per worker endpoint")
 
 # --- workload insight (meta/statement_summary.py) ------------------------------
 ENABLE_STATEMENT_SUMMARY = _p(

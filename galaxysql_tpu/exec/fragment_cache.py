@@ -373,6 +373,19 @@ class FragmentCache:
         self.pool.add_revoker(_revoke)
         weakref.finalize(self, _detach_pool, self.pool, _revoke)
 
+    def set_budget(self, nbytes: int):
+        """Resize the cache's byte budget live (memory governor: ELEVATED
+        pressure halves it, NORMAL restores).  Shrinking evicts LRU down to
+        the new cap immediately and lowers the pool ceiling so future
+        admissions respect it; growing just raises both."""
+        nbytes = max(0, int(nbytes))
+        with self._lock:
+            self.budget = nbytes
+            over = self._bytes - nbytes
+        self.pool.limit = nbytes
+        if over > 0:
+            self._evict_bytes(over)
+
     # -- epochs (remote tables without a CN-side version) ---------------------
 
     def epoch(self, table_key: str) -> int:

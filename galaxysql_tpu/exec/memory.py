@@ -99,3 +99,85 @@ GLOBAL_POOL = MemoryPool("global", 16 << 30)
 
 def query_pool(conn_id: int, limit: int = 4 << 30) -> MemoryPool:
     return GLOBAL_POOL.child(f"query-{conn_id}", limit)
+
+
+class PoolCharge:
+    """An operator's running reservation against a per-query pool.
+
+    Pipeline breakers (hash-join build, agg partials, sort slabs) call
+    ``to(nbytes)`` as their resident state grows; a failed adjustment means
+    the pool hierarchy is exhausted even after asking other consumers to
+    revoke — the caller must take its spill path and re-charge at zero.
+    ``squeeze`` is the cross-thread revocation flag: a revoker invoked from
+    another query's reservation (or the memory governor's CRITICAL
+    revoke-largest) cannot safely spill this operator's state mid-batch, so
+    it flips the flag and the operator spills at its next batch boundary.
+
+    A None pool (admission disabled, bare operator tests) makes every call a
+    no-op — the hot path pays one attribute check."""
+
+    __slots__ = ("pool", "held", "squeeze", "_revoker")
+
+    def __init__(self, pool: Optional[MemoryPool]):
+        self.pool = pool
+        self.held = 0
+        self.squeeze = False
+        self._revoker = None
+        if pool is not None:
+            def _revoke(nbytes, _self=self):
+                _self.squeeze = True
+                return 0  # advisory: bytes free at the next batch boundary
+            self._revoker = _revoke
+            pool.add_revoker(_revoke)
+
+    def to(self, nbytes: int) -> bool:
+        """Adjust the held reservation to `nbytes`; False = pool exhausted
+        (caller spills, then calls to(0))."""
+        if self.pool is None:
+            return True
+        delta = int(nbytes) - self.held
+        if delta <= 0:
+            if delta:
+                self.pool.release(-delta)
+                self.held = int(nbytes)
+            return True
+        if self.pool.try_reserve(delta):
+            self.held = int(nbytes)
+            return True
+        self.pool.revoke(delta)  # ask spillable consumers first
+        if self.pool.try_reserve(delta):
+            self.held = int(nbytes)
+            # the revoke above ran OUR revoker too: with the reservation now
+            # holding, that self-inflicted squeeze would only force a
+            # pointless spill at the caller's next check
+            self.squeeze = False
+            return True
+        return False
+
+    def close(self):
+        if self.pool is None:
+            return
+        if self.held:
+            self.pool.release(self.held)
+            self.held = 0
+        if self._revoker is not None:
+            self.pool.remove_revoker(self._revoker)
+            self._revoker = None
+
+
+def usage_fraction(pool: MemoryPool = GLOBAL_POOL) -> float:
+    """Root-pool usage in [0, 1] — the memory governor's pressure input."""
+    limit = pool.limit or 1
+    return pool.reserved / limit
+
+
+def largest_query_child(pool: MemoryPool = GLOBAL_POOL):
+    """The biggest per-query child pool (revoke target under CRITICAL
+    pressure), or None when no query holds revocable memory."""
+    best = None
+    for c in list(pool.children):
+        if not c.name.startswith("query-") or c.reserved <= 0:
+            continue
+        if best is None or c.reserved > best.reserved:
+            best = c
+    return best

@@ -638,7 +638,8 @@ class HashAggOp(Operator):
 
     def __init__(self, child: Operator, group_exprs: Sequence[Tuple[str, ir.Expr]],
                  aggs: Sequence[AggCall], max_groups: int = 1 << 16,
-                 spill_threshold: int = 256 << 20, prelude=None):
+                 spill_threshold: int = 256 << 20, prelude=None,
+                 mem_pool=None):
         self.child = child
         self.group_exprs = list(group_exprs)
         self.aggs = list(aggs)
@@ -646,6 +647,9 @@ class HashAggOp(Operator):
         # partial-state bytes above this spill to disk (MemoryRevoker analog)
         self.spill_threshold = spill_threshold
         self.spilled_partials = 0
+        # per-query memory pool: partial bytes charge it; exhaustion (or a
+        # cross-query squeeze revoke) forces the spill path early
+        self.mem_pool = mem_pool
         # fused streaming chain (exec/fusion.FusedSegment) applied INSIDE the
         # partial kernel: scan→filter→project→partial-agg is one XLA program,
         # one dispatch per batch instead of one per operator
@@ -785,15 +789,18 @@ class HashAggOp(Operator):
         inputs, lanes = self._partial_specs()
         lane_names = tuple(name for name, _ in lanes)
         mg = self.max_groups
+        from galaxysql_tpu.exec.memory import PoolCharge
         from galaxysql_tpu.exec.spill import Spiller
         # capacity under-estimates retry the whole aggregation with doubled output
         # capacity (children re-iterate; scans re-read from the store)
         spiller = Spiller()
+        charge = PoolCharge(self.mem_pool)
         try:
             while True:
                 partials: List[K.GroupByResult] = []
                 spiller.close()
                 partial_bytes = 0
+                charge.to(0)
                 overflowed = False
                 plits = self.prelude.lits() if self.prelude is not None else ()
                 for b in self.child.batches():
@@ -806,12 +813,19 @@ class HashAggOp(Operator):
                     host = jax.tree.map(np.asarray, r)
                     partials.append(host)
                     partial_bytes += _groupby_result_bytes(host)
-                    if partial_bytes > self.spill_threshold:
+                    # spill when over the threshold, when the per-query pool
+                    # cannot cover the resident partials, or when a revoker
+                    # (memory governor / another query's reservation) asked
+                    # this operator to give memory back
+                    if partial_bytes > self.spill_threshold or \
+                            not charge.to(partial_bytes) or charge.squeeze:
                         for p in partials:
                             spiller.spill(_groupby_result_to_arrays(p))
                         self.spilled_partials += len(partials)
                         partials = []
                         partial_bytes = 0
+                        charge.to(0)
+                        charge.squeeze = False
                 if not overflowed:
                     break
                 mg *= 2
@@ -825,6 +839,7 @@ class HashAggOp(Operator):
                 yield out
         finally:
             spiller.close()
+            charge.close()
 
 
 
@@ -1027,7 +1042,7 @@ class HashJoinOp(Operator):
                  enable_bloom: bool = True, probe_prelude=None,
                  rf_publish=None, rf_manager=None,
                  frag_cache=None, frag_key=None, frag_note=None,
-                 skew_watch=None):
+                 skew_watch=None, mem_pool=None):
         assert join_type in ("inner", "left", "semi", "anti")
         # filter-only fused segment (exec/fusion.FusedSegment) ANDed into the
         # probe live mask INSIDE the probe kernels: the WHERE above the probe
@@ -1046,6 +1061,9 @@ class HashJoinOp(Operator):
         # hash to disk and joins bucket pairs (HybridHashJoinExec analog)
         self.spill_threshold = spill_threshold
         self.grace_partitions = 0  # observable spill counter (tests)
+        # per-query memory pool: accumulated build bytes charge it;
+        # exhaustion or a squeeze revoke engages the grace path early
+        self.mem_pool = mem_pool
         self.enable_bloom = enable_bloom  # NO_BLOOM hint disables runtime filters
         # planned runtime filters (exec/runtime_filter): once the build side
         # materializes, publish bloom/min-max filters for probe-side scans
@@ -1638,53 +1656,62 @@ class HashJoinOp(Operator):
             yield from self._device_probe(build_batch, art, stored=True)
             return
         # accumulate the build side batch-by-batch; crossing the spill
-        # threshold hands the ALREADY-collected prefix plus the still-unread
+        # threshold — or exhausting the per-query memory pool, or a squeeze
+        # revoke — hands the ALREADY-collected prefix plus the still-unread
         # remainder to the grace path, so peak memory stays ~threshold (the
         # full build is never concatenated first)
+        from galaxysql_tpu.exec.memory import PoolCharge
         build_parts: List[ColumnBatch] = []
         build_bytes = 0
-        build_iter = iter(self.build.batches())
-        for b in build_iter:
-            build_parts.append(b)
-            build_bytes += _batch_bytes(b)
-            if build_bytes > self.spill_threshold:
-                # grace spill: the build never materializes in one piece, so
-                # no filter is published (and nothing is cached) — absent
-                # filters pass everything
-                yield from self._grace_batches(build_parts, build_iter)
+        charge = PoolCharge(self.mem_pool)
+        try:
+            build_iter = iter(self.build.batches())
+            for b in build_iter:
+                build_parts.append(b)
+                build_bytes += _batch_bytes(b)
+                if build_bytes > self.spill_threshold or \
+                        not charge.to(build_bytes) or charge.squeeze:
+                    # grace spill: the build never materializes in one
+                    # piece, so no filter is published (and nothing is
+                    # cached) — absent filters pass everything
+                    charge.to(0)
+                    yield from self._grace_batches(build_parts, build_iter)
+                    return
+            build_batch = concat_batches(build_parts)
+            # planned runtime filters publish HERE — before any probe pull, so
+            # probe-side scans (lazy generators) see the filter on first batch.
+            # An empty build publishes pass-NOTHING filters, never pass-all.
+            if self.rf_publish:
+                from galaxysql_tpu.exec import runtime_filter as _rf
+                _rf.publish_from_batch(self.rf_manager, self.rf_publish,
+                                       build_batch)
+            if K.prefer_scatter() and build_batch.capacity:
+                # CPU: every downstream build-side cost (CSR bincount domain,
+                # slot table size M, verify gathers) scales with CAPACITY,
+                # and a build side gathered out of an upstream join is mostly
+                # dead rows — host-compact first (sub-ms at build sizes)
+                build_batch = build_batch.compact()
+            if self.skew_watch and build_batch.capacity and K.prefer_scatter():
+                # heavy-hitter refresh from the lanes this pass just
+                # materialized on the host; the TPU path skips (lanes are
+                # device-resident and the refresh must never add a sync)
+                self._observe_skew(build_batch)
+            art = self._frag_admit(build_batch)
+            if build_batch.capacity == 0:
+                if art is not None:
+                    self._frag_store(art)
+                yield from self._empty_build_batches()
                 return
-        build_batch = concat_batches(build_parts)
-        # planned runtime filters publish HERE — before any probe pull, so
-        # probe-side scans (lazy generators) see the filter on first batch.
-        # An empty build publishes pass-NOTHING filters, never pass-all.
-        if self.rf_publish:
-            from galaxysql_tpu.exec import runtime_filter as _rf
-            _rf.publish_from_batch(self.rf_manager, self.rf_publish,
-                                   build_batch)
-        if K.prefer_scatter() and build_batch.capacity:
-            # CPU: every downstream build-side cost (CSR bincount domain, slot
-            # table size M, verify gathers) scales with CAPACITY, and a build
-            # side gathered out of an upstream join is mostly dead rows —
-            # host-compact first (sub-ms at build sizes)
-            build_batch = build_batch.compact()
-        if self.skew_watch and build_batch.capacity and K.prefer_scatter():
-            # heavy-hitter refresh from the lanes this pass just materialized
-            # on the host; the TPU path skips (lanes are device-resident and
-            # the refresh must never add a sync)
-            self._observe_skew(build_batch)
-        art = self._frag_admit(build_batch)
-        if build_batch.capacity == 0:
+            if K.prefer_scatter() and _native.AVAILABLE:
+                yield from self._native_batches(build_batch, art)
+                return
+            build_batch = build_batch.pad_to(
+                bucket_capacity(build_batch.capacity))
             if art is not None:
-                self._frag_store(art)
-            yield from self._empty_build_batches()
-            return
-        if K.prefer_scatter() and _native.AVAILABLE:
-            yield from self._native_batches(build_batch, art)
-            return
-        build_batch = build_batch.pad_to(bucket_capacity(build_batch.capacity))
-        if art is not None:
-            art.batch = build_batch  # cache the padded device-resident form
-        yield from self._device_probe(build_batch, art, stored=False)
+                art.batch = build_batch  # cache the padded device form
+            yield from self._device_probe(build_batch, art, stored=False)
+        finally:
+            charge.close()
 
     def _device_probe(self, build_batch: ColumnBatch, art,
                       stored: bool) -> Iterator[ColumnBatch]:
@@ -1845,13 +1872,16 @@ class SortOp(Operator):
     def __init__(self, child: Operator,
                  keys: Sequence[Tuple[ir.Expr, bool]],  # (expr, descending)
                  limit: Optional[int] = None, offset: int = 0,
-                 spill_threshold: int = 256 << 20):
+                 spill_threshold: int = 256 << 20, mem_pool=None):
         self.child = child
         self.keys = list(keys)
         self.limit = limit
         self.offset = offset
         self.spill_threshold = spill_threshold
         self.spilled_runs = 0  # observable spill counter (tests, EXPLAIN)
+        # per-query memory pool: slab bytes charge it; exhaustion or a
+        # squeeze revoke flushes the slab into a sorted run early
+        self.mem_pool = mem_pool
 
     def _compiled(self):
         from galaxysql_tpu.types import collation as _coll
@@ -1906,19 +1936,24 @@ class SortOp(Operator):
         return global_jit(key, build)
 
     def batches(self) -> Iterator[ColumnBatch]:
+        from galaxysql_tpu.exec.memory import PoolCharge
         from galaxysql_tpu.exec.spill import Spiller
         slab: List[ColumnBatch] = []
         slab_bytes = 0
         spiller = Spiller()
+        charge = PoolCharge(self.mem_pool)
         run_meta: List[int] = []  # row count per spilled run
         try:
             for b in self.child.batches():
                 slab.append(b)
                 slab_bytes += _batch_bytes(b)
-                if slab_bytes > self.spill_threshold:
+                if slab_bytes > self.spill_threshold or \
+                        not charge.to(slab_bytes) or charge.squeeze:
                     self._spill_run(slab, spiller, run_meta)
                     slab = []
                     slab_bytes = 0
+                    charge.to(0)
+                    charge.squeeze = False
             if not run_meta:
                 merged = concat_batches(slab)
                 if merged.capacity == 0:
@@ -1932,6 +1967,7 @@ class SortOp(Operator):
             yield from self._merge_runs(spiller, run_meta)
         finally:
             spiller.close()
+            charge.close()
 
     # -- external sort -------------------------------------------------------
 

@@ -59,6 +59,17 @@ class SpillSpaceManager:
 SPILL_MANAGER = SpillSpaceManager()
 
 
+def _note_spill(nbytes: int):
+    """Typed-registry spill observability (utils/metrics.py process-shared
+    counters): SHOW METRICS / Prometheus see total spill volume, and the
+    statement-summary counter bracket attributes per-query deltas to the
+    digest — a regressed digest whose windows carry spill bytes explains
+    itself (memory pressure, not a plan change)."""
+    from galaxysql_tpu.utils.metrics import SPILL_BYTES, SPILL_FILES
+    SPILL_BYTES.inc(int(nbytes))
+    SPILL_FILES.inc()
+
+
 class Spiller:
     """Writes arrays-dicts to spill files; streams them back; cleans up on close."""
 
@@ -73,6 +84,7 @@ class Spiller:
         nbytes = os.path.getsize(path)
         self.manager.charge(nbytes)
         self.files.append((path, nbytes))
+        _note_spill(nbytes)
         return nbytes
 
     def read_all(self) -> Iterator[Dict[str, np.ndarray]]:
@@ -108,6 +120,7 @@ class Spiller:
             json.dump(manifest, f)
         self.manager.charge(total)
         self.dirs.append((base, total))
+        _note_spill(total)
         return len(self.dirs) - 1
 
     def open_mmap(self, run_ix: int) -> Dict[str, np.ndarray]:

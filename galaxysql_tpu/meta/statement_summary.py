@@ -132,13 +132,14 @@ def counters_snapshot(instance) -> tuple:
     from galaxysql_tpu.exec.operators import COMPILE_STATS
     from galaxysql_tpu.exec.runtime_filter import RF_STATS
     from galaxysql_tpu.utils.events import EVENTS
-    from galaxysql_tpu.utils.metrics import RPC_RETRIES
+    from galaxysql_tpu.utils.metrics import RPC_RETRIES, SPILL_BYTES
     fc = getattr(instance, "frag_cache", None)
     return (COMPILE_STATS["retraces"],
             fc.hits if fc is not None else 0,
             RF_STATS["rows_pruned"],
             EVENTS._counts.get("skew_activate", 0),  # GIL-atomic dict read
-            RPC_RETRIES.value)
+            RPC_RETRIES.value,
+            SPILL_BYTES.value)
 
 
 def counters_delta(base: Optional[tuple], instance) -> Optional[dict]:
@@ -148,13 +149,16 @@ def counters_delta(base: Optional[tuple], instance) -> Optional[dict]:
     return {"retraces": now[0] - base[0], "frag_hits": now[1] - base[1],
             "rf_rows_pruned": now[2] - base[2],
             "skew_activations": now[3] - base[3],
-            "rpc_retries": now[4] - base[4]}
+            "rpc_retries": now[4] - base[4],
+            # spill attribution: a regressed digest whose windows show spill
+            # bytes explains ITSELF (memory pressure, not a plan change)
+            "spill_bytes": (now[5] - base[5]) if len(base) > 5 else 0}
 
 
 # -- aggregation structures ----------------------------------------------------
 
 _EXTRA_KEYS = ("retraces", "frag_hits", "rf_rows_pruned", "skew_activations",
-               "rpc_retries")
+               "rpc_retries", "spill_bytes")
 
 
 class _Bucket:
@@ -661,7 +665,8 @@ class StatementSummaryStore:
                         agg.rows_returned, agg.rows_examined,
                         ex["retraces"], ex["frag_hits"],
                         ex["rf_rows_pruned"], ex["skew_activations"],
-                        ex["rpc_retries"], agg.peak_rss_kb,
+                        ex["rpc_retries"], ex["spill_bytes"],
+                        agg.peak_rss_kb,
                         1 if agg.flagged else 0,
                         agg.orders, e.sample_sql)))
         out.sort(key=lambda t: -t[0])  # hottest = most total time consumed
@@ -684,7 +689,8 @@ class StatementSummaryStore:
                             b.rows_examined, b.extras["retraces"],
                             b.extras["frag_hits"],
                             b.extras["rf_rows_pruned"],
-                            b.extras["rpc_retries"], e.sample_sql[:128]))
+                            b.extras["rpc_retries"],
+                            b.extras["spill_bytes"], e.sample_sql[:128]))
         out.sort(key=lambda r: (-r[3], r[0], r[2]))
         return out
 

@@ -171,6 +171,51 @@ def _retry_safe(header: dict) -> bool:
     return False
 
 
+class RetryBudget:
+    """Token bucket bounding retry attempts per worker endpoint.
+
+    Under saturation every retry is ADDED load on a box already failing to
+    keep up — unbounded retries turn one slow worker into a metastable storm
+    (the whole fleet re-sending the same work).  Each retry attempt takes one
+    token; tokens refill at a steady rate, so a brief blip retries freely
+    while a sustained failure quickly degrades to fail-fast typed errors.
+    Locked, but only touched on the failure path — never on a healthy RPC."""
+
+    def __init__(self, capacity: int = 64, refill_per_s: float = 8.0):
+        self.capacity = max(0, int(capacity))
+        self.refill_per_s = max(0.0, float(refill_per_s))
+        self._tokens = float(self.capacity)
+        self._at = time.monotonic()
+        self._lock = threading.Lock()
+        self.exhausted = 0  # lifetime fail-fast count (SHOW WORKERS)
+
+    def _refill_locked(self, now: float):
+        self._tokens = min(float(self.capacity),
+                           self._tokens + (now - self._at) * self.refill_per_s)
+        self._at = now
+
+    def configure(self, capacity: int, refill_per_s: float):
+        with self._lock:
+            self._refill_locked(time.monotonic())
+            self.capacity = max(0, int(capacity))
+            self.refill_per_s = max(0.0, float(refill_per_s))
+            self._tokens = min(self._tokens, float(self.capacity))
+
+    def try_take(self) -> bool:
+        with self._lock:
+            self._refill_locked(time.monotonic())
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            self.exhausted += 1
+            return False
+
+    def remaining(self) -> float:
+        with self._lock:
+            self._refill_locked(time.monotonic())
+            return self._tokens
+
+
 class WorkerClient:
     """Coordinator-side connection to one worker process (one socket, locked:
     the protocol is strictly request/response)."""
@@ -206,6 +251,17 @@ class WorkerClient:
         self.stat_failures = 0
         self.stat_opens = 0
         self.last_error = ""
+        # retry budget (token bucket): each retry attempt takes one token;
+        # empty bucket -> fail typed instead of retrying (no retry storms).
+        # Live-config clients re-read the knobs on each take.
+        self.retry_budget = RetryBudget(
+            int(self._param("RPC_RETRY_BUDGET", 64)),
+            float(self._param("RPC_RETRY_REFILL_PER_S", 8)))
+        # worker-piggybacked load (queue depth + memory tier from RPC
+        # replies): routing deprioritizes pressured endpoints
+        self.load_q = 0
+        self.load_tier = 0
+        self.load_at = 0.0
         # sync-epoch plane: bound by SyncBus.attach; adds {se, origin} to
         # every request so the worker can detect missed broadcasts
         self._sync_bus = None
@@ -535,6 +591,33 @@ class WorkerClient:
                             f"failed after {attempt + 1} attempt(s): "
                             f"{type(e).__name__}: {e}",
                             sent=any_sent) from e
+                    if self._cfg is not None:
+                        # live knobs: SET GLOBAL RPC_RETRY_BUDGET applies to
+                        # attached workers (failure path only — never paid
+                        # on a healthy RPC)
+                        self.retry_budget.configure(
+                            int(self._param("RPC_RETRY_BUDGET", 64)),
+                            float(self._param("RPC_RETRY_REFILL_PER_S", 8)))
+                    if not self.retry_budget.try_take():
+                        # budget empty: retrying now only amplifies the
+                        # overload — fail typed instead (no retry storm)
+                        from galaxysql_tpu.utils.metrics import \
+                            RETRY_BUDGET_EXHAUSTED
+                        RETRY_BUDGET_EXHAUSTED.inc()
+                        RPC_FAILURES.inc()
+                        from galaxysql_tpu.utils import events
+                        events.publish(
+                            "retry_budget_exhausted",
+                            f"worker {self.addr[0]}:{self.addr[1]}: retry "
+                            f"budget exhausted; rpc:{op} fails without "
+                            f"retry",
+                            dedupe=f"rb-{self.addr[0]}:{self.addr[1]}",
+                            worker=f"{self.addr[0]}:{self.addr[1]}")
+                        raise errors.WorkerUnavailableError(
+                            f"worker {self.addr[0]}:{self.addr[1]} rpc:{op} "
+                            f"retry budget exhausted after {attempt + 1} "
+                            f"attempt(s): {type(e).__name__}: {e}",
+                            sent=any_sent) from e
                     with self._bk_lock:
                         self.stat_retries += 1
                     RPC_RETRIES.inc()
@@ -550,6 +633,18 @@ class WorkerClient:
             if rpc_span is not None:
                 tc.end(rpc_span)
         RPC_RTT_MS.observe(rtt_ms)
+        wl = resp.pop("wl", None)
+        if wl is not None:
+            # worker-piggybacked backpressure: queue depth + memory tier ride
+            # every reply, so routing deprioritizes pressured endpoints
+            # without any extra probe RPC (plain attribute writes — readers
+            # tolerate benign races)
+            try:
+                self.load_q = int(wl.get("q", 0))
+                self.load_tier = int(wl.get("mt", 0))
+                self.load_at = time.time()
+            except (TypeError, ValueError, AttributeError):
+                pass  # malformed piggyback must never fail a data request
         if rpc_span is not None:
             self._graft_trace(tc, rpc_span, resp, t_send, t_recv)
         if resp.get("error"):

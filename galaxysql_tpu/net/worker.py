@@ -31,7 +31,8 @@ from typing import Dict, Optional
 import numpy as np
 
 from galaxysql_tpu.net.dn import recv_msg, send_msg
-from galaxysql_tpu.utils.failpoint import FAIL_POINTS, FP_WORKER_CRASH
+from galaxysql_tpu.utils.failpoint import (FAIL_POINTS, FP_WORKER_CRASH,
+                                           FP_WORKER_SLOW_DRAIN)
 
 
 class Worker:
@@ -74,6 +75,9 @@ class Worker:
         # (persisted in the metadb so a restart keeps the gap detector armed)
         self._sync_epochs: Dict[str, int] = {}
         self.heals = 0
+        # in-flight request tokens (GIL-atomic list ops): the queue-depth
+        # half of the backpressure piggyback every reply carries
+        self._active: list = []
 
     # -- request handlers ----------------------------------------------------
 
@@ -83,6 +87,35 @@ class Worker:
             print(f"FP_WORKER_CRASH fired on {header.get('op')}",
                   file=sys.stderr, flush=True)
             _os._exit(137)  # hard crash: no atexit, no flush — chaos realism
+        self._active.append(None)
+        try:
+            if FAIL_POINTS.active:
+                # overload harness: a busy/brownout worker (slow drain) —
+                # still alive, still correct, just late; breakers stay
+                # closed while queue depth and RTT climb.  The sleep sits
+                # INSIDE the active bracket so browned-out requests are
+                # visible to the queue-depth piggyback.
+                spec = FAIL_POINTS.rpc_spec(FP_WORKER_SLOW_DRAIN,
+                                            header.get("op"))
+                if spec is not None:
+                    _time.sleep(float(spec.get("ms", 25.0)) / 1000.0)
+            resp, out = self._handle_epochs(header, arrays)
+        finally:
+            try:
+                self._active.pop()
+            except IndexError:  # pragma: no cover - bracket imbalance guard
+                pass
+        if isinstance(resp, dict):
+            # backpressure piggyback: queue depth + memory-pressure tier ride
+            # every reply (one list len + one pool division — no syncs)
+            try:
+                resp["wl"] = {"q": len(self._active),
+                              "mt": self.instance.admission.governor.tier()}
+            except Exception:
+                pass  # load telemetry must never fail a data request
+        return resp, out
+
+    def _handle_epochs(self, header: dict, arrays: Dict[str, np.ndarray]):
         origin, se = header.get("origin"), header.get("se")
         be = header.get("bcast_epoch")
         want_heal = bool(header.get("heal"))  # coordinator-tracked miss

@@ -49,6 +49,11 @@ class ExecContext:
         self.remote_xids: Dict = {}
         self.sort_spill_bytes = 256 << 20   # SORT_SPILL_BYTES (session override)
         self.join_spill_bytes = 256 << 20   # JOIN_SPILL_BYTES
+        self.agg_spill_bytes = 256 << 20    # partial-agg spill threshold
+        # per-query memory pool (exec/memory.py child of GLOBAL_POOL): join
+        # build / agg partial / sort slab reservations charge it; None keeps
+        # every operator charge a no-op (admission disabled, bare contexts)
+        self.mem_pool = None
         self.collect_stats = False       # EXPLAIN ANALYZE / profiling stats
         self.op_stats: List[dict] = []   # filled by StatsOp when collecting
         self.profile = None              # owning QueryProfile (utils/tracing)
@@ -735,7 +740,8 @@ def _build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
                 ctx.trace.append(f"fuse-agg-prelude {prelude.chain}")
         agg = ops.HashAggOp(build_operator(child_node, ctx),
                             node.groups, calls, max_groups=max_groups,
-                            prelude=prelude)
+                            spill_threshold=ctx.agg_spill_bytes,
+                            prelude=prelude, mem_pool=ctx.mem_pool)
         # the aggregate is a pipeline breaker with a DETERMINISTIC, usually
         # tiny output: fragment-cache it (version-keyed, same rules as join
         # builds), so a warm repeated query replays grouped rows instead of
@@ -756,7 +762,8 @@ def _build_operator(node: L.RelNode, ctx: ExecContext) -> ops.Operator:
     if isinstance(node, L.Sort):
         return ops.SortOp(build_operator(node.child, ctx), node.keys,
                           node.limit, node.offset,
-                          spill_threshold=ctx.sort_spill_bytes)
+                          spill_threshold=ctx.sort_spill_bytes,
+                          mem_pool=ctx.mem_pool)
     if isinstance(node, L.Limit):
         return ops.LimitOp(build_operator(node.child, ctx), node.limit, node.offset)
     if isinstance(node, L.Union):
@@ -961,7 +968,8 @@ def _build_join(node: L.Join, ctx: ExecContext) -> ops.Operator:
                               spill_threshold=ctx.join_spill_bytes,
                               rf_publish=rf_specs, rf_manager=rf_mgr,
                               frag_cache=cache, frag_key=fkey, frag_note=note,
-                              skew_watch=_skew_watch(node.right, rkeys, ctx))
+                              skew_watch=_skew_watch(node.right, rkeys, ctx),
+                              mem_pool=ctx.mem_pool)
     # inner: build the smaller estimated side
     l_est = estimate_rows(node.left)
     r_est = estimate_rows(node.right)
@@ -986,4 +994,5 @@ def _build_join(node: L.Join, ctx: ExecContext) -> ops.Operator:
                           probe_prelude=prelude,
                           rf_publish=rf_specs, rf_manager=rf_mgr,
                           frag_cache=cache, frag_key=fkey, frag_note=note,
-                          skew_watch=_skew_watch(build_node, build_keys, ctx))
+                          skew_watch=_skew_watch(build_node, build_keys, ctx),
+                          mem_pool=ctx.mem_pool)

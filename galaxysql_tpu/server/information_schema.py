@@ -69,7 +69,17 @@ _DEFS: Dict[str, List] = {
     # retry/failure counters (net/dn.WorkerClient; SHOW WORKERS twin)
     "workers": [("host", _V), ("port", _I), ("breaker_state", _V),
                 ("fenced", _I), ("consec_failures", _I), ("retries", _I),
-                ("failures", _I), ("breaker_opens", _I), ("last_error", _V)],
+                ("failures", _I), ("breaker_opens", _I), ("last_error", _V),
+                ("retry_budget", _I)],
+    # admission control + memory governance (server/admission.py):
+    # per-class limits/in-flight/queue depth, shed counters, pressure tier,
+    # retry-budget headroom — SHOW ADMISSION twin
+    "admission_stats": [("stat_name", _V), ("value", _D)],
+    # CCL rule states (utils/ccl.py; SHOW CCL_RULES twin) — rules are
+    # SQL-manageable via CREATE/DROP CCL_RULE
+    "ccl_rules": [("rule_name", _V), ("max_concurrency", _I),
+                  ("keyword", _V), ("user", _V), ("running", _I),
+                  ("waiting", _I), ("matched", _I), ("rejected", _I)],
     # statement-digest store (meta/statement_summary.py): per digest x plan
     # fingerprint aggregates — SHOW STATEMENT SUMMARY twin
     "statement_summary": [
@@ -78,7 +88,8 @@ _DEFS: Dict[str, List] = {
         ("avg_latency_ms", _D), ("p95_latency_ms", _D),
         ("p99_latency_ms", _D), ("rows_returned", _I), ("rows_examined", _I),
         ("retraces", _I), ("frag_cache_hits", _I), ("rf_rows_pruned", _I),
-        ("skew_activations", _I), ("rpc_retries", _I), ("peak_rss_kb", _I),
+        ("skew_activations", _I), ("rpc_retries", _I), ("spill_bytes", _I),
+        ("peak_rss_kb", _I),
         ("regressed", _I), ("join_order", _V), ("sample_sql", _V)],
     # time-bucketed windows per digest x plan (SHOW STATEMENT SUMMARY
     # HISTORY twin), newest bucket first
@@ -88,7 +99,7 @@ _DEFS: Dict[str, List] = {
         ("avg_latency_ms", _D), ("min_latency_ms", _D),
         ("max_latency_ms", _D), ("rows_returned", _I), ("rows_examined", _I),
         ("retraces", _I), ("frag_cache_hits", _I), ("rf_rows_pruned", _I),
-        ("rpc_retries", _I), ("sample_sql", _V)],
+        ("rpc_retries", _I), ("spill_bytes", _I), ("sample_sql", _V)],
     # typed instance-event journal (utils/events.py; SHOW EVENTS twin)
     "events": [("seq", _I), ("at", _D), ("kind", _V), ("severity", _V),
                ("node", _V), ("detail", _V), ("attrs", _V)],
@@ -215,6 +226,14 @@ def refresh(instance, session=None):
     fill("batch_stats", ([n, float(v)] for n, v in
                          (sched.stats_rows() if sched is not None else [])))
     fill("workers", (list(r) for r in instance.worker_rows()))
+    adm = getattr(instance, "admission", None)
+    fill("admission_stats", ([n, float(v)] for n, v in
+                             (adm.stats_rows() if adm is not None else [])))
+    from galaxysql_tpu.utils.ccl import GLOBAL_CCL
+    fill("ccl_rules", ([st.rule.name, st.rule.max_concurrency,
+                        st.rule.keyword or "", st.rule.user or "",
+                        st.running, st.waiting, st.total_matched,
+                        st.total_rejected] for st in GLOBAL_CCL.rules()))
     ss = getattr(instance, "stmt_summary", None)
     fill("statement_summary",
          (list(r) for r in (ss.rows() if ss is not None else [])))

@@ -68,8 +68,10 @@ class Instance:
         from galaxysql_tpu.utils.metrics import (BATCH_GROUP_SIZE,
                                                  BATCH_WAIT_MS, BREAKER_OPENS,
                                                  MetricsRegistry, QUERY_TIMEOUTS,
+                                                 RETRY_BUDGET_EXHAUSTED,
                                                  RPC_FAILURES, RPC_RETRIES,
                                                  RPC_RTT_MS, SEGMENT_WALL_MS,
+                                                 SPILL_BYTES, SPILL_FILES,
                                                  SYNC_FAILURES, SYNC_HEALS,
                                                  WORKER_FAILOVERS)
         from galaxysql_tpu.utils.tracing import ProfileRing, TraceIdAllocator
@@ -87,7 +89,8 @@ class Instance:
         # fault-tolerance plane counters (net/dn.py retry/breaker, SyncBus
         # healing, deadline kills) — process-shared, surfaced per instance
         for m in (RPC_RETRIES, RPC_FAILURES, BREAKER_OPENS, WORKER_FAILOVERS,
-                  SYNC_FAILURES, SYNC_HEALS, QUERY_TIMEOUTS):
+                  SYNC_FAILURES, SYNC_HEALS, QUERY_TIMEOUTS,
+                  RETRY_BUDGET_EXHAUSTED, SPILL_BYTES, SPILL_FILES):
             self.metrics.adopt(m)
         self.metrics.histogram("query_latency_ms",
                                "end-to-end query latency (ms)")
@@ -127,6 +130,11 @@ class Instance:
         # window coalesce into one vectorized dispatch per partition
         from galaxysql_tpu.server.batch_scheduler import BatchScheduler
         self.batch_scheduler = BatchScheduler(self)
+        # overload plane (server/admission.py): workload-class admission gate
+        # (AIMD limits, deadline-aware shedding) + the memory-pressure
+        # governor (tiered fragment-cache/spill/AP-refusal responses)
+        from galaxysql_tpu.server.admission import AdmissionController
+        self.admission = AdmissionController(self)
         from galaxysql_tpu.server.maintain import RecycleBin
         self.recycle = RecycleBin(self)
         self.lock = threading.RLock()
@@ -272,10 +280,12 @@ class Instance:
             bk = client.breaker_snapshot() if hasattr(client, "breaker_snapshot") \
                 else {"state": "closed", "consec_failures": 0, "opens": 0,
                       "retries": 0, "failures": 0, "last_error": ""}
+            budget = getattr(client, "retry_budget", None)
             rows.append((host, port, bk["state"],
                          1 if self.ha.worker_fenced((host, port)) else 0,
                          bk["consec_failures"], bk["retries"], bk["failures"],
-                         bk["opens"], bk["last_error"]))
+                         bk["opens"], bk["last_error"],
+                         int(budget.remaining()) if budget is not None else 0))
         return rows
 
     def attach_remote_table(self, schema: str, name: str, host: str,
@@ -559,6 +569,22 @@ class Instance:
         if not live:
             raise _errors.WorkerUnavailableError(
                 f"remote table {tm.name}: every endpoint is fenced/unattached")
+        # backpressure-aware weighting: endpoints that piggybacked a deep
+        # queue or an elevated memory tier in recent replies are
+        # deprioritized (never excluded — a uniformly-pressured fleet must
+        # still serve).  Stale load reports (>5s) decay to neutral.
+        import time as _t
+        now = _t.time()
+
+        def _load_weight(a, w):
+            c = self.workers.get(a)
+            if c is None or now - getattr(c, "load_at", 0.0) > 5.0:
+                return float(w)
+            penalty = 1.0 + getattr(c, "load_q", 0) \
+                + 4.0 * getattr(c, "load_tier", 0)
+            return float(w) / penalty
+
+        live = [(a, _load_weight(a, w)) for a, w in live]
         total = sum(w for _, w in live)
         pick = random.random() * total
         for a, w in live:

@@ -256,12 +256,22 @@ class Session:
             # in-flight DML
             keys = {f"{(t.schema or self._require_schema()).lower()}"
                     f".{t.table.lower()}" for t in self._stmt_tables(stmt)}
-            with self.instance.mdl.shared(keys):
-                if isinstance(stmt, ast.Insert):
-                    return self._run_insert(stmt, params)
-                if isinstance(stmt, ast.Update):
-                    return self._run_update(stmt, params)
-                return self._run_delete(stmt, params)
+            # DML rides the admission gate too (TP class): under overload a
+            # write queue must degrade typed, not pile unboundedly onto the
+            # store locks
+            ticket = self.instance.admission.admit(self, sql or "")
+            try:
+                with self.instance.mdl.shared(keys):
+                    if isinstance(stmt, ast.Insert):
+                        return self._run_insert(stmt, params)
+                    if isinstance(stmt, ast.Update):
+                        return self._run_update(stmt, params)
+                    return self._run_delete(stmt, params)
+            except Exception:
+                ticket.release(error=True)
+                raise
+            finally:
+                ticket.release()
         if isinstance(stmt, ast.CreateTable):
             return self._run_create_table(stmt)
         if isinstance(stmt, ast.DropTable):
@@ -313,6 +323,25 @@ class Session:
             return self._run_advise_index(stmt, params)
         if isinstance(stmt, ast.KillStmt):
             return ok(info="kill acknowledged")
+        if isinstance(stmt, ast.CreateCclRule):
+            from galaxysql_tpu.utils.ccl import CclRule
+            if any(st.rule.name.lower() == stmt.name.lower()
+                   for st in GLOBAL_CCL.rules()):
+                # silent replacement would zero the live rule's counters and
+                # orphan in-flight admissions' slot state — DDL semantics:
+                # error unless IF NOT EXISTS asked to keep the existing rule
+                if stmt.if_not_exists:
+                    return ok()
+                raise errors.TddlError(
+                    f"CCL rule '{stmt.name}' already exists")
+            GLOBAL_CCL.add_rule(CclRule(
+                stmt.name, stmt.max_concurrency, stmt.keyword, stmt.user,
+                stmt.wait_queue_size, stmt.wait_timeout_ms))
+            return ok()
+        if isinstance(stmt, ast.DropCclRule):
+            if not GLOBAL_CCL.drop_rule(stmt.name) and not stmt.if_exists:
+                raise errors.TddlError(f"unknown CCL rule '{stmt.name}'")
+            return ok()
         if isinstance(stmt, ast.BaselineStmt):
             return self._run_baseline(stmt)
         if isinstance(stmt, ast.LoadData):
@@ -624,15 +653,21 @@ class Session:
                 schema.lower() == "information_schema":
             from galaxysql_tpu.server import information_schema
             information_schema.refresh(self.instance, self)
-        admission = GLOBAL_CCL.admit(self, sql or "")
+        # overload plane first (typed ServerOverloadError shed, lock-free
+        # when idle), then the rule-matched CCL gate; both release on the
+        # single exit ramp below (idempotent handles — the exception paths
+        # may cross release sites)
+        ticket = self.instance.admission.admit(self, sql or "")
+        admission = None
         tc = None
-        if self._tracing_enabled():
-            tc = tracing.TraceContext(prof.trace_id,
-                                      node=self.instance.node_id)
-            prof.spans = tc.spans  # alias: the ring sees spans as they land
-        else:
-            self.last_spans = []  # SHOW TRACE must not show a stale tree
         try:
+            admission = GLOBAL_CCL.admit(self, sql or "")
+            if self._tracing_enabled():
+                tc = tracing.TraceContext(prof.trace_id,
+                                          node=self.instance.node_id)
+                prof.spans = tc.spans  # alias: the ring sees spans as they land
+            else:
+                self.last_spans = []  # SHOW TRACE must not show a stale tree
             if tc is None:
                 return self._run_query_admitted(stmt, sql, params, schema,
                                                 t0, prof)
@@ -647,7 +682,9 @@ class Session:
             self._record_query_error(sql, t0, prof, e, tc)
             raise
         finally:
-            admission.release()
+            if admission is not None:
+                admission.release()
+            ticket.release(prof)
 
     def _finish_trace(self, tc):
         """Close out a traced query: stamp device telemetry on the root span
@@ -730,6 +767,19 @@ class Session:
                                                         self.vars)
         ctx.join_spill_bytes = self.instance.config.get("JOIN_SPILL_BYTES",
                                                         self.vars)
+        # resource governance (server/admission.py): a per-query memory-pool
+        # child charges hash-join build / agg partial / sort slab bytes
+        # against the global hierarchy, and memory-pressure tiers lower the
+        # effective spill thresholds so pressured queries trade disk for
+        # headroom (NORMAL scale is 1.0 — the steady state pays one compare)
+        adm = getattr(self.instance, "admission", None)
+        governed = adm is not None and adm.enabled(self, sql or "")
+        if governed:
+            scale = adm.governor.spill_scale()
+            if scale != 1.0:
+                ctx.sort_spill_bytes = int(ctx.sort_spill_bytes * scale)
+                ctx.join_spill_bytes = int(ctx.join_spill_bytes * scale)
+                ctx.agg_spill_bytes = int(ctx.agg_spill_bytes * scale)
         # session-scoped SET ENABLE_SKEW_EXECUTION (the ctx default only sees
         # instance scope)
         from galaxysql_tpu.exec import skew as _skew
@@ -757,8 +807,23 @@ class Session:
         from galaxysql_tpu.plan import logical as L
         mdl_keys = {f"{n.table.schema.lower()}.{n.table.name.lower()}"
                     for n in L.walk(plan.rel) if isinstance(n, L.Scan)}
-        with self.instance.mdl.shared(mdl_keys):
-            return self._run_query_locked(plan, ctx, sql, t0, prof)
+        if governed:
+            # created immediately before the try that closes it: an
+            # exception between creation and teardown would leak the child
+            # onto GLOBAL_POOL.children for the process lifetime
+            from galaxysql_tpu.exec.memory import query_pool
+            ctx.mem_pool = query_pool(
+                self.conn_id,
+                int(self.instance.config.get("QUERY_MEM_BYTES", self.vars)
+                    or (4 << 30)))
+        try:
+            with self.instance.mdl.shared(mdl_keys):
+                return self._run_query_locked(plan, ctx, sql, t0, prof)
+        finally:
+            # per-query pool teardown: releases any bytes a failed operator
+            # left reserved and unlinks from the global hierarchy
+            if ctx.mem_pool is not None:
+                ctx.mem_pool.close()
 
     # -- point-plan fast path (DirectShardingKeyTableOperation / XPlan key-Get
     # analog, Planner.java:914): archetypal `SELECT cols FROM t WHERE pk = ?`

@@ -203,20 +203,22 @@ def handle(session, stmt: ast.Show):
                 ["Digest", "Schema", "Plan", "Window_start", "Execs",
                  "Errors", "Avg_ms", "Min_ms", "Max_ms", "Rows_returned",
                  "Rows_examined", "Retraces", "Frag_hits", "Rf_rows_pruned",
-                 "Rpc_retries", "SQL"],
+                 "Rpc_retries", "Spill_bytes", "SQL"],
                 [dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.BIGINT, dt.BIGINT,
                  dt.BIGINT, dt.DOUBLE, dt.DOUBLE, dt.DOUBLE, dt.BIGINT,
                  dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.BIGINT,
-                 dt.VARCHAR], ss.history_rows())
+                 dt.BIGINT, dt.VARCHAR], ss.history_rows())
         return ResultSet(
             ["Digest", "Schema", "Plan", "Engines", "Execs", "Errors",
              "Avg_ms", "P95_ms", "P99_ms", "Rows_returned", "Rows_examined",
              "Retraces", "Frag_hits", "Rf_rows_pruned", "Skew_activations",
-             "Rpc_retries", "Peak_rss_kb", "Regressed", "Join_order", "SQL"],
+             "Rpc_retries", "Spill_bytes", "Peak_rss_kb", "Regressed",
+             "Join_order", "SQL"],
             [dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.BIGINT,
              dt.BIGINT, dt.DOUBLE, dt.DOUBLE, dt.DOUBLE, dt.BIGINT,
              dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.BIGINT,
-             dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.VARCHAR, dt.VARCHAR],
+             dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.VARCHAR,
+             dt.VARCHAR],
             ss.rows())
     if kind == "events":
         # SHOW EVENTS: the typed instance-event journal (utils/events.py) —
@@ -237,10 +239,19 @@ def handle(session, stmt: ast.Show):
         # plane's SQL surface; information_schema.workers twin)
         return ResultSet(
             ["Host", "Port", "Breaker", "Fenced", "Consec_failures",
-             "Retries", "Failures", "Breaker_opens", "Last_error"],
+             "Retries", "Failures", "Breaker_opens", "Last_error",
+             "Retry_budget"],
             [dt.VARCHAR, dt.BIGINT, dt.VARCHAR, dt.BIGINT, dt.BIGINT,
-             dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.VARCHAR],
+             dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.VARCHAR, dt.BIGINT],
             inst.worker_rows())
+    if kind == "admission":
+        # SHOW ADMISSION: the overload plane (server/admission.py) — per-class
+        # adaptive limits/in-flight/queue depth, shed counters, memory tier,
+        # retry-budget headroom (information_schema.admission_stats twin)
+        adm = getattr(inst, "admission", None)
+        rows = adm.stats_rows() if adm is not None else []
+        return ResultSet(["Stat", "Value"], [dt.VARCHAR, dt.DOUBLE],
+                         [(n, float(v)) for n, v in rows])
     if kind == "metrics":
         # the typed counter/gauge registry (information_schema.metrics twin)
         rows = [(n, k, float(v), h) for n, k, v, h in inst.metrics.rows()]

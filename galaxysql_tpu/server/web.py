@@ -106,12 +106,13 @@ class WebConsole:
                         "errors", "avg_ms", "p95_ms", "p99_ms",
                         "rows_returned", "rows_examined", "retraces",
                         "frag_hits", "rf_rows_pruned", "skew_activations",
-                        "rpc_retries", "peak_rss_kb", "regressed",
-                        "join_order", "sql"]
+                        "rpc_retries", "spill_bytes", "peak_rss_kb",
+                        "regressed", "join_order", "sql"]
             hist_cols = ["digest", "schema", "plan", "window_start", "execs",
                          "errors", "avg_ms", "min_ms", "max_ms",
                          "rows_returned", "rows_examined", "retraces",
-                         "frag_hits", "rf_rows_pruned", "rpc_retries", "sql"]
+                         "frag_hits", "rf_rows_pruned", "rpc_retries",
+                         "spill_bytes", "sql"]
             return {"top": ss.top_digests(k),
                     "statements": [dict(zip(sum_cols, r))
                                    for r in ss.rows()],

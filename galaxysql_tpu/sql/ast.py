@@ -535,6 +535,26 @@ class RevokeStmt(Statement):
 
 
 @dataclasses.dataclass
+class CreateCclRule(Statement):
+    """CREATE CCL_RULE name WITH MAX_CONCURRENCY = n [, KEYWORD = 's']
+    [, USER = 'u'] [, WAIT_QUEUE_SIZE = n] [, WAIT_TIMEOUT = ms] —
+    SQL-managed concurrency-control rules (utils/ccl.py GLOBAL_CCL)."""
+    name: str
+    max_concurrency: int
+    keyword: Optional[str] = None
+    user: Optional[str] = None
+    wait_queue_size: int = 64
+    wait_timeout_ms: int = 10_000
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass
+class DropCclRule(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
 class KillStmt(Statement):
     conn_id: int
     query_only: bool = False

@@ -22,6 +22,9 @@ behind them:
   batching (server/batch_scheduler.py).  Hinted statements never register
   PointPlans, so BATCH(OFF) structurally pins the statement to the planned
   (unbatched) path; the directive still parses so tools can round-trip it.
+- ADMISSION(OFF|ON)        per-statement control of the workload-class
+  admission gate (server/admission.py): OFF bypasses classification,
+  limits, queuing and shedding for this statement
 - MAX_EXECUTION_TIME(ms)   per-statement deadline (MySQL's optimizer-hint
   spelling): overrides the MAX_EXECUTION_TIME session param for this query;
   past-deadline execution dies with a typed QueryTimeoutError.
@@ -79,6 +82,13 @@ def parse_hints(comment: Optional[str]) -> Dict[str, object]:
             mode = arglist[0].lower()
             if mode in ("off", "on"):
                 out["batch"] = mode
+        elif name == "ADMISSION" and arglist:
+            # per-statement admission-control bypass (server/admission.py):
+            # OFF skips the gate entirely — the query neither classifies nor
+            # takes a class token (the maintenance-query escape hatch)
+            mode = arglist[0].lower()
+            if mode in ("off", "on"):
+                out["admission"] = mode
         elif name == "SKEW" and arglist:
             mode = arglist[0].lower()
             if mode in ("off", "join", "agg", "on"):

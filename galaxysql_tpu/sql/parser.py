@@ -928,6 +928,8 @@ class Parser:
             self.next()
             ine = self._if_not_exists()
             return ast.CreateDatabase(self.expect_ident(), ine)
+        if self.accept_kw("CCL_RULE"):
+            return self._create_ccl_rule()
         if self.accept_kw("USER"):
             ine = self._if_not_exists()
             user = self._user_name()
@@ -1329,8 +1331,51 @@ class Parser:
                 break
         return stmt
 
+    def _create_ccl_rule(self) -> ast.CreateCclRule:
+        """CREATE CCL_RULE [IF NOT EXISTS] name WITH opt = val [, ...] —
+        the SQL surface over utils/ccl.py (SHOW CCL_RULES reads it back)."""
+        ine = self._if_not_exists()
+        name = self.expect_ident()
+        stmt = ast.CreateCclRule(name, 1, if_not_exists=ine)
+        self.expect_kw("WITH")
+        saw_conc = False
+        while True:
+            opt = self.expect_ident().upper()
+            self.expect_op("=")
+            t = self.next()
+            if opt in ("MAX_CONCURRENCY", "WAIT_QUEUE_SIZE", "WAIT_TIMEOUT",
+                       "WAIT_TIMEOUT_MS"):
+                try:
+                    val = int(t.text)
+                except ValueError:
+                    raise self.error(f"CCL_RULE {opt} expects an integer")
+                if opt == "MAX_CONCURRENCY":
+                    stmt.max_concurrency = val
+                    saw_conc = True
+                elif opt == "WAIT_QUEUE_SIZE":
+                    stmt.wait_queue_size = val
+                else:
+                    stmt.wait_timeout_ms = val
+            elif opt == "KEYWORD":
+                stmt.keyword = t.text
+            elif opt == "USER":
+                stmt.user = t.text
+            else:
+                raise self.error(f"unknown CCL_RULE option {opt}")
+            if not self.accept_op(","):
+                break
+        if not saw_conc:
+            raise self.error("CCL_RULE requires MAX_CONCURRENCY")
+        return stmt
+
     def _drop(self) -> ast.Statement:
         self.expect_kw("DROP")
+        if self.accept_kw("CCL_RULE"):
+            ie = False
+            if self.accept_kw("IF"):
+                self.expect_kw("EXISTS")
+                ie = True
+            return ast.DropCclRule(self.expect_ident(), ie)
         if self.at_kw("DATABASE", "SCHEMA"):
             self.next()
             ie = False

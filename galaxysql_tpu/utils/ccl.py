@@ -107,6 +107,7 @@ class CclManager:
             with st.lock:
                 if st.waiting >= st.rule.wait_queue_size:
                     st.total_rejected += 1
+                    self._publish_reject(st, "queue_full")
                     raise errors.CclRejectError(
                         f"CCL rule '{st.rule.name}': wait queue full")
                 st.waiting += 1
@@ -118,10 +119,22 @@ class CclManager:
                 else:
                     st.running += 1
             if not ok:
+                self._publish_reject(st, "wait_timeout")
                 raise errors.CclRejectError(
                     f"CCL rule '{st.rule.name}': wait timeout")
             return _Admission(st)
         return _NO_ADMISSION
+
+    @staticmethod
+    def _publish_reject(st: _RuleState, reason: str):
+        """CCL rejects land in the typed event journal (deduped per
+        rule x reason so a flood cannot evict rarer events)."""
+        from galaxysql_tpu.utils import events
+        events.publish("ccl_reject",
+                       f"CCL rule '{st.rule.name}' rejected a query "
+                       f"({reason})",
+                       dedupe=f"ccl-{st.rule.name}-{reason}",
+                       rule=st.rule.name, reason=reason)
 
 
 GLOBAL_CCL = CclManager()

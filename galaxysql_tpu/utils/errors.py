@@ -88,6 +88,23 @@ class CclRejectError(TddlError):
     sqlstate = "HY000"
 
 
+class ServerOverloadError(TddlError):
+    """Admission control shed this query: the server is saturated (per-class
+    concurrency limit + full wait queue, CRITICAL memory pressure, or a
+    deadline that cannot cover the digest's predicted service time).
+
+    Carries `retry_after_ms` — the client-visible backoff suggestion — so a
+    well-behaved driver retries later instead of amplifying the overload.
+    Typed (never a hang, never a raw queue error): the overload harness
+    asserts every refusal under flood is this class or CclRejectError."""
+    errno = 9003
+    sqlstate = "HY000"
+
+    def __init__(self, message: str, retry_after_ms: int = 100):
+        super().__init__(message)
+        self.retry_after_ms = int(retry_after_ms)
+
+
 class QueryTimeoutError(TddlError):
     """Query exceeded its MAX_EXECUTION_TIME deadline (ER_QUERY_TIMEOUT).
 

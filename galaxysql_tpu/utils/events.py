@@ -33,11 +33,17 @@ KINDS = (
     # statement-summary sentinel): quarantine opened with a rollback pin /
     # targeted statistics repair, probation verdicts
     "plan_rollback", "stats_repair", "plan_promoted", "plan_heal_failed",
+    # resource-governance plane (server/admission.py, utils/ccl.py,
+    # net/dn.py retry budgets): overload sheds, CCL rejects/queue-fulls,
+    # memory-pressure tier transitions, exhausted retry budgets
+    "admission_reject", "ccl_reject", "mem_pressure",
+    "retry_budget_exhausted",
 )
 
 _WARN_KINDS = frozenset({
     "breaker_open", "worker_failover", "sync_failure", "batch_fallback",
     "plan_regression", "plan_rollback", "plan_heal_failed",
+    "admission_reject", "ccl_reject", "retry_budget_exhausted",
 })
 
 

@@ -43,6 +43,15 @@ FP_RPC_FAIL_N = "FP_RPC_FAIL_N"
 # WORKER-side: the worker process exits hard on the next matching op
 # (armed remotely via the `failpoint` sync action)
 FP_WORKER_CRASH = "FP_WORKER_CRASH"
+# WORKER-side slow drain (overload harness): the worker sleeps N ms inside
+# every matching request — a busy/brownout worker, not a dead one, so
+# breakers stay closed while queue depth and RTT climb.  Armed remotely via
+# the `failpoint` sync action; dict form {"ms": 50, "op": "exec_sql"}.
+FP_WORKER_SLOW_DRAIN = "FP_WORKER_SLOW_DRAIN"
+# host memory-pressure injection (overload harness): overrides the memory
+# governor's computed tier.  Arm value: "elevated" | "critical" | a float
+# usage fraction (e.g. 0.95) fed through the normal thresholds.
+FP_MEM_PRESSURE = "FP_MEM_PRESSURE"
 
 
 class FailPointError(RuntimeError):

@@ -195,6 +195,17 @@ SYNC_HEALS = Counter(
     "wholesale cache invalidations from a detected sync-epoch gap")
 QUERY_TIMEOUTS = Counter(
     "query_timeouts", "queries killed by a MAX_EXECUTION_TIME deadline")
+RETRY_BUDGET_EXHAUSTED = Counter(
+    "retry_budget_exhausted",
+    "worker RPCs failed fast because the per-endpoint retry token bucket "
+    "was empty (anti-retry-storm backstop)")
+# spill observability (exec/spill.py Spiller): promoted out of per-operator
+# attributes so SHOW METRICS / Prometheus / statement-summary deltas see
+# WHERE memory pressure went — process-shared, adopted per instance.
+SPILL_BYTES = Counter(
+    "spill_bytes_total", "bytes written to spill files (agg/join/sort)")
+SPILL_FILES = Counter(
+    "spill_files_total", "spill files/runs written")
 
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")

@@ -172,3 +172,148 @@ class TestConcurrencyStress:
             f"{len(rows)} visible vs {len(want)} committed; "
             f"missing={list(set(want) - set(rows))[:5]} "
             f"extra={list(set(rows) - set(want))[:5]}")
+
+
+class TestCclManagerStress:
+    """Concurrency-stress for the CCL admission plane (utils/ccl.py):
+    rule add/drop racing in-flight admit(), bounded wait-queue overflow
+    under 100 threads, and the double-release() guard on the
+    Session._run_query exception paths."""
+
+    def _mk(self):
+        from galaxysql_tpu.utils.ccl import CclManager
+        return CclManager()
+
+    def test_add_drop_races_inflight_admit(self):
+        """Rules churn while 100 threads admit/release: no exception other
+        than CclRejectError, and after the storm every slot is free."""
+        from galaxysql_tpu.utils.ccl import CclRule
+        import types
+        ccl = self._mk()
+        sess = types.SimpleNamespace(user="root", vars={})
+        stop = threading.Event()
+        failures: list = []
+
+        def churn():
+            i = 0
+            while not stop.is_set():
+                ccl.add_rule(CclRule(f"r{i % 3}", max_concurrency=4,
+                                     keyword="stress", wait_queue_size=8,
+                                     wait_timeout_ms=50))
+                ccl.drop_rule(f"r{(i + 1) % 3}")
+                i += 1
+
+        def admit_loop():
+            for _ in range(60):
+                try:
+                    h = ccl.admit(sess, "select stress from t")
+                    h.release()
+                except errors.CclRejectError:
+                    pass
+                except Exception as exc:  # noqa: BLE001 — asserted below
+                    failures.append(exc)
+
+        churner = threading.Thread(target=churn, daemon=True)
+        churner.start()
+        threads = [threading.Thread(target=admit_loop, daemon=True)
+                   for _ in range(100)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "admit() hung under rule churn"
+        stop.set()
+        churner.join(timeout=10)
+        assert not failures, failures[:3]
+        for st in ccl.rules():
+            assert st.running == 0 and st.waiting == 0
+
+    def test_wait_queue_overflow_under_100_threads(self):
+        """One slot, queue of 5, 100 threads: admissions + queue never
+        exceed bounds, overflow rejects typed, nobody hangs."""
+        from galaxysql_tpu.utils.ccl import CclRule
+        import types
+        ccl = self._mk()
+        ccl.add_rule(CclRule("one", max_concurrency=1, keyword="hot",
+                             wait_queue_size=5, wait_timeout_ms=100))
+        sess = types.SimpleNamespace(user="root", vars={})
+        admitted: list = []
+        rejected: list = []
+        failures: list = []
+        lock = threading.Lock()
+
+        def worker():
+            try:
+                h = ccl.admit(sess, "select hot from t")
+                with lock:
+                    admitted.append(1)
+                h.release()
+            except errors.CclRejectError:
+                with lock:
+                    rejected.append(1)
+            except Exception as exc:  # noqa: BLE001 — asserted below
+                failures.append(exc)
+
+        # the slot is HELD for the whole storm: every thread must either
+        # wait (bounded queue of 5, 100ms timeout) or reject typed — no
+        # hang, no unbounded queue, no wrong exception class
+        st = ccl.rules()[0]
+        st.sem.acquire()
+        try:
+            threads = [threading.Thread(target=worker, daemon=True)
+                       for _ in range(100)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+                assert not t.is_alive(), "admit() hung on a full wait queue"
+        finally:
+            st.sem.release()
+        assert not failures, failures[:3]
+        assert not admitted  # the slot never freed during the storm
+        assert len(rejected) == 100  # all typed (queue-full or timeout)
+        assert st.running == 0 and st.waiting == 0
+        assert st.total_rejected == 100
+        # the rule is healthy after the storm: the freed slot admits again
+        h = ccl.admit(sess, "select hot from t")
+        h.release()
+
+    def test_double_release_guard(self):
+        """release() is idempotent, and the Session._run_query exception
+        path releases exactly once (a failing matched query never leaks or
+        double-frees its slot)."""
+        from galaxysql_tpu.utils.ccl import GLOBAL_CCL, CclRule
+        import types
+        ccl = self._mk()
+        ccl.add_rule(CclRule("g", max_concurrency=1, keyword="t",
+                             wait_queue_size=0))
+        sess = types.SimpleNamespace(user="root", vars={})
+        h = ccl.admit(sess, "select * from t")
+        h.release()
+        h.release()  # second release must be a no-op
+        st = ccl.rules()[0]
+        assert st.running == 0
+        # the slot is actually free (a leaked/double-freed semaphore would
+        # break the next admit or blow BoundedSemaphore)
+        h2 = ccl.admit(sess, "select * from t")
+        h2.release()
+        # end-to-end: a matched query FAILING mid-execution releases its
+        # slot on the exception ramp exactly once
+        inst = Instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE cclx")
+        s.execute("USE cclx")
+        s.execute("CREATE TABLE t (a BIGINT)")
+        GLOBAL_CCL.add_rule(CclRule("x", max_concurrency=1, keyword="t",
+                                    wait_queue_size=0))
+        try:
+            for _ in range(3):
+                with pytest.raises(errors.TddlError):
+                    s.execute("SELECT nope FROM t")
+            st = GLOBAL_CCL.rules()[0]
+            assert st.running == 0 and st.waiting == 0
+            # the slot survives repeated failures: a healthy query admits
+            assert s.execute("SELECT count(*) FROM t").rows == [(0,)]
+        finally:
+            GLOBAL_CCL.clear()
+            s.close()

@@ -1,0 +1,601 @@
+"""Overload harness: admission control, memory-pressure governance, and
+retry-budgeted backpressure under sustained load (make overload-smoke).
+
+The acceptance shape: under an AP flood with injected worker slow-drain and
+memory pressure, concurrent TP traffic keeps bounded p99 and nonzero
+goodput; every refusal is a typed ServerOverloadError / CclRejectError /
+MemoryLimitExceeded (no hangs, no process OOM); admitted queries return
+bit-identical results to an idle run; and total rpc_retries stays within
+the configured budget (no metastable retry amplification)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from galaxysql_tpu.server import admission as adm_mod
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.utils import errors
+from galaxysql_tpu.utils.events import EVENTS
+from galaxysql_tpu.utils.failpoint import (FAIL_POINTS, FP_MEM_PRESSURE,
+                                           FP_WORKER_SLOW_DRAIN)
+
+pytestmark = pytest.mark.overload
+
+RUN_BOUND_S = 90.0
+
+
+def bounded(fn, timeout_s: float = RUN_BOUND_S):
+    """Zero-hang enforcement: run on a daemon thread, fail on timeout."""
+    result: dict = {}
+
+    def run():
+        try:
+            result["v"] = fn()
+        except BaseException as exc:  # noqa: BLE001 — re-raised below
+            result["e"] = exc
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise AssertionError(f"hang: call exceeded {timeout_s}s bound")
+    if "e" in result:
+        raise result["e"]
+    return result.get("v")
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    FAIL_POINTS.clear()
+    yield
+    FAIL_POINTS.clear()
+
+
+def _mk(schema="ov", rows=0):
+    inst = Instance()
+    s = Session(inst)
+    s.execute(f"CREATE DATABASE {schema}")
+    s.execute(f"USE {schema}")
+    if rows:
+        s.execute("CREATE TABLE t (a BIGINT PRIMARY KEY, b BIGINT, "
+                  "c BIGINT) PARTITION BY HASH(a) PARTITIONS 4")
+        inst.store(schema, "t").insert_arrays(
+            {"a": np.arange(rows), "b": np.arange(rows) % 97,
+             "c": np.arange(rows) * 3}, inst.tso.next_timestamp())
+        s.execute("ANALYZE TABLE t")  # real stats drive the AP classifier
+    return inst, s
+
+
+# -- classification -----------------------------------------------------------
+
+
+class TestClassification:
+    def test_heuristic_and_digest_truth(self):
+        inst, s = _mk(rows=100)
+        ctl = inst.admission
+        cls, _ms, _d = ctl.classify(s, "SELECT b FROM t WHERE a = 5")
+        assert cls == "TP"
+        cls, _ms, _d = ctl.classify(
+            s, "SELECT b, sum(c) FROM t GROUP BY b")
+        assert cls == "AP"
+        # after execution the digest cost map records observed truth: the
+        # engine's workload classifier (scanned rows), not the keyword guess
+        s.execute("SELECT count(*) FROM t")
+        cls2, ms2, dig = ctl.classify(s, "SELECT count(*) FROM t")
+        assert dig and dig in ctl._digest_cost
+        assert ms2 is not None and ms2 > 0
+        s.close()
+
+    def test_information_schema_stays_tp(self):
+        inst, s = _mk("ovis")
+        cls, _ms, _d = inst.admission.classify(
+            s, "SELECT * FROM information_schema.metrics")
+        assert cls == "TP"  # observability must stay reachable under flood
+        s.close()
+
+
+# -- limits, queuing, shedding -------------------------------------------------
+
+
+class TestAdmissionLimits:
+    def test_queue_full_sheds_typed_with_event(self):
+        inst, s = _mk("ovq", rows=200)
+        EVENTS.clear()
+        inst.config.set_instance("ADMISSION_AP_LIMIT", 1)
+        inst.config.set_instance("ADMISSION_QUEUE_SIZE", 0)
+        inst.admission._limit.clear()  # re-read the lowered limit
+        inst.admission._tokens["AP"].append(None)  # hold the only AP slot
+        try:
+            with pytest.raises(errors.ServerOverloadError) as ei:
+                s.execute("SELECT b, sum(c) FROM t GROUP BY b")
+            assert ei.value.retry_after_ms > 0
+            assert ei.value.errno == 9003
+        finally:
+            inst.admission._tokens["AP"].pop()
+        assert inst.metrics.counter("admission_shed_total").value >= 1
+        kinds = [e.kind for e in EVENTS.entries()]
+        assert "admission_reject" in kinds
+        s.close()
+
+    def test_wait_timeout_sheds_typed(self):
+        inst, s = _mk("ovt", rows=200)
+        inst.config.set_instance("ADMISSION_AP_LIMIT", 1)
+        inst.config.set_instance("ADMISSION_QUEUE_SIZE", 4)
+        inst.config.set_instance("ADMISSION_WAIT_MS", 50)
+        inst.admission._limit.clear()
+        inst.admission._tokens["AP"].append(None)
+        try:
+            t0 = time.perf_counter()
+            with pytest.raises(errors.ServerOverloadError):
+                bounded(lambda: s.execute(
+                    "SELECT b, sum(c) FROM t GROUP BY b"), 10.0)
+            assert time.perf_counter() - t0 < 5.0  # bounded wait, no hang
+        finally:
+            inst.admission._tokens["AP"].pop()
+        assert inst.admission.shed_timeout >= 1
+        s.close()
+
+    def test_waiter_admitted_when_slot_frees(self):
+        inst, s = _mk("ovw", rows=200)
+        inst.config.set_instance("ADMISSION_AP_LIMIT", 1)
+        inst.config.set_instance("ADMISSION_WAIT_MS", 5000)
+        inst.admission._limit.clear()
+        inst.admission._tokens["AP"].append(None)
+        got = []
+
+        def waiter():
+            s2 = Session(inst, schema="ovw")
+            got.append(s2.execute("SELECT b, sum(c) FROM t GROUP BY b").rows)
+            s2.close()
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        # free the slot: the queued query must admit and complete
+        inst.admission._tokens["AP"].pop()
+        with inst.admission._cond:
+            inst.admission._cond.notify_all()
+        t.join(20.0)
+        assert not t.is_alive() and got and got[0]
+        s.close()
+
+    def test_aimd_decrease_and_increase(self):
+        inst, s = _mk("ova")
+        ctl = inst.admission
+        lim0 = ctl.limit("AP")
+        # latency blows through the AP target -> multiplicative decrease
+        for _ in range(ctl.AIMD_SAMPLE):
+            ctl._aimd("AP", 60_000.0)
+        assert ctl.limit("AP") < lim0
+        # healthy latency with the limit binding -> additive increase
+        shrunk = ctl.limit("AP")
+        ctl._ewma["AP"] = 1.0
+        for _ in range(int(shrunk)):
+            ctl._tokens["AP"].append(None)
+        try:
+            for _ in range(ctl.AIMD_SAMPLE):
+                ctl._aimd("AP", 1.0)
+        finally:
+            ctl._tokens["AP"].clear()
+        assert ctl.limit("AP") > shrunk
+        s.close()
+
+
+class TestDeadlineShed:
+    def test_predicted_service_time_vs_deadline(self):
+        inst, s = _mk("ovd", rows=100)
+        ctl = inst.admission
+        q = "SELECT b, sum(c) FROM t GROUP BY b"
+        s.execute(q)  # record the digest
+        dig = s._digest_of(q)
+        ctl._digest_cost[dig] = ("AP", 60_000.0)  # predicted 60s service
+        s.execute("SET MAX_EXECUTION_TIME = 200")  # 200ms budget
+        with pytest.raises(errors.ServerOverloadError):
+            s.execute(q)
+        assert ctl.shed_deadline >= 1
+        s.close()
+
+
+# -- memory-pressure governance ------------------------------------------------
+
+
+class TestMemoryPressure:
+    def test_tiers_and_frag_budget(self):
+        inst, s = _mk("ovm")
+        gov = inst.admission.governor
+        base = inst.frag_cache.budget
+        assert gov.tier() == 0 and gov.spill_scale() == 1.0
+        FAIL_POINTS.arm(FP_MEM_PRESSURE, "elevated")
+        assert gov.tier() == 1
+        assert gov.spill_scale() == 0.25
+        assert inst.frag_cache.budget == base // 2
+        FAIL_POINTS.arm(FP_MEM_PRESSURE, "critical")
+        assert gov.tier() == 2
+        FAIL_POINTS.disarm(FP_MEM_PRESSURE)
+        assert gov.tier() == 0
+        assert inst.frag_cache.budget == base  # restored
+        kinds = [e.kind for e in EVENTS.entries()]
+        assert "mem_pressure" in kinds
+        s.close()
+
+    def test_critical_refuses_ap_keeps_tp(self):
+        inst, s = _mk("ovc", rows=200)
+        FAIL_POINTS.arm(FP_MEM_PRESSURE, "critical")
+        with pytest.raises(errors.ServerOverloadError):
+            s.execute("SELECT b, sum(c) FROM t GROUP BY b")
+        # TP point read still serves (goodput never zero)
+        assert s.execute("SELECT b FROM t WHERE a = 5").rows == [(5,)]
+        assert inst.admission.shed_memory >= 1
+        s.close()
+
+    def test_critical_revokes_largest_query(self):
+        from galaxysql_tpu.exec.memory import (GLOBAL_POOL, PoolCharge,
+                                               query_pool)
+        inst, s = _mk("ovr")
+        pool = query_pool(999_001, limit=1 << 20)
+        charge = PoolCharge(pool)
+        try:
+            assert charge.to(512 << 10)
+            assert inst.admission.governor.revoke_largest_query() > 0
+            # flag-based revoke: the owning operator spills at its next
+            # batch boundary
+            assert charge.squeeze
+        finally:
+            charge.close()
+            pool.close()
+        assert pool not in GLOBAL_POOL.children
+        s.close()
+
+    def test_pool_exhaustion_spills_not_oom(self):
+        """A tiny per-query pool forces the sort slab to spill (typed path,
+        bit-identical results) instead of accumulating resident memory."""
+        from galaxysql_tpu.utils.metrics import SPILL_BYTES
+        inst, s = _mk("ovs", rows=20_000)
+        q = "SELECT a, c FROM t ORDER BY c DESC LIMIT 7"
+        expect = s.execute(q).rows
+        before = SPILL_BYTES.value
+        s.execute("SET QUERY_MEM_BYTES = 4096")
+        assert s.execute(q).rows == expect  # spilled run, same answer
+        assert SPILL_BYTES.value > before
+        # per-query counter delta attributes the spill to the digest
+        r = s.execute("SELECT sum(spill_bytes) FROM "
+                      "information_schema.statement_summary")
+        assert r.rows[0][0] and r.rows[0][0] > 0
+        s.close()
+
+
+# -- retry budget --------------------------------------------------------------
+
+
+class TestRetryBudget:
+    def test_token_bucket(self):
+        from galaxysql_tpu.net.dn import RetryBudget
+        b = RetryBudget(capacity=2, refill_per_s=0.0)
+        assert b.try_take() and b.try_take()
+        assert not b.try_take()
+        assert b.exhausted == 1
+        assert b.remaining() == 0.0
+        b.configure(capacity=4, refill_per_s=0.0)
+        assert not b.try_take()  # capacity change alone mints no tokens
+
+    def test_empty_budget_fails_fast_typed(self):
+        from galaxysql_tpu.net.dn import WorkerClient
+        from galaxysql_tpu.utils.metrics import (RETRY_BUDGET_EXHAUSTED,
+                                                 RPC_RETRIES)
+        EVENTS.clear()
+        client = WorkerClient("127.0.0.1", 1, max_retries=3,
+                              failure_threshold=100)
+        client.retry_budget.configure(capacity=0, refill_per_s=0.0)
+        r0 = RPC_RETRIES.value
+        e0 = RETRY_BUDGET_EXHAUSTED.value
+        with pytest.raises(errors.WorkerUnavailableError) as ei:
+            bounded(lambda: client.request({"op": "exec_plan",
+                                            "fragment": {}}), 20.0)
+        assert "retry budget" in str(ei.value)
+        assert RPC_RETRIES.value == r0  # zero retries happened
+        assert RETRY_BUDGET_EXHAUSTED.value == e0 + 1
+        assert "retry_budget_exhausted" in [e.kind for e in EVENTS.entries()]
+
+    def test_budget_caps_retry_volume(self):
+        from galaxysql_tpu.net.dn import WorkerClient
+        from galaxysql_tpu.utils.metrics import RPC_RETRIES
+        client = WorkerClient("127.0.0.1", 1, max_retries=2,
+                              failure_threshold=10_000,
+                              retry_backoff_ms=1)
+        client.retry_budget.configure(capacity=3, refill_per_s=0.0)
+        r0 = RPC_RETRIES.value
+        for _ in range(20):  # a would-be retry storm
+            with pytest.raises(errors.WorkerUnavailableError):
+                client.request({"op": "exec_plan", "fragment": {}})
+        assert RPC_RETRIES.value - r0 <= 3  # bounded by the bucket, not 40
+
+
+# -- hatches -------------------------------------------------------------------
+
+
+class TestHatches:
+    def test_param_off(self):
+        inst, s = _mk("ovh1")
+        s.execute("SET ENABLE_ADMISSION_CONTROL = 0")
+        t = inst.admission.admit(s, "SELECT sum(a) FROM t GROUP BY a")
+        assert t.ctl is None  # structural no-op ticket
+        s.close()
+
+    def test_env_off(self, monkeypatch):
+        inst, s = _mk("ovh2")
+        monkeypatch.setattr(adm_mod, "ENABLED", False)
+        t = inst.admission.admit(s, "SELECT sum(a) FROM t GROUP BY a")
+        assert t.ctl is None
+        s.close()
+
+    def test_hint_off(self):
+        inst, s = _mk("ovh3", rows=50)
+        FAIL_POINTS.arm(FP_MEM_PRESSURE, "critical")
+        # CRITICAL refuses AP — unless the statement opts out of admission
+        q = "/*+TDDL: ADMISSION(OFF)*/ SELECT b, sum(c) FROM t GROUP BY b"
+        assert s.execute(q).rows
+        s.close()
+
+    def test_results_identical_on_vs_off(self, monkeypatch):
+        inst, s = _mk("ovh4", rows=2_000)
+        q = "SELECT b, sum(c) FROM t GROUP BY b ORDER BY b LIMIT 13"
+        on = s.execute(q).rows
+        monkeypatch.setattr(adm_mod, "ENABLED", False)
+        assert s.execute(q).rows == on
+        s.close()
+
+    def test_idle_hot_path_dispatch_counts_unchanged(self, monkeypatch):
+        """The no-overload guard: with limits idle, admission adds ZERO
+        device dispatches — the gate is host-side token bookkeeping only."""
+        from galaxysql_tpu.exec.operators import DISPATCH_STATS
+        inst, s = _mk("ovh5", rows=2_000)
+        q = "SELECT b, sum(c) FROM t GROUP BY b ORDER BY b LIMIT 13"
+        s.execute(q)  # warm compiles on both paths
+
+        def count(n=3):
+            d0 = DISPATCH_STATS["dispatches"]
+            for _ in range(n):
+                s.execute(q)
+            return DISPATCH_STATS["dispatches"] - d0
+
+        with_admission = count()
+        monkeypatch.setattr(adm_mod, "ENABLED", False)
+        without = count()
+        assert with_admission == without
+        s.close()
+
+
+# -- SQL surfaces --------------------------------------------------------------
+
+
+class TestSqlSurfaces:
+    def test_ccl_rule_ddl_round_trip(self):
+        from galaxysql_tpu.utils.ccl import GLOBAL_CCL
+        inst, s = _mk("ovsql", rows=10)
+        try:
+            s.execute("CREATE CCL_RULE throttle_t WITH MAX_CONCURRENCY = 2, "
+                      "KEYWORD = 'slowq', WAIT_QUEUE_SIZE = 3, "
+                      "WAIT_TIMEOUT = 500")
+            rows = s.execute("SHOW CCL_RULES").rows
+            assert ("throttle_t", 2, "slowq", "", 0, 0, 0, 0) in rows
+            r = s.execute("SELECT rule_name, max_concurrency FROM "
+                          "information_schema.ccl_rules")
+            assert ("throttle_t", 2) in r.rows
+            # IF NOT EXISTS keeps the existing rule
+            s.execute("CREATE CCL_RULE IF NOT EXISTS throttle_t "
+                      "WITH MAX_CONCURRENCY = 9")
+            assert GLOBAL_CCL.rules()[0].rule.max_concurrency == 2
+            s.execute("DROP CCL_RULE throttle_t")
+            assert s.execute("SHOW CCL_RULES").rows == []
+            with pytest.raises(errors.TddlError):
+                s.execute("DROP CCL_RULE throttle_t")
+            s.execute("DROP CCL_RULE IF EXISTS throttle_t")  # no error
+        finally:
+            GLOBAL_CCL.clear()
+            s.close()
+
+    def test_ccl_reject_publishes_event(self):
+        from galaxysql_tpu.utils.ccl import GLOBAL_CCL
+        inst, s = _mk("ovev", rows=10)
+        EVENTS.clear()
+        try:
+            s.execute("CREATE CCL_RULE block WITH MAX_CONCURRENCY = 1, "
+                      "KEYWORD = 't', WAIT_QUEUE_SIZE = 0")
+            st = GLOBAL_CCL.rules()[0]
+            st.sem.acquire()
+            try:
+                with pytest.raises(errors.CclRejectError):
+                    s.execute("SELECT b FROM t WHERE a = 1")
+            finally:
+                st.sem.release()
+            assert "ccl_reject" in [e.kind for e in EVENTS.entries()]
+        finally:
+            GLOBAL_CCL.clear()
+            s.close()
+
+    def test_show_admission_and_info_schema(self):
+        inst, s = _mk("ovsh", rows=50)
+        s.execute("SELECT b FROM t WHERE a = 1")  # a TP admission
+        rows = dict(s.execute("SHOW ADMISSION").rows)
+        assert rows["enabled"] == 1.0
+        assert "tp_limit" in rows and "ap_limit" in rows
+        assert rows["memory_pressure_tier"] == 0.0
+        r = s.execute("SELECT stat_name, value FROM "
+                      "information_schema.admission_stats "
+                      "WHERE stat_name = 'tp_admitted'")
+        assert r.rows and r.rows[0][1] >= 1
+        # the new gauges land in the typed registry / SHOW METRICS
+        names = {n for n, *_ in s.execute("SHOW METRICS").rows}
+        assert {"memory_pressure_tier", "admission_queue_depth_tp",
+                "admission_queue_depth_ap",
+                "retry_budget_remaining"} <= names
+        s.close()
+
+    def test_spill_metrics_in_registry(self):
+        inst, s = _mk("ovsp", rows=30_000)
+        s.execute("SET SORT_SPILL_BYTES = 65536")
+        s.execute("SELECT a, c FROM t ORDER BY c LIMIT 5")
+        vals = {n: v for n, _k, v, _h in inst.metrics.rows()}
+        assert vals.get("spill_bytes_total", 0) > 0
+        assert vals.get("spill_files_total", 0) > 0
+        assert "spill_bytes_total" in inst.metrics.prometheus_text()
+        s.close()
+
+
+# -- worker backpressure -------------------------------------------------------
+
+
+class TestWorkerBackpressure:
+    def test_slow_drain_piggyback(self):
+        """A browned-out worker (slow drain, not dead) piggybacks its load in
+        every reply; the client records it, results stay correct, breakers
+        stay closed."""
+        from test_chaos import WorkerHarness
+        h = WorkerHarness(init_sql=(
+            "CREATE DATABASE w; USE w; "
+            "CREATE TABLE kv (k BIGINT PRIMARY KEY, v BIGINT); "
+            "INSERT INTO kv VALUES (1, 10), (2, 20), (3, 30)"))
+        inst, s = _mk("w")
+        try:
+            inst.attach_remote_table("w", "kv", *h.addr)
+            client = inst.workers[h.addr]
+            # no op filter: the remote scan ships as exec_plan when the
+            # fragment compiles and degrades to exec_sql otherwise — the
+            # drain must hit either path
+            client.sync_action("failpoint",
+                               {"key": FP_WORKER_SLOW_DRAIN,
+                                "value": {"ms": 40}})
+            t0 = time.perf_counter()
+            rows = bounded(
+                lambda: s.execute("SELECT v FROM kv WHERE k = 2").rows, 30.0)
+            assert rows == [(20,)]
+            assert time.perf_counter() - t0 >= 0.04  # the drain really hit
+            assert client.load_at > 0  # piggybacked load recorded
+            assert client.breaker_state() == "closed"  # slow is not dead
+            client.sync_action("failpoint", {"clear": True})
+        finally:
+            s.close()
+            h.close()
+
+    def test_routing_deprioritizes_pressured_endpoint(self):
+        """read_endpoint weights down endpoints that reported deep queues /
+        memory pressure — without ever excluding them."""
+        import types
+        from galaxysql_tpu.net.dn import WorkerClient
+        inst = Instance()
+        calm = WorkerClient("127.0.0.1", 7001)
+        busy = WorkerClient("127.0.0.1", 7002)
+        busy.load_q, busy.load_tier, busy.load_at = 8, 1, time.time()
+        inst.workers[("127.0.0.1", 7001)] = calm
+        inst.workers[("127.0.0.1", 7002)] = busy
+        tm = types.SimpleNamespace(
+            name="kv", remote={"host": "127.0.0.1", "port": 7001},
+            replicas=[{"host": "127.0.0.1", "port": 7002, "weight": 1}])
+        picks = {7001: 0, 7002: 0}
+        for _ in range(400):
+            addr, _c = inst.read_endpoint(tm)
+            picks[addr[1]] += 1
+        assert picks[7002] > 0          # pressured, not excluded
+        assert picks[7001] > 3 * picks[7002]  # but strongly deprioritized
+
+
+# -- the end-to-end overload scenario -----------------------------------------
+
+
+class TestOverloadEndToEnd:
+    def test_tp_survives_ap_flood_with_pressure(self):
+        """AP flood + ELEVATED memory pressure: TP keeps nonzero goodput and
+        bounded p99; every AP refusal is typed; admitted results are
+        bit-identical to idle; nothing hangs."""
+        # 60k rows: above the planner's AP row threshold, so the flood query
+        # is a GENUINE AP classification (the digest cost map records the
+        # engine's workload verdict, which overrides the keyword guess after
+        # the first execution)
+        inst, s = _mk("ovf", rows=60_000)
+        inst.config.set_instance("ADMISSION_AP_LIMIT", 2)
+        inst.config.set_instance("ADMISSION_QUEUE_SIZE", 1)
+        inst.config.set_instance("ADMISSION_WAIT_MS", 100)
+        inst.admission._limit.clear()
+        ap_q = ("SELECT b, sum(c), count(*) FROM t "
+                "GROUP BY b ORDER BY 2 DESC LIMIT 5")
+        tp_q = "SELECT b FROM t WHERE a = %d"
+        idle_ap = s.execute(ap_q).rows          # idle-run truths
+        idle_tp = {k: s.execute(tp_q % k).rows for k in (3, 77, 991)}
+        FAIL_POINTS.arm(FP_MEM_PRESSURE, "elevated")
+        stop = threading.Event()
+        bad_failures: list = []
+        ap_ok = [0]
+        ap_shed = [0]
+        lock = threading.Lock()
+
+        def ap_flood():
+            sx = Session(inst, schema="ovf")
+            while not stop.is_set():
+                try:
+                    rows = sx.execute(ap_q).rows
+                    with lock:
+                        ap_ok[0] += 1
+                    if rows != idle_ap:
+                        bad_failures.append(
+                            AssertionError("admitted AP result drifted"))
+                except (errors.ServerOverloadError,
+                        errors.CclRejectError):
+                    with lock:
+                        ap_shed[0] += 1
+                    time.sleep(0.002)
+                except Exception as exc:  # noqa: BLE001 — asserted below
+                    bad_failures.append(exc)
+            sx.close()
+
+        tp_lats: list = []
+
+        def tp_loop():
+            sx = Session(inst, schema="ovf")
+            mine = []
+            for j in range(60):
+                k = (3, 77, 991)[j % 3]
+                t0 = time.perf_counter()
+                try:
+                    rows = sx.execute(tp_q % k).rows
+                except Exception as exc:  # noqa: BLE001 — asserted below
+                    bad_failures.append(exc)
+                    continue
+                mine.append(time.perf_counter() - t0)
+                if rows != idle_tp[k]:
+                    bad_failures.append(
+                        AssertionError("admitted TP result drifted"))
+            with lock:
+                tp_lats.extend(mine)
+            sx.close()
+
+        def run():
+            floods = [threading.Thread(target=ap_flood, daemon=True)
+                      for _ in range(6)]
+            for t in floods:
+                t.start()
+            time.sleep(0.2)  # flood established before TP measurement
+            tps = [threading.Thread(target=tp_loop, daemon=True)
+                   for _ in range(4)]
+            for t in tps:
+                t.start()
+            for t in tps:
+                t.join(RUN_BOUND_S)
+                assert not t.is_alive(), "TP thread hung under flood"
+            stop.set()
+            for t in floods:
+                t.join(RUN_BOUND_S)
+                assert not t.is_alive(), "AP thread hung"
+
+        bounded(run)
+        FAIL_POINTS.clear()
+        assert not bad_failures, bad_failures[:3]
+        assert len(tp_lats) == 240  # full TP goodput, zero TP failures
+        tp_lats.sort()
+        p99 = tp_lats[min(int(0.99 * len(tp_lats)), len(tp_lats) - 1)]
+        assert p99 < 5.0, f"TP p99 {p99:.3f}s unbounded under flood"
+        assert ap_ok[0] > 0          # AP goodput nonzero too
+        assert ap_shed[0] > 0        # the flood actually shed (typed)
+        s.close()

@@ -372,7 +372,7 @@ class TestPlanRegressionSentinel:
         assert len(fps) == 2, f"expected a new plan fingerprint, got {fps}"
         new_fp = (fps - base_fps).pop()
         flagged = [r for r in rows if r[2] == new_fp]
-        assert flagged and flagged[0][17] == 1  # Regressed column
+        assert flagged and flagged[0][18] == 1  # Regressed column
         # typed event
         evs = [r for r in s.execute("SHOW EVENTS").rows
                if r[2] == "plan_regression"]
