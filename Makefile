@@ -68,6 +68,19 @@ batch-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m batching -p no:cacheprovider
 	JAX_PLATFORMS=cpu BENCH_BATCH_SESSIONS=100,1000 $(PY) bench.py --batch-only
 
+# DML batching smoke: the dml_batch marker suite (batched vs sequential
+# bit-identical table state under 100+ concurrent write sessions, poison-key
+# error isolation, own-txn bypass, read-your-writes after async GSI apply,
+# replica reply-leg-drop exactly-once, group commit, CDC coalescing +
+# replay equivalence, the hatch trio, steady-state retrace/dispatch guards)
+dml-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m dml_batch -p no:cacheprovider
+
+# DML bench: closed-loop point-DML + mixed read/write serving, DML batching
+# on vs off (BENCH json lines on stdout; BENCH_DML_SESSIONS=64,256 default)
+bench-dml:
+	JAX_PLATFORMS=cpu $(PY) bench.py --dml-only
+
 # chaos smoke: the fault-injection suite over a real worker subprocess —
 # retry transparency + dedupe-window exactly-once (reply-leg drop), circuit
 # breaker open/half-open/closed, MAX_EXECUTION_TIME deadline kills, sync-epoch
@@ -110,4 +123,4 @@ heal-smoke:
 
 .PHONY: tier1 fusion-smoke obs-smoke rf-smoke cache-smoke trace-smoke bench \
 	batch-smoke chaos-smoke skew-smoke bench-skew summary-smoke heal-smoke \
-	overload-smoke bench-overload
+	overload-smoke bench-overload dml-smoke bench-dml

@@ -372,71 +372,12 @@ def dispatch_microbench(runs: int):
 
 
 def _closed_loop_point(inst, tpl, keys, n_sessions, per_session):
-    """Closed-loop multi-session point-select driver: n_sessions threads,
-    each its own Session, each firing per_session queries back-to-back.
-    Returns (qps, p99_ms, errors).  Thread stacks are shrunk so the 10k-
-    session level fits comfortably; sessions + threads are built BEFORE the
-    clock starts, so the numbers measure serving, not setup."""
-    import threading
-    lats: list = []
-    errors: list = []
-    lock = threading.Lock()
-    start = threading.Event()
-    all_ready = threading.Event()
-    ready = [0]
+    """Closed-loop multi-session point-select driver (thin wrapper over the
+    generic `_closed_loop_ops` scaffolding).  Returns (qps, p99_ms, errors)."""
     nkeys = len(keys)
-
-    def run(i):
-        counted = False
-        try:
-            sx = Session(inst, schema="tpch")
-            mine = []
-            with lock:
-                ready[0] += 1
-                counted = True
-                if ready[0] == n_sessions:
-                    all_ready.set()
-            start.wait()
-            for j in range(per_session):
-                k = keys[(i * 31 + j * 7) % nkeys]
-                t0 = time.perf_counter()
-                sx.execute(tpl % k)
-                mine.append(time.perf_counter() - t0)
-            sx.close()
-            with lock:
-                lats.extend(mine)
-        except Exception as e:  # pragma: no cover - surfaced to the caller
-            with lock:
-                errors.append(e)
-                if not counted:  # failed during setup: still unblock t0
-                    ready[0] += 1
-                    if ready[0] == n_sessions:
-                        all_ready.set()
-
-    # the shrunken stack must still be in effect at START time — the OS
-    # thread (and its stack) is created by t.start(), not Thread()
-    old_stack = threading.stack_size(512 << 10)
-    try:
-        threads = [threading.Thread(target=run, args=(i,), daemon=True)
-                   for i in range(n_sessions)]
-        for t in threads:
-            t.start()
-    finally:
-        threading.stack_size(old_stack)
-    # every session constructed before the clock starts — the docstring's
-    # "measure serving, not setup" contract (bounded wait: a wedged setup
-    # still releases the run rather than hanging the bench)
-    all_ready.wait(timeout=120.0)
-    t0 = time.perf_counter()
-    start.set()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
-    if errors or not lats:
-        return 0.0, 0.0, errors
-    lats.sort()
-    p99 = lats[min(int(0.99 * len(lats)), len(lats) - 1)]
-    return len(lats) / wall, p99 * 1000.0, errors
+    return _closed_loop_ops(
+        inst, "tpch", n_sessions, per_session,
+        lambda sx, i, j: sx.execute(tpl % keys[(i * 31 + j * 7) % nkeys]))
 
 
 def batch_serving_bench(inst, s, data, platform):
@@ -505,6 +446,187 @@ def batch_serving_bench(inst, s, data, platform):
             "retraces_steady": _ops.COMPILE_STATS["retraces"],
             "platform": platform,
         })
+    return out
+
+
+def _closed_loop_ops(inst, schema, n_sessions, per_session, op):
+    """Closed-loop multi-session driver over an arbitrary per-op callable
+    `op(sx, i, j)` — THE scaffolding (`_closed_loop_point` wraps it):
+    sessions + threads built before the clock starts, shrunken stacks,
+    bounded ready-wait.  Returns (qps, p99_ms, errors)."""
+    import threading
+    lats: list = []
+    errors: list = []
+    lock = threading.Lock()
+    start = threading.Event()
+    all_ready = threading.Event()
+    ready = [0]
+
+    def run(i):
+        counted = False
+        try:
+            sx = Session(inst, schema=schema)
+            mine = []
+            with lock:
+                ready[0] += 1
+                counted = True
+                if ready[0] == n_sessions:
+                    all_ready.set()
+            start.wait()
+            for j in range(per_session):
+                t0 = time.perf_counter()
+                op(sx, i, j)
+                mine.append(time.perf_counter() - t0)
+            sx.close()
+            with lock:
+                lats.extend(mine)
+        except Exception as e:  # pragma: no cover - surfaced to the caller
+            with lock:
+                errors.append(e)
+                if not counted:
+                    ready[0] += 1
+                    if ready[0] == n_sessions:
+                        all_ready.set()
+
+    old_stack = threading.stack_size(512 << 10)
+    try:
+        threads = [threading.Thread(target=run, args=(i,), daemon=True)
+                   for i in range(n_sessions)]
+        for t in threads:
+            t.start()
+    finally:
+        threading.stack_size(old_stack)
+    all_ready.wait(timeout=120.0)
+    t0 = time.perf_counter()
+    start.set()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors or not lats:
+        return 0.0, 0.0, errors
+    lats.sort()
+    p99 = lats[min(int(0.99 * len(lats)), len(lats) - 1)]
+    return len(lats) / wall, p99 * 1000.0, errors
+
+
+def dml_serving_bench(inst, s, platform):
+    """Mega-batched write serving: closed-loop DML QPS/chip + p99 at
+    increasing session counts, DML batching on (adaptive window, group
+    commit, coalesced CDC) vs off (the sequential per-statement path) on the
+    SAME engine.  vs_baseline is the on/off QPS ratio — the write-path
+    amortization win this PR claims.  A mixed 50/50 read+write closed loop
+    rides along (`tp_mixed_rw_qps_...`): real TP traffic is never
+    write-only, and the two batchers must compose.
+
+    Methodology matches batch_serving_bench: best of BENCH_DML_RUNS
+    (default 3) passes per mode per level; every INSERT id is globally
+    unique so no pass ever conflicts with another."""
+    from galaxysql_tpu.exec import operators as _ops
+    from galaxysql_tpu.utils.metrics import DML_GROUP_SIZE
+
+    schema = "dmlbench"
+    # measure the batcher, not the shedder: the closed loop intentionally
+    # saturates, and AIMD shedding typed errors would abort the pass.
+    # Both knobs restore on exit — later bench sections (and operator
+    # settings) must not inherit this section's configuration.
+    prev_adm = inst.config.get("ENABLE_ADMISSION_CONTROL")
+    prev_batch = inst.config.get("ENABLE_DML_BATCHING")
+    inst.config.set_instance("ENABLE_ADMISSION_CONTROL", 0)
+    try:
+        return _dml_serving_passes(inst, s, schema, platform)
+    finally:
+        inst.config.set_instance("ENABLE_DML_BATCHING", prev_batch)
+        inst.config.set_instance("ENABLE_ADMISSION_CONTROL", prev_adm)
+
+
+def _dml_serving_passes(inst, s, schema, platform):
+    from galaxysql_tpu.exec import operators as _ops
+    from galaxysql_tpu.utils.metrics import DML_GROUP_SIZE
+    try:
+        s.execute(f"CREATE DATABASE {schema}")
+    except Exception:
+        pass
+    sb = Session(inst, schema=schema)
+    sb.execute("CREATE TABLE wb (id BIGINT NOT NULL PRIMARY KEY, "
+               "grp INT NOT NULL, amt DECIMAL(12,2)) "
+               "PARTITION BY HASH(id) PARTITIONS 4")
+    ins = "INSERT INTO wb (id, grp, amt) VALUES (%d, %d, %d.25)"
+    sel = "SELECT amt FROM wb WHERE id = %d"
+    # register + warm the DML batch plan and the read PointPlan
+    sb.execute(ins % (1, 1, 1))
+    sb.execute(ins % (2, 2, 2))
+    sb.execute(sel % 1)
+    sb.execute(sel % 1)
+    next_id = [1000]
+
+    def make_insert_op(base):
+        def op(sx, i, j):
+            k = base + i * 1000 + j
+            sx.execute(ins % (k, k % 97, k % 1000))
+        return op
+
+    def make_mixed_op(base):
+        def op(sx, i, j):
+            k = base + i * 1000 + j
+            if j % 2 == 0:
+                sx.execute(ins % (k, k % 97, k % 1000))
+            else:
+                sx.execute(sel % (base + i * 1000 + j - 1))
+        return op
+
+    levels = [int(x) for x in os.environ.get(
+        "BENCH_DML_SESSIONS", "64,256").split(",") if x]
+    reps = max(1, int(os.environ.get("BENCH_DML_RUNS", "3")))
+    out = []
+
+    def passes(mode_on, mk_op, n, per):
+        inst.config.set_instance("ENABLE_DML_BATCHING", 1 if mode_on else 0)
+        best = (0.0, 0.0)
+        for _ in range(reps):
+            base = next_id[0]
+            next_id[0] += n * 1000 + 1000
+            qps, p99, errs = _closed_loop_ops(inst, schema, n, per,
+                                              mk_op(base))
+            if errs:
+                raise errs[0]
+            if qps > best[0]:
+                best = (qps, p99)
+        return best
+
+    # warm both paths + the group-commit pipeline before any timed pass
+    passes(True, make_insert_op, 32, 4)
+    passes(False, make_insert_op, 32, 4)
+    for n in levels:
+        per = max(4, min(16, 8000 // n))
+        qps_off, p99_off = passes(False, make_insert_op, n, per)
+        _ops.reset_compile_stats()
+        DML_GROUP_SIZE.reset()
+        qps_on, p99_on = passes(True, make_insert_op, n, per)
+        gs = DML_GROUP_SIZE.quantiles()
+        out.append({
+            "metric": f"tp_dml_qps_per_chip_{n}_sessions",
+            "value": round(qps_on, 1), "unit": "qps",
+            "vs_baseline": round(qps_on / max(qps_off, 1e-9), 3),
+            "p99_ms": round(p99_on, 3),
+            "unbatched_qps": round(qps_off, 1),
+            "unbatched_p99_ms": round(p99_off, 3),
+            "dml_flushes": DML_GROUP_SIZE.count,
+            "dml_group_p50": gs[0.5],
+            "retraces_steady": _ops.COMPILE_STATS["retraces"],
+            "platform": platform,
+        })
+        mq_off, mp_off = passes(False, make_mixed_op, n, per)
+        mq_on, mp_on = passes(True, make_mixed_op, n, per)
+        out.append({
+            "metric": f"tp_mixed_rw_qps_per_chip_{n}_sessions",
+            "value": round(mq_on, 1), "unit": "qps",
+            "vs_baseline": round(mq_on / max(mq_off, 1e-9), 3),
+            "p99_ms": round(mp_on, 3),
+            "unbatched_qps": round(mq_off, 1),
+            "unbatched_p99_ms": round(mp_off, 3),
+            "platform": platform,
+        })
+    sb.close()
     return out
 
 
@@ -743,6 +865,10 @@ def main():
     # -- mega-batched TP serving: closed-loop multi-session QPS ---------------
     if os.environ.get("BENCH_BATCH", "1") != "0":
         results.extend(batch_serving_bench(inst, s, data, platform))
+
+    # -- mega-batched write serving: closed-loop DML + mixed r/w QPS ----------
+    if os.environ.get("BENCH_DML", "1") != "0":
+        results.extend(dml_serving_bench(inst, s, platform))
 
     # -- skew-aware execution: Zipf theta sweep on Q9-like joins --------------
     # needs the 8-device mesh; single-device runs use `bench.py --skew-only`
@@ -1041,9 +1167,21 @@ def batch_only_main():
         print(json.dumps(out))
 
 
+def dml_only_main():
+    """`bench.py --dml-only` (make bench-dml): the closed-loop DML + mixed
+    read/write serving bench on a fresh instance (no TPC-H load needed —
+    the driver builds its own write table)."""
+    inst = Instance()
+    s = Session(inst)
+    for out in dml_serving_bench(inst, s, jax.devices()[0].platform):
+        print(json.dumps(out))
+
+
 if __name__ == "__main__":
     if "--batch-only" in sys.argv:
         batch_only_main()
+    elif "--dml-only" in sys.argv:
+        dml_only_main()
     elif "--skew-only" in sys.argv:
         skew_only_main()
     elif "--overload-only" in sys.argv:

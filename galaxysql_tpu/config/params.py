@@ -71,6 +71,29 @@ DEADLOCK_DETECT_INTERVAL = _p("DEADLOCK_DETECT_INTERVAL", 1000, "ms")
 
 # --- DML ----------------------------------------------------------------------
 DML_BATCH_SIZE = _p("DML_BATCH_SIZE", 10_000, "insert batch size")
+ENABLE_DML_BATCHING = _p(
+    "ENABLE_DML_BATCHING", True,
+    "coalesce plan-identical autocommit point DMLs (single-row INSERT "
+    "VALUES, point UPDATE/DELETE) from concurrent sessions into one "
+    "vectorized flush per partition with a shared flush-time TSO, coalesced "
+    "CDC/version bumps, and per-session error isolation "
+    "(server/dml_batch.py) — the write-path mirror of the read batcher")
+DML_BATCH_WINDOW_US = _p(
+    "DML_BATCH_WINDOW_US", 0,
+    "fixed DML batch collection window in microseconds (0 = adaptive, "
+    "gated on live DML concurrency like the read batcher's window; "
+    "sequential write traffic pays nothing)")
+ENABLE_ASYNC_APPLY = _p(
+    "ENABLE_ASYNC_APPLY", True,
+    "pipeline GSI maintenance and replica DML legs of BATCHED autocommit "
+    "writes through the background applier (txn/async_apply.py) instead of "
+    "per-statement synchronous work; a session's own subsequent reads fence "
+    "on its apply watermark (read-your-writes), cross-session GSI/replica "
+    "freshness is eventual within the apply lag")
+APPLY_WAIT_MS = _p(
+    "APPLY_WAIT_MS", 10_000,
+    "max milliseconds a session's read will wait on its own async-apply "
+    "watermark (read-your-writes fence) before proceeding")
 ENABLE_RECYCLEBIN = _p("ENABLE_RECYCLEBIN", True,
                        "DROP TABLE parks tables for FLASHBACK ... BEFORE DROP")
 

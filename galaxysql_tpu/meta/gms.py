@@ -237,6 +237,19 @@ class MetaDb:
         self.execute("INSERT OR REPLACE INTO global_tx_log VALUES (?,?,?,?)",
                      (txn_id, state, commit_ts, time.time()))
 
+    def tx_log_put_many(self, entries):
+        """Group-commit write: every (txn_id, state, commit_ts) entry lands
+        in ONE sqlite transaction — the commit-point fsync amortized across
+        a flush group of concurrent committers (txn/xa.GroupCommitGate)."""
+        if not entries:
+            return
+        now = time.time()
+        with self._lock:
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO global_tx_log VALUES (?,?,?,?)",
+                [(tid, state, cts, now) for tid, state, cts in entries])
+            self._conn.commit()
+
     def tx_log_get(self, txn_id: int) -> Optional[Tuple[str, int]]:
         rows = self.query("SELECT state, commit_ts FROM global_tx_log "
                           "WHERE txn_id=?", (txn_id,))

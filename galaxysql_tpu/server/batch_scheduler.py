@@ -84,6 +84,10 @@ class BatchRequest:
     fallback: bool = False
     group_size: int = 0
     wait_us: float = 0.0
+    # DML members (server/dml_batch.py): affected-row count + the async-apply
+    # watermark the session fences its own reads on (0 = nothing async)
+    affected: int = 0
+    apply_seq: int = 0
 
 
 class _Group:
@@ -109,6 +113,10 @@ class BatchScheduler:
 
     MIN_WINDOW_S = 100e-6
     MAX_WINDOW_S = 500e-6
+    # config param naming the fixed-window override (subclasses rebind: the
+    # DML batcher keys off DML_BATCH_WINDOW_US so read/write windows tune
+    # independently)
+    WINDOW_PARAM = "BATCH_WINDOW_US"
     # adaptive collection extends past one window quantum WHILE members keep
     # arriving (follower wake->resubmit is serialized by the interpreter, so
     # a mega-group trickles in over several quanta); this caps the total
@@ -202,7 +210,7 @@ class BatchScheduler:
         (concurrency IS the amortizable demand — sequential traffic pays
         nothing), sized to collect ~TARGET_GROUP keys at the observed
         arrival rate, clamped to [MIN_WINDOW_S, MAX_WINDOW_S]."""
-        fixed = self.instance.config.get("BATCH_WINDOW_US")
+        fixed = self.instance.config.get(self.WINDOW_PARAM)
         if fixed:
             return float(fixed) / 1e6
         if self._inflight < self.MIN_INFLIGHT:
@@ -247,7 +255,7 @@ class BatchScheduler:
                 window = self._window_s()
                 if window <= 0.0:
                     return None
-                fixed = bool(self.instance.config.get("BATCH_WINDOW_US"))
+                fixed = bool(self.instance.config.get(self.WINDOW_PARAM))
                 # adaptive: all in-flight point queries are potential members
                 target = None if fixed else min(max(self._inflight, 2), cap)
                 g = _Group(gkey, pp, pinned_ts, now, target)

@@ -1,0 +1,678 @@
+"""Cross-session mega-batched writes: vectorized DML batching + group commit.
+
+PR 6 (server/batch_scheduler.py) made the READ half of TP serve at scale by
+coalescing plan-identical point reads into one vectorized dispatch; this is
+its mirror image for mutations — the last unbatched hot path.  Sequentially,
+every autocommit point DML pays its own parse, TSO fetch, per-partition
+append/stamp, CDC binlog write (a metadb transaction per statement!),
+fragment-cache/catalog version bumps, and synchronous GSI maintenance.  At
+hundreds of sessions those per-statement costs dominate.  Here,
+plan-identical autocommit point DMLs (single-row INSERT VALUES, point
+UPDATE/DELETE on one equality key) arriving within the adaptive window
+coalesce into ONE flush:
+
+- one shared flush-time TSO for the whole group (all members were
+  concurrent; they linearize at the flush instant — the Tailwind
+  amortization argument applied to mutations),
+- one vectorized apply per touched partition: INSERT members' rows encode
+  and append as one `insert_pylists` call; UPDATE/DELETE keys resolve
+  through `exec/operators.batched_point_lookup` (the same one-dispatch CSR
+  program the read batcher uses) and stamp in one partition pass,
+- CDC emission, fragment-cache invalidation and catalog version bumps
+  coalesced to once per flush instead of once per statement,
+- GSI maintenance and replica legs handed to the async applier
+  (txn/async_apply.py) with read-your-writes fencing.
+
+Per-session error isolation mirrors the read batcher: a poisoned key
+(FP_DML_POISON_KEY — the duplicate-key/constraint stand-in), a NOT NULL
+violation, a per-key routing error, or a write conflict fails ONLY its own
+session(s); any group-scope failure falls every member back to the
+sequential path, bit-identical by construction.  UPDATE/DELETE members
+sharing one key inside a group also fall back (their effects are
+order-dependent; the sequential path serializes them under the store locks).
+
+Correctness envelope:
+
+- autocommit only: a transaction holding writes needs own-txn visibility and
+  undo registration — it bypasses structurally (`Session._try_batched_dml`).
+- group key carries the catalog schema_version; DDL between submit and flush
+  fails the re-check and the group falls back.  The flush holds shared MDL.
+- remote and archive-backed tables never register batch plans.
+
+Escape hatches (the established trio): `DML_BATCH(OFF)` hint (any hint
+comment structurally pins the statement to the sequential path and blocks
+registration), `ENABLE_DML_BATCHING` param, `GALAXYSQL_DML_BATCHING=0` env.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from galaxysql_tpu.server.batch_scheduler import BatchRequest, BatchScheduler
+from galaxysql_tpu.sql import ast
+from galaxysql_tpu.sql.parameterize import DecimalParam
+from galaxysql_tpu.utils import errors
+from galaxysql_tpu.utils.failpoint import FAIL_POINTS, FP_DML_POISON_KEY, \
+    FailPointError
+
+# kill switch: GALAXYSQL_DML_BATCHING=0 disables the whole write batcher
+ENABLED = os.environ.get("GALAXYSQL_DML_BATCHING", "1") != "0"
+
+
+# -- plan registration ---------------------------------------------------------
+#
+# A "DML batch plan" is the write-side PointPlan: the archetypal statement
+# shape extracted once (after a successful SEQUENTIAL execution validated it)
+# and keyed by the parameterized text, so later executions skip parse+bind
+# entirely and can coalesce.  Sources map each written column / the key to
+# either a parameterize slot index or a constant.
+
+def _literal_source(e, vals, cursor):
+    """AST literal -> ("slot", i) | ("const", v) advancing the slot cursor.
+    Returns (source, cursor) or (None, cursor) when the shape won't register."""
+    if isinstance(e, ast.NumberLit) or (
+            isinstance(e, ast.Unary) and e.op == "-" and
+            isinstance(e.arg, ast.NumberLit)):
+        want = e.value if isinstance(e, ast.NumberLit) else -e.arg.value
+        if cursor < len(vals):
+            v = vals[cursor]
+            got = v.value if isinstance(v, DecimalParam) else v
+            if got == want:
+                return ("slot", cursor), cursor + 1
+        return ("const", want), cursor
+    if isinstance(e, ast.StringLit):
+        if cursor < len(vals) and vals[cursor] == e.value:
+            return ("slot", cursor), cursor + 1
+        return ("const", e.value), cursor
+    if isinstance(e, ast.NullLit):
+        return ("const", None), cursor
+    return None, cursor
+
+
+def _eq_key(where, vals, cursor):
+    """WHERE col = <literal> -> (col_name, source, cursor) or None."""
+    if not (isinstance(where, ast.Binary) and where.op == "=" and
+            isinstance(where.left, ast.Name)):
+        return None
+    src, cursor = _literal_source(where.right, vals, cursor)
+    if src is None:
+        return None
+    return where.left.parts[-1], src, cursor
+
+
+def try_register(session, stmt, sql: str, params) -> None:
+    """Register a DML batch plan after a successful sequential execution.
+    Mirrors `Session._register_point_plan`: only archetypal shapes register,
+    and hinted statements never do."""
+    inst = session.instance
+    sched = getattr(inst, "dml_batch_scheduler", None)
+    if sched is None or not sched.enabled(session):
+        return
+    if not sql or "/*" in sql or getattr(stmt, "hints", None):
+        return
+    from galaxysql_tpu.sql.parameterize import parameterize
+    p = parameterize(sql)
+    if not p.slots:
+        return  # no parameterized literal: nothing identical to coalesce on
+    key = ((session.schema or "").lower(), p.cache_key)
+    if key in inst.dml_plans:
+        return
+    try:
+        vals = p.resolve(params or [])
+    except Exception:
+        return
+    schema = stmt.table.schema or session.schema
+    if not schema:
+        return
+    try:
+        tm = inst.catalog.table(schema, stmt.table.table)
+    except Exception:
+        return
+    if getattr(tm, "remote", None) is not None:
+        return
+    if inst.archive.files_for(f"{tm.schema.lower()}.{tm.name.lower()}",
+                              None):
+        return  # archived cold rows: the flush would only ever fall back
+    plan = _extract_plan(stmt, tm, vals)
+    if plan is None:
+        return
+    plan["schema"] = tm.schema
+    plan["table"] = tm.name
+    plan["schema_version"] = inst.catalog.schema_version
+    if len(inst.dml_plans) > 512:
+        inst.dml_plans.clear()
+    inst.dml_plans[key] = plan
+
+
+def _extract_plan(stmt, tm, vals) -> Optional[dict]:
+    cursor = 0
+    if isinstance(stmt, ast.Insert):
+        if stmt.select is not None or stmt.rows is None or \
+                len(stmt.rows) != 1 or stmt.ignore or stmt.replace or \
+                stmt.on_dup_update:
+            return None
+        columns = stmt.columns or tm.column_names()
+        row = stmt.rows[0]
+        if len(row) != len(columns):
+            return None
+        sources = []
+        for e in row:
+            src, cursor = _literal_source(e, vals, cursor)
+            if src is None:
+                return None
+            sources.append(src)
+        if cursor != len(vals):
+            return None  # unconsumed params: shape has literals we missed
+        cols = []
+        try:
+            cols = [tm.column(c).name for c in columns]
+        except Exception:
+            return None
+        # the poison/fallback identity key: the first primary-key column's
+        # value when present, else the first column's
+        key_ix = 0
+        if tm.primary_key:
+            for i, c in enumerate(cols):
+                if c == tm.primary_key[0]:
+                    key_ix = i
+                    break
+        return {"kind": "insert", "columns": cols, "sources": sources,
+                "key_ix": key_ix}
+    if isinstance(stmt, ast.Delete):
+        if stmt.order_by or stmt.limit is not None:
+            return None
+        ek = _eq_key(stmt.where, vals, cursor)
+        if ek is None:
+            return None
+        col, src, cursor = ek
+        if cursor != len(vals):
+            return None
+        try:
+            key_col = tm.column(col).name
+        except Exception:
+            return None
+        return {"kind": "delete", "key_col": key_col, "key_src": src}
+    if isinstance(stmt, ast.Update):
+        if not isinstance(stmt.table, ast.TableName) or stmt.order_by or \
+                stmt.limit is not None:
+            return None
+        sets = []
+        for name, vexpr in stmt.sets:
+            src, cursor = _literal_source(vexpr, vals, cursor)
+            if src is None:
+                return None
+            try:
+                cm = tm.column(name.simple)
+            except Exception:
+                return None
+            sets.append((cm.name, src))
+        ek = _eq_key(stmt.where, vals, cursor)
+        if ek is None:
+            return None
+        col, ksrc, cursor = ek
+        if cursor != len(vals):
+            return None
+        try:
+            key_col = tm.column(col).name
+        except Exception:
+            return None
+        if any(c.lower() == key_col.lower() for c, _ in sets):
+            return None  # SET of the match key: order-sensitive, sequential
+        return {"kind": "update", "key_col": key_col, "key_src": ksrc,
+                "sets": sets}
+    return None
+
+
+def _src_value(src, vals):
+    kind, v = src
+    v = vals[v] if kind == "slot" else v
+    return v.value if isinstance(v, DecimalParam) else v
+
+
+def _encode_set_value(tm, cname: str, value):
+    """One member's SET value -> (lane scalar, valid) exactly mirroring the
+    sequential `Session._run_update` encode branches (dictionary codes for
+    string literals; otherwise the binder-literal + Cast compile path), so
+    batched and sequential updates are bit-identical."""
+    from galaxysql_tpu.expr import ir
+    from galaxysql_tpu.expr.compiler import ExprCompiler
+    from galaxysql_tpu.types import datatype as dt
+    cm = tm.column(cname)
+    target = cm.dtype
+    if target.is_string and isinstance(value, str):
+        d = tm.dictionaries[cm.name.lower()]
+        return np.asarray(d.encode_one(value, add=True), np.int32), True
+    if isinstance(value, DecimalParam):
+        e = ir.Literal(value.value, dt.decimal(18, value.scale))
+    elif value is None:
+        e = ir.lit(None, dt.NULLTYPE)
+    else:
+        e = ir.lit(value)
+    if not (e.dtype.clazz == target.clazz and e.dtype.scale == target.scale) \
+            and e.dtype.clazz != dt.TypeClass.NULL and not target.is_string:
+        e = ir.Cast(e, target)
+    data, valid = ExprCompiler(np).compile(e)({})
+    ok = True if valid is None else bool(np.all(np.asarray(valid)))
+    return np.asarray(data).astype(cm.dtype.lane), ok
+
+
+class DmlBatchScheduler(BatchScheduler):
+    """Leader/follower write batcher; sessions reach it via
+    `Session._try_batched_dml`.  Inherits the read batcher's collection
+    protocol (adaptive concurrency-gated window, group-commit pacing,
+    early-seal, safety-net timeouts) and replaces execution with the
+    vectorized write flush."""
+
+    WINDOW_PARAM = "DML_BATCH_WINDOW_US"
+
+    def __init__(self, instance):
+        super().__init__(instance)
+        m = instance.metrics
+        self.batched = m.counter(
+            "dml_batched_queries", "DML statements served by a batch group")
+        self.flushes = m.counter(
+            "dml_batch_flushes", "DML batch group executions")
+        self.fallbacks = m.counter(
+            "dml_batch_fallbacks",
+            "DML batch members returned to the sequential path")
+        self.singletons = m.counter(
+            "dml_batch_singletons", "DML groups flushed with a single member")
+
+    def enabled(self, session) -> bool:
+        return ENABLED and bool(self.instance.config.get(
+            "ENABLE_DML_BATCHING", session.vars))
+
+    def _async_apply_on(self) -> bool:
+        return bool(self.instance.config.get("ENABLE_ASYNC_APPLY"))
+
+    # -- group execution -------------------------------------------------------
+
+    def _execute(self, gkey: Tuple, pp: dict, pinned_ts: Optional[int],
+                 reqs: List[BatchRequest]):
+        inst = self.instance
+        if inst.catalog.schema_version != pp["schema_version"]:
+            raise RuntimeError("schema changed under the group")  # -> fallback
+        tm = inst.catalog.table(pp["schema"], pp["table"])
+        store = inst.store(pp["schema"], pp["table"])
+        inst_key = f"{tm.schema.lower()}.{tm.name.lower()}"
+        if inst.archive.files_for(inst_key, None):
+            # cold rows moved in since registration: evict the plan so later
+            # statements go sequential directly instead of paying a window +
+            # fallback on every execution
+            inst.dml_plans.pop((gkey[0], gkey[1]), None)
+            raise RuntimeError("archive-backed table")  # group falls back
+        # ONE shared flush-time TSO: every member's write stamps at the same
+        # instant they linearize at (group commit for autocommit writes)
+        ts = inst.tso.next_timestamp()
+        poison = FAIL_POINTS.value(FP_DML_POISON_KEY) \
+            if FAIL_POINTS.active else None
+        cdc_sink: List[tuple] = []
+        tasks: List[dict] = []
+        with inst.mdl.shared({inst_key}):
+            if pp["kind"] == "insert":
+                self._flush_insert(pp, tm, store, reqs, ts, poison,
+                                   cdc_sink, tasks)
+            else:
+                self._flush_point_write(pp, tm, store, reqs, ts, poison,
+                                        cdc_sink, tasks)
+        # per-flush (not per-statement) epilogue: one CDC metadb transaction,
+        # one version bump, one fragment-cache invalidation
+        inst.cdc.write_events(ts, cdc_sink)
+        tm.bump_version()
+        fcache = getattr(inst, "frag_cache", None)
+        if fcache is not None:
+            fcache.invalidate_table(inst_key)
+        if not tasks:
+            # sync-apply mode wrote the GSI stores inline: their versions
+            # bump here (the sequential path's _note_write contract) so
+            # version-keyed caches never serve a stale covering-index scan.
+            # Async mode bumps at apply time (AsyncApplier._finish_batch).
+            from galaxysql_tpu.server import session as _sess
+            for _i, gtm, _g in _sess.gsi_targets(inst, tm):
+                gtm.bump_version()
+                if fcache is not None:
+                    fcache.invalidate_table(
+                        f"{gtm.schema.lower()}.{gtm.name.lower()}")
+        inst.catalog.version += 1
+        mark = 0
+        if tasks:
+            mark = inst.applier.enqueue(tasks)
+        for r in reqs:
+            if r.error is None and not r.fallback:
+                r.apply_seq = mark
+
+    # -- INSERT ---------------------------------------------------------------
+
+    def _flush_insert(self, pp, tm, store, reqs, ts, poison, cdc_sink, tasks):
+        cols = pp["columns"]
+        sources = pp["sources"]
+        key_ix = pp["key_ix"]
+        by_col: Dict[str, list] = {c: [] for c in cols}
+        served: List[BatchRequest] = []
+        for r in reqs:
+            vals = r.lane_val  # the member's resolved parameter values
+            row = [_src_value(s, vals) for s in sources]
+            if poison is not None and row[key_ix] == poison:
+                r.error = FailPointError(
+                    f"failpoint {FP_DML_POISON_KEY} fired (key {row[key_ix]!r})")
+                continue
+            err = self._row_error(tm, cols, row)
+            if err is not None:
+                r.error = err
+                continue
+            for c, v in zip(cols, row):
+                by_col[c].append(v)
+            served.append(r)
+        if not served:
+            return
+        # append_lock: the before/after range derivation below must not
+        # interleave with another flush's (or a sequential writer's) appends
+        with store.append_lock:
+            try:
+                # encode strictly BEFORE any mutation: one member's bad
+                # value (a type the column can't encode) falls the whole
+                # group back to the sequential path, where only that member
+                # fails with its own attribution
+                lanes, valid, nrows = store.encode_pylists(by_col)
+            except Exception:
+                for r in served:
+                    r.fallback = True
+                return
+            before = [p.num_rows for p in store.partitions]
+            try:
+                store.append_encoded(lanes, valid, nrows, ts)
+            except Exception as ex:
+                # mutation may be partial: errors are per-member from here —
+                # a fallback would re-apply rows that already landed
+                for r in served:
+                    r.error = ex
+                return
+            ranges = [(pid, before[pid], p.num_rows - before[pid])
+                      for pid, p in enumerate(store.partitions)
+                      if p.num_rows - before[pid]]
+        async_on = self._async_apply_on() and _has_gsi(self.instance, tm)
+        for pid, start, added in ranges:
+            self.instance.cdc.capture_range(tm, store, pid, start,
+                                            added, ts, sink=cdc_sink)
+            if async_on:
+                tasks.append({"kind": "gsi_insert", "tm": tm, "store": store,
+                              "pid": pid, "start": start, "n": added,
+                              "ts": ts})
+            else:
+                from galaxysql_tpu.server import session as _sess
+                _sess.gsi_write_rows(self.instance, tm, store, pid,
+                                     start, added, ts, None)
+        for r in served:
+            r.affected = 1
+
+    @staticmethod
+    def _row_error(tm, cols, row):
+        """Per-member NOT NULL validation: the sequential path's store-level
+        check, applied per row so one bad member cannot poison the group."""
+        have = dict(zip(cols, row))
+        for c in tm.columns:
+            v = have.get(c.name, c.default)
+            if v is None and not c.nullable and c.default is None \
+                    and not c.auto_increment:
+                return errors.TddlError(f"Column '{c.name}' cannot be null")
+        return None
+
+    # -- point UPDATE / DELETE ------------------------------------------------
+
+    def _flush_point_write(self, pp, tm, store, reqs, ts, poison,
+                           cdc_sink, tasks):
+        from galaxysql_tpu.exec.device_cache import GLOBAL_DEVICE_CACHE
+        from galaxysql_tpu.exec.operators import batched_point_lookup
+        from galaxysql_tpu.plan.rules import _lane_encode
+        from galaxysql_tpu.storage.table_store import INFINITY_TS
+        key_col = pp["key_col"]
+        kind = pp["kind"]
+        # unique keys only: members sharing a key are order-dependent — they
+        # fall back and serialize on the sequential path
+        by_key: Dict[Any, List[BatchRequest]] = {}
+        lanes: Dict[Any, Any] = {}
+        for r in reqs:
+            kv = _src_value(pp["key_src"], r.lane_val)
+            if poison is not None and kv == poison:
+                r.error = FailPointError(
+                    f"failpoint {FP_DML_POISON_KEY} fired (key {kv!r})")
+                continue
+            if kv is None:
+                r.affected = 0  # eq NULL matches nothing, like the read path
+                continue
+            lane = _lane_encode(tm, key_col, kv)
+            if lane is None:
+                r.fallback = True
+                continue
+            by_key.setdefault(lane, []).append(r)
+        uvals, members = [], []
+        for lane, rs in by_key.items():
+            if len(rs) > 1:
+                for r in rs:
+                    r.fallback = True
+                continue
+            uvals.append(lane)
+            members.append(rs[0])
+        if not uvals:
+            return
+        errs: List[Optional[BaseException]] = [None] * len(uvals)
+        # UPDATE set-values encode BEFORE any mutation: a bad cast fails its
+        # member here, never mid-flush with partitions half-stamped
+        set_scalars: List[Optional[list]] = [None] * len(uvals)
+        if kind == "update":
+            for u, r in enumerate(members):
+                try:
+                    set_scalars[u] = [
+                        (cname,) + _encode_set_value(
+                            tm, cname, _src_value(src, r.lane_val))
+                        for cname, src in pp["sets"]]
+                except Exception as ex:
+                    errs[u] = ex
+        by_pid = self._route(tm, key_col, uvals, errs,
+                             len(store.partitions))
+        counts = [0] * len(uvals)
+        async_on = self._async_apply_on() and _has_gsi(self.instance, tm)
+        from galaxysql_tpu.server import session as _sess
+        for pid in sorted(by_pid):
+            part = store.partitions[pid]
+            if part.num_rows == 0:
+                continue
+            sub = [u for u in by_pid[pid] if errs[u] is None]
+            if not sub:
+                continue
+            sub_vals = [uvals[i] for i in sub]
+            try:
+                ids, offs = batched_point_lookup(
+                    store, pid, part, key_col, tm.version, sub_vals, ts, 0,
+                    device_cache=GLOBAL_DEVICE_CACHE)
+            except Exception as ex:
+                for u in sub:  # this partition's keys only; others proceed
+                    errs[u] = ex
+                continue
+            if ids.size == 0:
+                continue
+            # append_lock before the partition lock (the appender
+            # ordering everywhere): update_rows appends new MVCC versions a
+            # concurrent inserter's range derivation must not swallow
+            try:
+              with store.append_lock, part.lock:
+                # first-writer-wins re-check under the lock (the sequential
+                # path's _check_write_conflict), per key so one contended row
+                # fails only its own session
+                conflict = part.end_ts[ids] != INFINITY_TS
+                keep: List[Tuple[int, int, int]] = []  # (u, lo, hi)
+                for j, u in enumerate(sub):
+                    lo, hi = int(offs[j]), int(offs[j + 1])
+                    if hi <= lo:
+                        continue
+                    if conflict[lo:hi].any():
+                        errs[u] = errors.TransactionError(
+                            "write conflict: row locked or deleted by a "
+                            "concurrent transaction")
+                        continue
+                    keep.append((u, lo, hi))
+                if not keep:
+                    continue
+                ok_ids = np.concatenate([ids[lo:hi] for _, lo, hi in keep])
+                seg_sizes = [hi - lo for _, lo, hi in keep]
+                self.instance.cdc.capture_rows(tm, store, pid, ok_ids,
+                                               "delete", ts, sink=cdc_sink)
+                if async_on:
+                    tasks.append({"kind": "gsi_delete", "tm": tm,
+                                  "store": store, "pid": pid,
+                                  "row_ids": ok_ids.copy(), "ts": ts})
+                else:
+                    _sess.gsi_delete(self.instance, tm, store, pid, ok_ids,
+                                     ts, None)
+                if kind == "delete":
+                    part.delete_rows(ok_ids, ts)
+                else:
+                    start = part.num_rows
+                    nl, nv = self._set_lanes(
+                        tm, pp["sets"],
+                        [set_scalars[u] for u, _, _ in keep], seg_sizes)
+                    part.update_rows(ok_ids, nl, nv, ts)
+                    if async_on:
+                        tasks.append({"kind": "gsi_insert", "tm": tm,
+                                      "store": store, "pid": pid,
+                                      "start": start, "n": ok_ids.size,
+                                      "ts": ts})
+                    else:
+                        _sess.gsi_write_rows(self.instance, tm, store, pid,
+                                             start, ok_ids.size, ts, None)
+                    self.instance.cdc.capture_range(tm, store, pid, start,
+                                                    ok_ids.size, ts,
+                                                    sink=cdc_sink)
+                for (u, _lo, _hi), nmatch in zip(keep, seg_sizes):
+                    counts[u] += nmatch
+            except Exception as ex:
+                # mutation may have begun: errors are strictly PER-MEMBER
+                # from here (a group fallback would re-apply partitions that
+                # already stamped).  Keys already counted keep their result.
+                for u in sub:
+                    if errs[u] is None and counts[u] == 0:
+                        errs[u] = ex
+        ndel = 0
+        for u, r in enumerate(members):
+            if r.error is None and errs[u] is not None:
+                r.error = errs[u]
+            elif r.error is None and not r.fallback:
+                r.affected = counts[u]
+                ndel += counts[u]
+        if kind == "delete" and ndel:
+            tm.stats.row_count = max(tm.stats.row_count - ndel, 0)
+
+    @staticmethod
+    def _set_lanes(tm, sets, member_scalars, seg_sizes):
+        """Per-partition SET lanes: each kept member's pre-encoded scalar
+        repeated over its matched segment (one np.repeat per set column)."""
+        new_lanes: Dict[str, np.ndarray] = {}
+        new_valid: Dict[str, np.ndarray] = {}
+        reps = np.asarray(seg_sizes)
+        for ci, (cname, _src) in enumerate(sets):
+            cm = tm.column(cname)
+            datas = [ms[ci][1] for ms in member_scalars]
+            valids = [ms[ci][2] for ms in member_scalars]
+            new_lanes[cm.name] = np.repeat(
+                np.asarray(datas, dtype=cm.dtype.lane), reps)
+            new_valid[cm.name] = np.repeat(
+                np.asarray(valids, dtype=np.bool_), reps)
+        return new_lanes, new_valid
+
+    # -- bookkeeping -----------------------------------------------------------
+
+    def _bulk_finish(self, pp: dict, reqs: List[BatchRequest], flush_t: float):
+        """Leader-side group finish, mirroring the read batcher: all
+        per-statement profile/metric work happens once per FLUSH so the woken
+        member's serialized tail stays minimal."""
+        from galaxysql_tpu.utils.metrics import DML_GROUP_SIZE, DML_WAIT_MS
+        from galaxysql_tpu.utils.tracing import GLOBAL_STATS
+        DML_GROUP_SIZE.observe(len(reqs))
+        self.flushes.inc()
+        end_t = time.perf_counter()
+        exec_us = (end_t - flush_t) * 1e6
+        nfall = 0
+        waits = []
+        served = []
+        serve_ms = []
+        n = len(reqs)
+        for r in reqs:
+            r.group_size = n
+            wait_us = (flush_t - r.t0) * 1e6
+            r.wait_us = wait_us
+            waits.append(wait_us / 1000.0)
+            if r.fallback:
+                nfall += 1
+                continue
+            if r.error is not None or r.prof is None:
+                continue
+            p = r.prof
+            p.workload = "TP"
+            p.engine = "dml_batch"
+            p.rows = r.affected
+            total_us = wait_us + exec_us
+            p.elapsed_ms = round(total_us / 1000.0, 3)
+            p.trace = [f"trace-id {p.trace_id}",
+                       f"dml-batch {pp['table']} {pp['kind']} "
+                       f"[group={n} wait={wait_us:.0f}us "
+                       f"exec={exec_us:.0f}us]",
+                       f"elapsed={total_us / 1e6:.3f}s workload=TP"]
+            served.append(p)
+            serve_ms.append(total_us / 1000.0)
+        DML_WAIT_MS.observe_many(waits)
+        if nfall:
+            self.fallbacks.inc(nfall)
+        if served:
+            inst = self.instance
+            inst.profiles.record_many(served)
+            lat_h, q_total, q_wl, q_eng = inst.finish_handles("TP",
+                                                              "dml_batch")
+            lat_h.observe_many(serve_ms)
+            q_total.inc(len(served))
+            q_wl.inc(len(served))
+            q_eng.inc(len(served))
+            GLOBAL_STATS.bump("queries", len(served))
+            self.batched.inc(len(served))
+
+    # -- observability ---------------------------------------------------------
+
+    def stats_rows(self) -> List[Tuple[str, float]]:
+        """DML-group rows for SHOW BATCH STATS / info_schema.batch_stats,
+        prefixed so they compose with the read batcher's rows."""
+        from galaxysql_tpu.utils.metrics import DML_GROUP_SIZE, DML_WAIT_MS
+        gs = DML_GROUP_SIZE.quantiles()
+        ws = DML_WAIT_MS.quantiles()
+        mean_group = (DML_GROUP_SIZE.sum / DML_GROUP_SIZE.count) \
+            if DML_GROUP_SIZE.count else 0.0
+        applier = getattr(self.instance, "applier", None)
+        with self._lock:
+            open_groups = len(self._groups)
+            window_us = self._window_s() * 1e6
+        return [
+            ("dml_batched_queries", float(self.batched.value)),
+            ("dml_batch_flushes", float(self.flushes.value)),
+            ("dml_batch_fallbacks", float(self.fallbacks.value)),
+            ("dml_batch_singletons", float(self.singletons.value)),
+            ("dml_group_size_mean", round(mean_group, 3)),
+            ("dml_group_size_p50", float(gs[0.5])),
+            ("dml_group_size_p95", float(gs[0.95])),
+            ("dml_group_size_p99", float(gs[0.99])),
+            ("dml_wait_ms_p50", float(ws[0.5])),
+            ("dml_wait_ms_p95", float(ws[0.95])),
+            ("dml_window_us", round(window_us, 1)),
+            ("dml_open_groups", float(open_groups)),
+            ("dml_inflight", float(self._inflight)),
+            ("gsi_apply_backlog",
+             float(applier.backlog_gauge.value) if applier else 0.0),
+            ("gsi_apply_lag_ms",
+             round(applier.lag_ms(), 3) if applier else 0.0),
+        ]
+
+
+def _has_gsi(instance, tm) -> bool:
+    from galaxysql_tpu.server import session as _sess
+    return bool(_sess.gsi_targets(instance, tm))

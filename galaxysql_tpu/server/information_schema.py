@@ -223,8 +223,10 @@ def refresh(instance, session=None):
     fill("fragment_cache", ([k, t, r, b, h] for k, t, r, b, h in
                             (fcache.rows() if fcache is not None else [])))
     sched = getattr(instance, "batch_scheduler", None)
+    dsched = getattr(instance, "dml_batch_scheduler", None)
     fill("batch_stats", ([n, float(v)] for n, v in
-                         (sched.stats_rows() if sched is not None else [])))
+                         (sched.stats_rows() if sched is not None else []) +
+                         (dsched.stats_rows() if dsched is not None else [])))
     fill("workers", (list(r) for r in instance.worker_rows()))
     adm = getattr(instance, "admission", None)
     fill("admission_stats", ([n, float(v)] for n, v in

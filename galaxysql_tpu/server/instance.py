@@ -67,6 +67,7 @@ class Instance:
         self.ha = HaManager(self)
         from galaxysql_tpu.utils.metrics import (BATCH_GROUP_SIZE,
                                                  BATCH_WAIT_MS, BREAKER_OPENS,
+                                                 DML_GROUP_SIZE, DML_WAIT_MS,
                                                  MetricsRegistry, QUERY_TIMEOUTS,
                                                  RETRY_BUDGET_EXHAUSTED,
                                                  RPC_FAILURES, RPC_RETRIES,
@@ -86,6 +87,8 @@ class Instance:
         self.metrics.adopt(RPC_RTT_MS)
         self.metrics.adopt(BATCH_GROUP_SIZE)
         self.metrics.adopt(BATCH_WAIT_MS)
+        self.metrics.adopt(DML_GROUP_SIZE)
+        self.metrics.adopt(DML_WAIT_MS)
         # fault-tolerance plane counters (net/dn.py retry/breaker, SyncBus
         # healing, deadline kills) — process-shared, surfaced per instance
         for m in (RPC_RETRIES, RPC_FAILURES, BREAKER_OPENS, WORKER_FAILOVERS,
@@ -130,6 +133,19 @@ class Instance:
         # window coalesce into one vectorized dispatch per partition
         from galaxysql_tpu.server.batch_scheduler import BatchScheduler
         self.batch_scheduler = BatchScheduler(self)
+        # cross-session DML batching (server/dml_batch.py): plan-identical
+        # autocommit point writes coalesce into one vectorized flush with a
+        # shared flush-time TSO, coalesced CDC/version bumps, and async
+        # GSI/replica apply — the write-side mirror of the read batcher
+        from galaxysql_tpu.server.dml_batch import DmlBatchScheduler
+        self.dml_batch_scheduler = DmlBatchScheduler(self)
+        # (schema, parameterized-sql) -> DML batch plan (write-side
+        # PointPlans; server/dml_batch.try_register)
+        self.dml_plans: Dict[tuple, dict] = {}
+        # background applier for GSI maintenance + replica DML legs with
+        # read-your-writes watermark fencing (txn/async_apply.py)
+        from galaxysql_tpu.txn.async_apply import AsyncApplier
+        self.applier = AsyncApplier(self)
         # overload plane (server/admission.py): workload-class admission gate
         # (AIMD limits, deadline-aware shedding) + the memory-pressure
         # governor (tiered fragment-cache/spill/AP-refusal responses)
@@ -235,6 +251,16 @@ class Instance:
         """Flush all table data + metadata to disk (checkpoint)."""
         if not self.data_dir:
             return
+        # pending async GSI/replica applies must land before the snapshot:
+        # a checkpoint taken mid-apply would persist a base table whose GSI
+        # rows exist only in the in-memory queue — and that queue has no
+        # redo source, so saving anyway would freeze the divergence forever.
+        # A wedged applier therefore fails the checkpoint LOUDLY.
+        applier = getattr(self, "applier", None)
+        if applier is not None and not applier.drain():
+            raise errors.TddlError(
+                "checkpoint aborted: async GSI/replica applies did not "
+                "drain (backlog wedged); retry after the applier recovers")
         # marker time is captured BEFORE the store snapshots: a txn committing
         # while save() runs may have provisional stamps in an already-written
         # npz, so tx-log purge may only drop entries resolved before this point

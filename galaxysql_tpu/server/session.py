@@ -103,6 +103,82 @@ class Transaction:
         return seen.values()
 
 
+def gsi_targets(instance, tm):
+    out = []
+    for i in tm.indexes:
+        if i.global_index and i.status in ("WRITE_ONLY", "PUBLIC"):
+            gsi_name = f"{tm.name}${i.name}"
+            try:
+                gtm = instance.catalog.table(tm.schema, gsi_name)
+                out.append((i, gtm, instance.store(tm.schema, gsi_name)))
+            except (errors.UnknownTableError, KeyError):
+                pass
+    return out
+
+
+def gsi_write_rows(instance, tm, base_store, pid: int, start: int, n: int,
+                   ts: int, txn):
+    """Propagate base rows appended at [start, start+n) into every GSI store.
+
+    Writes carry the same (possibly provisional) timestamp and register with
+    the transaction so COMMIT finalizes and ROLLBACK undoes them with the
+    base rows."""
+    targets = gsi_targets(instance, tm)
+    if not targets or n == 0:
+        return
+    p = base_store.partitions[pid]
+    for _i, gtm, gstore in targets:
+        cols = gtm.column_names()
+        lanes = {c: p.lanes[c][start:start + n] for c in cols}
+        valid = {c: p.valid[c][start:start + n] for c in cols}
+        pids = gstore._route(lanes)
+        # the GSI store's append_lock: the (before, append) pair below must
+        # not interleave with another GSI writer's appends or the undo range
+        # would cover the other writer's rows (same race append_lock closes
+        # on the base store)
+        with gstore.append_lock:
+            for gp in np.unique(pids):
+                sel = np.nonzero(pids == gp)[0]
+                gpart = gstore.partitions[int(gp)]
+                before = gpart.num_rows
+                gpart.append({k: v[sel] for k, v in lanes.items()},
+                             {k: v[sel] for k, v in valid.items()}, ts)
+                if txn is not None:
+                    txn.inserted.append((gstore, int(gp), before, sel.size))
+
+
+def _pk_void(arrays: List[np.ndarray]) -> np.ndarray:
+    """Pack parallel key arrays into one comparable lane (exact tuple
+    matching — per-column isin would match the cross product of composite
+    keys)."""
+    return np.rec.fromarrays(arrays)
+
+
+def gsi_delete(instance, tm, base_store, pid: int, row_ids: np.ndarray,
+               ts: int, txn):
+    """Remove the GSI entries of deleted base rows, matched on primary key."""
+    if not tm.primary_key:
+        return
+    targets = gsi_targets(instance, tm)
+    if not targets:
+        return
+    p = base_store.partitions[pid]
+    del_keys = _pk_void([p.lanes[c][row_ids] for c in tm.primary_key])
+    for _i, gtm, gstore in targets:
+        if not all(gtm.has_column(c) for c in tm.primary_key):
+            continue
+        for gp_id, gp in enumerate(gstore.partitions):
+            vis = gp.visible_mask(None)
+            keys = _pk_void([gp.lanes[c] for c in tm.primary_key])
+            mask = vis & np.isin(keys, del_keys)
+            ids = np.nonzero(mask)[0]
+            if ids.size:
+                if txn is not None:
+                    txn.deleted.append((gstore, gp_id, ids,
+                                        gp.end_ts[ids].copy()))
+                gp.delete_rows(ids, ts)
+
+
 class Session:
     # bound on each replica DML leg (a hung replica goes stale after this,
     # it must not stall the statement for socket-timeout x retries)
@@ -164,6 +240,8 @@ class Session:
 
     _SELECT_RE = __import__("re").compile(
         r"^\s*(?:/\*.*?\*/\s*)*select\b", __import__("re").I | __import__("re").S)
+    _DML_RE = __import__("re").compile(
+        r"^\s*(?:insert|update|delete)\b", __import__("re").I)
 
     def _execute_one(self, sql: str, params: Optional[list]) -> ResultSet:
         # statement deadline: one config lookup; MAX_EXECUTION_TIME=0 (the
@@ -176,8 +254,85 @@ class Session:
             # ~1ms) per execution is pure waste; authorization runs against the
             # plan's AST in _run_query_admitted (TP latency floor, SURVEY §3.2)
             return self._run_query(None, sql, params)
+        if self.txn is None and self.instance.dml_plans and \
+                "/*" not in sql and self._DML_RE.match(sql):
+            # DML hot path, the write-side mirror of the SELECT one: a
+            # registered batch plan executes without parse or bind, coalesced
+            # with plan-identical statements from concurrent sessions
+            # (server/dml_batch.py).  Hinted statements never take it.
+            # The WHOLE statement (batched or sequential fallback) brackets
+            # the scheduler's in-flight gate: live DML concurrency is the
+            # signal the adaptive window keys off.
+            sched = getattr(self.instance, "dml_batch_scheduler", None)
+            if sched is not None:
+                sched.point_begin()
+                try:
+                    rs = self._try_batched_dml(sql, params)
+                    if rs is not None:
+                        return rs
+                    stmt = parse(sql)
+                    return self.execute_statement(stmt, sql, params)
+                finally:
+                    sched.point_end()
         stmt = parse(sql)
         return self.execute_statement(stmt, sql, params)
+
+    def _try_batched_dml(self, sql: str,
+                         params: Optional[list]) -> Optional[ResultSet]:
+        """Submit this autocommit point DML to the cross-session write
+        batcher.  Returns the scattered result, or None when the session
+        must run the sequential path (no plan, batching disabled, window
+        closed, singleton group, or group-scope fallback)."""
+        sched = getattr(self.instance, "dml_batch_scheduler", None)
+        if sched is None or not sched.enabled(self) or not self.schema:
+            return None
+        schema = self.schema
+        p = parameterize(sql)
+        pp = self.instance.dml_plans.get((schema.lower(), p.cache_key))
+        if pp is None:
+            return None
+        if pp["schema_version"] != self.instance.catalog.schema_version:
+            self.instance.dml_plans.pop((schema.lower(), p.cache_key), None)
+            return None
+        try:
+            vals = p.resolve(params or [])
+        except Exception:
+            return None
+        # same privilege gate the sequential path applies to its AST
+        priv = {"insert": "INSERT", "update": "UPDATE",
+                "delete": "DELETE"}[pp["kind"]]
+        self.instance.privileges.check(self.user, priv,
+                                       pp["schema"], pp["table"])
+        self._apply_fence()
+        t0 = time.time()
+        prof = tracing.QueryProfile(
+            trace_id=self.instance.trace_ids.next(), sql=sql[:512],
+            schema=schema, conn_id=self.conn_id, started_at=t0)
+        from galaxysql_tpu.meta.statement_summary import counters_snapshot
+        self._ss0 = counters_snapshot(self.instance)
+        ticket = self.instance.admission.admit(self, sql)
+        try:
+            gkey = (schema.lower(), p.cache_key, pp["schema_version"])
+            req = sched.submit(gkey, pp, vals, None, prof)
+        except Exception:
+            ticket.release(error=True)
+            raise
+        if req is None:
+            # sequential fallback: release so the sequential ramp re-admits
+            ticket.release()
+            return None
+        if req.error is not None:
+            ticket.release(error=True)
+            raise req.error  # isolated to this session; members proceed
+        if req.apply_seq:
+            self._apply_mark = max(getattr(self, "_apply_mark", 0),
+                                   req.apply_seq)
+        # the leader bulk-finished profile/ring/metrics at scatter; the woken
+        # member's tail is the summary record + admission feedback only
+        self.last_trace = prof.trace
+        self._summary_record(sql, prof, "TP", "dml_batch", req.affected)
+        ticket.release(prof)
+        return ok(affected=req.affected)
 
     _PRIV_BY_STMT = {
         ast.Select: "SELECT", ast.SetOpSelect: "SELECT", ast.Insert: "INSERT",
@@ -243,35 +398,7 @@ class Session:
         if isinstance(stmt, (ast.Select, ast.SetOpSelect)):
             return self._run_query(stmt, sql, params)
         if isinstance(stmt, (ast.Insert, ast.Update, ast.Delete)):
-            # the MAX_EXECUTION_TIME hint must bind DML too (the SELECT path
-            # reads it off the cached plan; DML has no plan cache) — it rides
-            # self._deadline into the remote-DML RPC headers
-            from galaxysql_tpu.sql.hints import parse_hints
-            hint_ms = parse_hints(getattr(stmt, "hints", None)) \
-                .get("max_execution_time")
-            if hint_ms:
-                self._deadline = time.time() + hint_ms / 1000.0
-            # statement-scope shared MDL on every referenced table: a
-            # repartition cutover cannot swap partition metadata under
-            # in-flight DML
-            keys = {f"{(t.schema or self._require_schema()).lower()}"
-                    f".{t.table.lower()}" for t in self._stmt_tables(stmt)}
-            # DML rides the admission gate too (TP class): under overload a
-            # write queue must degrade typed, not pile unboundedly onto the
-            # store locks
-            ticket = self.instance.admission.admit(self, sql or "")
-            try:
-                with self.instance.mdl.shared(keys):
-                    if isinstance(stmt, ast.Insert):
-                        return self._run_insert(stmt, params)
-                    if isinstance(stmt, ast.Update):
-                        return self._run_update(stmt, params)
-                    return self._run_delete(stmt, params)
-            except Exception:
-                ticket.release(error=True)
-                raise
-            finally:
-                ticket.release()
+            return self._run_dml(stmt, sql, params)
         if isinstance(stmt, ast.CreateTable):
             return self._run_create_table(stmt)
         if isinstance(stmt, ast.DropTable):
@@ -369,6 +496,95 @@ class Session:
             return self._run_index_ddl(stmt, sql)
         raise errors.NotSupportedError(f"statement {type(stmt).__name__}")
 
+    def _run_dml(self, stmt, sql: str, params: Optional[list]) -> ResultSet:
+        """Sequential DML ramp: deadline hint, async-apply fencing, admission
+        gate, statement-scope MDL, dispatch — and on success, per-digest
+        statement-summary attribution (write costs must be as truthful as
+        read costs for the admission classifier) plus DML batch-plan
+        registration so later plan-identical executions can coalesce."""
+        # the MAX_EXECUTION_TIME hint must bind DML too (the SELECT path
+        # reads it off the cached plan; DML has no plan cache) — it rides
+        # self._deadline into the remote-DML RPC headers
+        from galaxysql_tpu.sql.hints import parse_hints
+        hint_ms = parse_hints(getattr(stmt, "hints", None)) \
+            .get("max_execution_time")
+        if hint_ms:
+            self._deadline = time.time() + hint_ms / 1000.0
+        # statement-scope shared MDL on every referenced table: a
+        # repartition cutover cannot swap partition metadata under
+        # in-flight DML
+        keys = {f"{(t.schema or self._require_schema()).lower()}"
+                f".{t.table.lower()}" for t in self._stmt_tables(stmt)}
+        # read-your-writes fence (own async-apply watermark), plus a GLOBAL
+        # barrier when this DML touches a GSI-bearing table with applies
+        # still in flight: a sequential delete racing ahead of a pending
+        # async GSI insert would orphan the index row
+        self._apply_fence()
+        applier = getattr(self.instance, "applier", None)
+        if applier is not None and applier.pending():
+            try:
+                tms = [self.instance.catalog.table(*k.split(".", 1))
+                       for k in keys]
+            except Exception:
+                tms = []
+            if any(gsi_targets(self.instance, tm) for tm in tms):
+                applier.barrier(self._apply_wait_s())
+        t0 = time.time()
+        prof = tracing.QueryProfile(
+            trace_id=self.instance.trace_ids.next(),
+            sql=(sql or "<dml>")[:512], schema=self.schema or "",
+            conn_id=self.conn_id, started_at=t0)
+        from galaxysql_tpu.meta.statement_summary import counters_snapshot
+        self._ss0 = counters_snapshot(self.instance)
+        # DML rides the admission gate too (TP class): under overload a
+        # write queue must degrade typed, not pile unboundedly onto the
+        # store locks
+        ticket = self.instance.admission.admit(self, sql or "")
+        try:
+            with self.instance.mdl.shared(keys):
+                if isinstance(stmt, ast.Insert):
+                    rs = self._run_insert(stmt, params)
+                elif isinstance(stmt, ast.Update):
+                    rs = self._run_update(stmt, params)
+                else:
+                    rs = self._run_delete(stmt, params)
+        except Exception:
+            ticket.release(error=True)
+            raise
+        else:
+            prof.workload = "TP"
+            prof.engine = "dml"
+            prof.elapsed_ms = round((time.time() - t0) * 1000, 3)
+            # the digest's observed write cost feeds the statement summary +
+            # the admission classifier (truthful per-digest costs, PR 10/12)
+            self._summary_record(sql, prof, "TP", "dml", rs.affected)
+            if self.txn is None:
+                from galaxysql_tpu.server import dml_batch
+                dml_batch.try_register(self, stmt, sql, params)
+            return rs
+        finally:
+            ticket.release(prof)
+
+    def _apply_wait_s(self) -> float:
+        # NOT `ms or default`: a configured 0 means "never wait" (the house
+        # 0-as-disable convention), only an absent value takes the default
+        ms = self.instance.config.get("APPLY_WAIT_MS", self.vars)
+        return (10_000.0 if ms is None else float(ms)) / 1000.0
+
+    def _apply_fence(self):
+        """Read-your-writes: wait (bounded) until this session's own async
+        GSI/replica applies have landed.  One int compare when idle."""
+        mark = getattr(self, "_apply_mark", 0)
+        if not mark:
+            return
+        applier = getattr(self.instance, "applier", None)
+        if applier is None:
+            self._apply_mark = 0
+            return
+        if applier.applied_seq < mark:
+            applier.wait_applied(mark, self._apply_wait_s())
+        self._apply_mark = 0
+
     def _run_alter(self, stmt: ast.AlterTable, sql: str) -> ResultSet:
         from galaxysql_tpu.ddl.jobs import alter_table_job
         schema = stmt.table.schema or self._require_schema()
@@ -459,84 +675,33 @@ class Session:
         data = {c: [r[i] if i < len(r) else None for r in rows]
                 for i, c in enumerate(columns)}
         data = {tm.column(c).name: vals for c, vals in data.items()}
-        before = [p.num_rows for p in store.partitions]
-        n = store.insert_pylists(data, ts)
-        for pid, p in enumerate(store.partitions):
-            added = p.num_rows - before[pid]
-            if added:
-                if txn is not None:
-                    txn.inserted.append((store, pid, before[pid], added))
-                self._gsi_write_rows(tm, store, pid, before[pid], added, ts, txn)
+        with store.append_lock:
+            before = [p.num_rows for p in store.partitions]
+            n = store.insert_pylists(data, ts)
+            ranges = [(pid, before[pid], p.num_rows - before[pid])
+                      for pid, p in enumerate(store.partitions)
+                      if p.num_rows - before[pid]]
+        for pid, start, added in ranges:
+            if txn is not None:
+                txn.inserted.append((store, pid, start, added))
+            self._gsi_write_rows(tm, store, pid, start, added, ts, txn)
         return n
 
     # -- GSI write maintenance (online index writers, SURVEY.md App.D) -----------
+    # Module-level so the async applier (txn/async_apply.py) and the DML
+    # batch scheduler (server/dml_batch.py) apply the SAME maintenance the
+    # sequential path does; the Session methods delegate.
 
     def _gsi_targets(self, tm):
-        out = []
-        for i in tm.indexes:
-            if i.global_index and i.status in ("WRITE_ONLY", "PUBLIC"):
-                gsi_name = f"{tm.name}${i.name}"
-                try:
-                    gtm = self.instance.catalog.table(tm.schema, gsi_name)
-                    out.append((i, gtm, self.instance.store(tm.schema, gsi_name)))
-                except (errors.UnknownTableError, KeyError):
-                    pass
-        return out
+        return gsi_targets(self.instance, tm)
 
     def _gsi_write_rows(self, tm, base_store, pid: int, start: int, n: int,
                         ts: int, txn):
-        """Propagate base rows appended at [start, start+n) into every GSI store.
+        gsi_write_rows(self.instance, tm, base_store, pid, start, n, ts, txn)
 
-        Writes carry the same (possibly provisional) timestamp and register with the
-        transaction so COMMIT finalizes and ROLLBACK undoes them with the base rows."""
-        targets = self._gsi_targets(tm)
-        if not targets or n == 0:
-            return
-        p = base_store.partitions[pid]
-        for _i, gtm, gstore in targets:
-            cols = gtm.column_names()
-            lanes = {c: p.lanes[c][start:start + n] for c in cols}
-            valid = {c: p.valid[c][start:start + n] for c in cols}
-            pids = gstore._route(lanes)
-            for gp in np.unique(pids):
-                sel = np.nonzero(pids == gp)[0]
-                gpart = gstore.partitions[int(gp)]
-                before = gpart.num_rows
-                gpart.append({k: v[sel] for k, v in lanes.items()},
-                             {k: v[sel] for k, v in valid.items()}, ts)
-                if txn is not None:
-                    txn.inserted.append((gstore, int(gp), before, sel.size))
-
-    @staticmethod
-    def _pk_void(arrays: List[np.ndarray]) -> np.ndarray:
-        """Pack parallel key arrays into one comparable lane (exact tuple matching —
-        per-column isin would match the cross product of composite keys)."""
-        stacked = np.rec.fromarrays(arrays)
-        return stacked
-
-    def _gsi_delete(self, tm, base_store, pid: int, row_ids: np.ndarray, ts: int,
-                    txn):
-        """Remove the GSI entries of deleted base rows, matched on primary key."""
-        if not tm.primary_key:
-            return
-        targets = self._gsi_targets(tm)
-        if not targets:
-            return
-        p = base_store.partitions[pid]
-        del_keys = self._pk_void([p.lanes[c][row_ids] for c in tm.primary_key])
-        for _i, gtm, gstore in targets:
-            if not all(gtm.has_column(c) for c in tm.primary_key):
-                continue
-            for gp_id, gp in enumerate(gstore.partitions):
-                vis = gp.visible_mask(None)
-                keys = self._pk_void([gp.lanes[c] for c in tm.primary_key])
-                mask = vis & np.isin(keys, del_keys)
-                ids = np.nonzero(mask)[0]
-                if ids.size:
-                    if txn is not None:
-                        txn.deleted.append((gstore, gp_id, ids,
-                                            gp.end_ts[ids].copy()))
-                    gp.delete_rows(ids, ts)
+    def _gsi_delete(self, tm, base_store, pid: int, row_ids: np.ndarray,
+                    ts: int, txn):
+        gsi_delete(self.instance, tm, base_store, pid, row_ids, ts, txn)
 
     # -- DQL ------------------------------------------------------------------------
 
@@ -581,6 +746,8 @@ class Session:
         p = parameterize(sql)
         if engine in ("point", "batch"):
             fp, orders = "point", ""  # both serve the cached PointPlan shape
+        elif engine in ("dml", "dml_batch"):
+            fp, orders = "dml", ""  # write statements have no join order
         elif error and plan is None:
             fp, orders = "unknown", ""
         else:
@@ -641,6 +808,9 @@ class Session:
 
     def _run_query(self, stmt, sql: str, params: Optional[list]) -> ResultSet:
         schema = self._require_schema()
+        # read-your-writes: this session's own async GSI/replica applies must
+        # land before its reads (one int compare when nothing is pending)
+        self._apply_fence()
         t0 = time.time()
         prof = tracing.QueryProfile(trace_id=self.instance.trace_ids.next(),
                                     sql=(sql or "<stmt>")[:512], schema=schema,
@@ -1171,23 +1341,29 @@ class Session:
             self.instance.cdc.flush_txn(txn, cts)
             if txn.inserted or txn.deleted:
                 self.instance.catalog.version += 1
+            self._last_commit_ts = cts
             return
-        commit_ts = self.instance.tso.next_timestamp()
         # stamp via the XA participant helper (single home for the commit/rollback
         # stamping invariants; bump_version per store included).  The commit point
         # is logged FIRST: a crash mid-stamping would otherwise be resolved by
         # boot recovery as presumed-abort on the not-yet-stamped stores only —
-        # a half-committed txn (base table vs GSI diverging).
+        # a half-committed txn (base table vs GSI diverging).  TSO fetch +
+        # commit-point fsync ride the group-commit gate, amortized across
+        # concurrent committers (txn/xa.GroupCommitGate).
         from galaxysql_tpu.txn.xa import participants_of
         parts = participants_of(txn)
+        gate = self.instance.xa_coordinator.group_gate
         if parts:
-            self.instance.metadb.tx_log_put(txn.txn_id, "COMMITTED", commit_ts)
+            commit_ts = gate.commit_point(txn.txn_id)
             for sp in parts:
                 sp.commit(commit_ts)
-            self.instance.metadb.tx_log_put(txn.txn_id, "DONE", commit_ts)
+            gate.log_state(txn.txn_id, "DONE", commit_ts)
+        else:
+            commit_ts = self.instance.tso.next_timestamp()
         self.instance.cdc.flush_txn(txn, commit_ts)
         if txn.inserted or txn.deleted:
             self.instance.catalog.version += 1
+        self._last_commit_ts = commit_ts
 
     def _rollback(self):
         txn = self.txn
@@ -1260,18 +1436,21 @@ class Session:
                     data[c].append(e.value)
         # normalize column name case
         data = {tm.column(c).name: vals for c, vals in data.items()}
-        before_counts = [p.num_rows for p in store.partitions]
-        n = store.insert_pylists(data, ts)
-        for pid, p in enumerate(store.partitions):
-            added = p.num_rows - before_counts[pid]
-            if added:
-                if txn is not None:
-                    txn.inserted.append((store, pid, before_counts[pid], added))
-                self._gsi_write_rows(tm, store, pid, before_counts[pid], added,
-                                     ts, txn)
-                self.instance.cdc.capture_range(tm, store, pid,
-                                                before_counts[pid], added,
-                                                ts, txn, self)
+        # append_lock: the appended-range derivation must not interleave
+        # with a concurrent writer's appends (see TableStore.append_lock)
+        with store.append_lock:
+            before_counts = [p.num_rows for p in store.partitions]
+            n = store.insert_pylists(data, ts)
+            ranges = [(pid, before_counts[pid],
+                       p.num_rows - before_counts[pid])
+                      for pid, p in enumerate(store.partitions)
+                      if p.num_rows - before_counts[pid]]
+        for pid, start, added in ranges:
+            if txn is not None:
+                txn.inserted.append((store, pid, start, added))
+            self._gsi_write_rows(tm, store, pid, start, added, ts, txn)
+            self.instance.cdc.capture_range(tm, store, pid, start, added,
+                                            ts, txn, self)
         tm.bump_version()
         self._note_write(tm)
         self.instance.catalog.version += 1
@@ -1306,6 +1485,20 @@ class Session:
                 continue
             endpoints.append(a)
         auto = self.txn is None
+        # ASYNC replica legs (autocommit only): the statement commits after
+        # the PRIMARY applied; replica branches ship from the background
+        # applier, batched per endpoint and uid-stamped so the worker dedupe
+        # window makes retries exactly-once (PR 8).  The session fences its
+        # own subsequent reads on the apply watermark; a replica that still
+        # fails goes STALE, the synchronous path's contract applied late.
+        applier = getattr(self.instance, "applier", None)
+        async_rep = (auto and applier is not None and len(endpoints) > 1 and
+                     bool(self.instance.config.get("ENABLE_ASYNC_APPLY",
+                                                   self.vars)))
+        rep_addrs = []
+        if async_rep:
+            rep_addrs = endpoints[1:]
+            endpoints = [primary]
         self._begin()
         affected = 0
         # idempotency token: the coordinator stamps one statement uid; the
@@ -1448,6 +1641,17 @@ class Session:
         self._note_remote_write(tm.schema, tm.name)
         if auto:
             self._commit()
+            if rep_addrs:
+                cts = getattr(self, "_last_commit_ts", 0)
+                mark = applier.enqueue([
+                    {"kind": "replica", "addr": a, "schema": tm.schema,
+                     "sql": self._current_sql,
+                     "params": list(self._current_params or []),
+                     "uid": f"{stmt_uid}:r{ai}", "commit_ts": cts,
+                     "timeout_s": self.REPLICA_DML_TIMEOUT_S,
+                     "base_schema": tm.schema, "base_table": tm.name}
+                    for ai, a in enumerate(rep_addrs)])
+                self._apply_mark = max(getattr(self, "_apply_mark", 0), mark)
         return ok(affected=affected)
 
     def _sync_privileges(self) -> ResultSet:
@@ -1585,7 +1789,11 @@ class Session:
         n = 0
         for store, pid, ids in self._dml_match(tm, stmt.where, params, alias):
             p = store.partitions[pid]
-            with p.lock:
+            # append_lock BEFORE the partition lock (the ordering every
+            # appender follows): update_rows appends new MVCC versions, and
+            # a concurrent inserter deriving its appended ranges must not
+            # attribute them to itself (see TableStore.append_lock)
+            with store.append_lock, p.lock:
                 # re-check under the lock (see _run_delete) and read the lanes at
                 # a consistent length with the stamp we are about to write
                 self._check_write_conflict(p, ids)

@@ -187,10 +187,14 @@ def handle(session, stmt: ast.Show):
                           dt.BIGINT], rows)
     if kind == "batch" and (stmt.target or "").lower() == "stats":
         # SHOW BATCH STATS: the cross-session point-query batching scheduler
-        # (group sizes, waits, hit ratio, window occupancy) — the
+        # (group sizes, waits, hit ratio, window occupancy) plus the DML
+        # batcher's group rows and the async-apply backlog/lag gauges — the
         # information_schema.batch_stats twin
         sched = getattr(inst, "batch_scheduler", None)
         rows = sched.stats_rows() if sched is not None else []
+        dsched = getattr(inst, "dml_batch_scheduler", None)
+        if dsched is not None:
+            rows = rows + dsched.stats_rows()
         return ResultSet(["Stat", "Value"], [dt.VARCHAR, dt.DOUBLE],
                          [(n, float(v)) for n, v in rows])
     if kind == "statement_summary":

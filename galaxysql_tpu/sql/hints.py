@@ -22,6 +22,11 @@ behind them:
   batching (server/batch_scheduler.py).  Hinted statements never register
   PointPlans, so BATCH(OFF) structurally pins the statement to the planned
   (unbatched) path; the directive still parses so tools can round-trip it.
+- DML_BATCH(OFF|ON)        per-statement control of cross-session DML
+  batching (server/dml_batch.py).  Hinted DML statements never register
+  batch plans and never take the batched write path (a hint comment
+  structurally pins the statement to the sequential path), so DML_BATCH(OFF)
+  is honored by construction; the directive still parses for round-tripping.
 - ADMISSION(OFF|ON)        per-statement control of the workload-class
   admission gate (server/admission.py): OFF bypasses classification,
   limits, queuing and shedding for this statement
@@ -82,6 +87,10 @@ def parse_hints(comment: Optional[str]) -> Dict[str, object]:
             mode = arglist[0].lower()
             if mode in ("off", "on"):
                 out["batch"] = mode
+        elif name == "DML_BATCH" and arglist:
+            mode = arglist[0].lower()
+            if mode in ("off", "on"):
+                out["dml_batch"] = mode
         elif name == "ADMISSION" and arglist:
             # per-statement admission-control bypass (server/admission.py):
             # OFF skips the gate entirely — the query neither classifies nor

@@ -149,11 +149,25 @@ class TableStore:
         self.partitions = [Partition(table, i) for i in range(n)]
         # process-unique identity for caches (id() can be recycled after GC)
         self.uid = next(TableStore._next_uid)
+        # serializes the (before-count -> append -> derive appended ranges)
+        # critical section DML writers run: two concurrent inserts reading
+        # num_rows, appending, and re-reading would each attribute the
+        # OTHER's rows to their own [start, n) range — double-captured CDC,
+        # double-propagated GSI rows, mis-ranged txn undo entries.  Partition
+        # locks only make each append atomic, not the count arithmetic.
+        self.append_lock = threading.RLock()
 
     # -- write path ----------------------------------------------------------
 
     def insert_pylists(self, data: Dict[str, List[Any]], begin_ts: int) -> int:
         """Encode python values and route rows to partitions.  Returns rows inserted."""
+        lanes, valid, n = self.encode_pylists(data)
+        return self.append_encoded(lanes, valid, n, begin_ts)
+
+    def encode_pylists(self, data: Dict[str, List[Any]]):
+        """Phase 1 of insert_pylists: python values -> (lanes, valid, n),
+        mutating NOTHING except auto-increment allocation.  Split out so the
+        batched write path can fail a bad value strictly pre-mutation."""
         table = self.table
         n = len(next(iter(data.values()))) if data else 0
         lanes: Dict[str, np.ndarray] = {}
@@ -175,13 +189,17 @@ class TableStore:
             valid[c.name] = col.np_valid()
             if not c.nullable and not valid[c.name].all() and c.default is None:
                 raise errors.TddlError(f"Column '{c.name}' cannot be null")
+        return lanes, valid, n
+
+    def append_encoded(self, lanes, valid, n: int, begin_ts: int) -> int:
+        """Phase 2 of insert_pylists: route + append pre-encoded lanes."""
         pids = self._route(lanes)
         for pid in np.unique(pids):
             sel = np.nonzero(pids == pid)[0]
             self.partitions[int(pid)].append(
                 {k: v[sel] for k, v in lanes.items()},
                 {k: v[sel] for k, v in valid.items()}, begin_ts)
-        table.stats.row_count += n
+        self.table.stats.row_count += n
         return n
 
     def insert_arrays(self, data: Dict[str, Any], begin_ts: int) -> int:

@@ -1,0 +1,256 @@
+"""Asynchronous pipelined apply of secondary maintenance work.
+
+Reference analog: PolarDB-X's async GSI backfill/maintenance workers and the
+binlog-fed replica apply pipeline — secondary structures trail the primary
+write by a bounded lag instead of riding every statement's critical path.
+
+The batched write path (server/dml_batch.py) enqueues here instead of doing
+per-statement synchronous work:
+
+- GSI maintenance: base-table rows appended/deleted by a flush group
+  propagate into every global-secondary-index store in ONE batched apply per
+  flush instead of per statement (the lanes are MVCC-immutable, so deferred
+  reads of the enqueued row ids/ranges are stable).
+- Replica DML legs: an autocommit remote DML's replica branches ship from
+  this pipeline, batched per endpoint, uid-stamped so the PR-8 worker dedupe
+  window makes retries exactly-once; a replica that still fails after the
+  RPC retry policy is marked STALE (excluded from reads until rebuilt) —
+  exactly the synchronous path's failure contract, applied late.
+
+Read-your-writes fencing: `enqueue` returns a monotonic watermark; the
+writing session stores it and its OWN subsequent reads wait (bounded by
+APPLY_WAIT_MS) until `applied_seq` catches up.  Other sessions never wait:
+cross-session GSI/replica freshness is eventual within the apply lag, which
+`gsi_apply_lag_ms` / `gsi_apply_backlog` gauges make observable.
+
+The worker thread is lazy (created on first enqueue, daemon) so the many
+short-lived test Instances never pay for it; version bumps and fragment-
+cache invalidations happen once per drained batch, at apply time — a cached
+covering-index scan can never serve a half-applied GSI state because the
+version only moves when the apply lands.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from galaxysql_tpu.utils.failpoint import FAIL_POINTS, FP_APPLY_DELAY_MS
+
+
+class AsyncApplier:
+    """Per-Instance background applier with a FIFO queue and watermarks."""
+
+    IDLE_WAIT_S = 0.5
+
+    def __init__(self, instance):
+        self.instance = instance
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: List[Tuple[int, float, dict]] = []  # (seq, t, task)
+        self._seq = 0
+        self.applied_seq = 0
+        self._thread: Optional[threading.Thread] = None
+        m = instance.metrics
+        self.gsi_applies = m.counter(
+            "gsi_async_applies", "GSI maintenance tasks applied async")
+        self.replica_applies = m.counter(
+            "replica_async_applies", "replica DML legs applied async")
+        self.apply_failures = m.counter(
+            "async_apply_failures", "async apply tasks that failed "
+            "(GSI apply error or replica marked stale)")
+        self.backlog_gauge = m.gauge(
+            "gsi_apply_backlog", "async apply tasks queued, not yet applied")
+        self.lag_gauge = m.gauge(
+            "gsi_apply_lag_ms", "age of the oldest pending async apply task")
+
+    # -- producer side -------------------------------------------------------
+
+    def enqueue(self, tasks: List[dict]) -> int:
+        """Append tasks FIFO; returns the watermark covering all of them.
+        A session fences its own reads on this value (`wait_applied`)."""
+        now = time.time()
+        with self._cond:
+            for t in tasks:
+                self._seq += 1
+                self._queue.append((self._seq, now, t))
+            mark = self._seq
+            self.backlog_gauge.set(len(self._queue))
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="async-applier", daemon=True)
+                self._thread.start()
+            self._cond.notify_all()
+        return mark
+
+    def wait_applied(self, mark: int, timeout_s: float) -> bool:
+        """Block until `applied_seq >= mark` (read-your-writes fence)."""
+        if self.applied_seq >= mark:
+            return True
+        deadline = time.time() + timeout_s
+        with self._cond:
+            while self.applied_seq < mark:
+                left = deadline - time.time()
+                if left <= 0:
+                    return False
+                self._cond.wait(min(left, 0.1))
+        return True
+
+    def pending(self) -> bool:
+        """Anything enqueued but not yet applied? (two GIL-atomic reads)"""
+        return self.applied_seq < self._seq
+
+    def barrier(self, timeout_s: float) -> bool:
+        """Wait for everything enqueued SO FAR (global fence: sequential DML
+        on a GSI-bearing table must not race pending async applies)."""
+        return self.wait_applied(self._seq, timeout_s)
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Wait for the whole queue to apply (checkpoints, tests)."""
+        with self._lock:
+            mark = self._seq
+        return self.wait_applied(mark, timeout_s)
+
+    def lag_ms(self) -> float:
+        with self._lock:
+            if not self._queue:
+                return 0.0
+            return (time.time() - self._queue[0][1]) * 1000.0
+
+    # -- consumer side -------------------------------------------------------
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while not self._queue:
+                    self.lag_gauge.set(0.0)
+                    self._cond.wait(self.IDLE_WAIT_S)
+                batch = self._queue
+                self._queue = []
+            delay = FAIL_POINTS.value(FP_APPLY_DELAY_MS) \
+                if FAIL_POINTS.active else None
+            if delay:
+                time.sleep(float(delay) / 1000.0)
+            touched: Dict[str, Any] = {}
+            for seq, t0, task in batch:
+                # only IDEMPOTENT tasks retry: gsi_delete stamps by PK match
+                # (re-running a partial apply is a no-op), while a partially
+                # applied gsi_insert would double-append on retry — it fails
+                # terminal with an error event instead; replica tasks carry
+                # their own retry policy (uid-deduped) + STALE contract
+                attempts = 3 if task.get("kind") == "gsi_delete" else 1
+                for att in range(attempts):
+                    try:
+                        self._apply(task, touched)
+                        break
+                    except Exception as ex:
+                        if att + 1 < attempts:
+                            time.sleep(0.05 * (att + 1))
+                            continue
+                        self.apply_failures.inc()
+                        try:
+                            from galaxysql_tpu.utils import events
+                            events.publish(
+                                "async_apply_failed",
+                                f"{task.get('kind')} apply failed after "
+                                f"{attempts} attempt(s): "
+                                f"{type(ex).__name__}: {ex}",
+                                severity="error",
+                                node=self.instance.node_id,
+                                kind=task.get("kind", ""))
+                        except Exception:
+                            pass
+            self._finish_batch(touched)
+            with self._cond:
+                self.applied_seq = batch[-1][0]
+                self.backlog_gauge.set(len(self._queue))
+                self.lag_gauge.set(
+                    (time.time() - self._queue[0][1]) * 1000.0
+                    if self._queue else 0.0)
+                self._cond.notify_all()
+
+    def _apply(self, task: dict, touched: Dict[str, Any]):
+        kind = task["kind"]
+        if kind == "gsi_insert":
+            from galaxysql_tpu.server import session as _sess
+            tm = task["tm"]
+            _sess.gsi_write_rows(self.instance, tm, task["store"],
+                                 task["pid"], task["start"], task["n"],
+                                 task["ts"], None)
+            self.gsi_applies.inc()
+            self._touch_gsi(tm, touched)
+        elif kind == "gsi_delete":
+            from galaxysql_tpu.server import session as _sess
+            tm = task["tm"]
+            _sess.gsi_delete(self.instance, tm, task["store"], task["pid"],
+                             task["row_ids"], task["ts"], None)
+            self.gsi_applies.inc()
+            self._touch_gsi(tm, touched)
+        elif kind == "replica":
+            self._apply_replica(task)
+        else:  # pragma: no cover - queue corruption guard
+            raise ValueError(f"unknown async apply task kind {kind!r}")
+
+    def _touch_gsi(self, tm, touched: Dict[str, Any]):
+        from galaxysql_tpu.server import session as _sess
+        for _i, gtm, _g in _sess.gsi_targets(self.instance, tm):
+            touched[f"{gtm.schema.lower()}.{gtm.name.lower()}"] = gtm
+
+    def _finish_batch(self, touched: Dict[str, Any]):
+        """Version/cache hygiene ONCE per drained batch: bump every touched
+        GSI meta and invalidate its cached fragments so version-keyed caches
+        (fragment, device lanes) re-key now that the apply landed."""
+        if not touched:
+            return
+        fcache = getattr(self.instance, "frag_cache", None)
+        for key, gtm in touched.items():
+            gtm.bump_version()
+            if fcache is not None:
+                fcache.invalidate_table(key)
+        self.instance.catalog.version += 1
+
+    def _apply_replica(self, task: dict):
+        """Ship one replica DML leg: dml + xa_commit under a fresh branch
+        xid, uid-stamped (the worker dedupe window replays a reconnect retry's
+        recorded response — exactly-once).  Terminal failure marks the
+        replica STALE, the same contract the synchronous path enforced."""
+        addr = task["addr"]
+        client = self.instance.workers.get(addr)
+        uid = task["uid"]
+        xid = f"a{uid.replace(':', '_')}"
+        try:
+            if client is None:
+                raise ConnectionError(f"worker {addr} not attached")
+            deadline = time.time() + task.get("timeout_s", 30.0)
+            client.request({"op": "dml", "xid": xid,
+                            "schema": task["schema"], "sql": task["sql"],
+                            "uid": uid,
+                            "params": list(task.get("params") or [])},
+                           deadline=deadline)
+            client.request({"op": "xa_commit", "xid": xid,
+                            "commit_ts": int(task["commit_ts"])},
+                           deadline=deadline)
+            self.replica_applies.inc()
+        except Exception:
+            self.apply_failures.inc()
+            self._mark_stale(task)
+            if client is not None:
+                try:
+                    client.request({"op": "xa_rollback", "xid": xid},
+                                   deadline=time.time() + 5.0)
+                except Exception:
+                    pass
+            raise
+
+    def _mark_stale(self, task: dict):
+        try:
+            tm = self.instance.catalog.table(task["base_schema"],
+                                             task["base_table"])
+        except Exception:
+            return
+        for r in getattr(tm, "replicas", []):
+            if (r["host"], r["port"]) == task["addr"]:
+                r["stale"] = True

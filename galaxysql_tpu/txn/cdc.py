@@ -60,12 +60,15 @@ class CdcManager:
     # -- capture ------------------------------------------------------------
 
     def capture_rows(self, tm, store, pid: int, row_ids: np.ndarray,
-                     kind: str, ts: int, txn=None, session=None):
+                     kind: str, ts: int, txn=None, session=None, sink=None):
         """Log `kind` (insert|delete) for the given partition rows.
 
         Inside a transaction the event buffers on the txn and flushes at
         commit with the commit TSO (rollback discards); autocommit writes
-        immediately with the statement timestamp."""
+        immediately with the statement timestamp.  A `sink` list collects
+        the event instead of writing — the batched DML flush gathers every
+        member's events and lands them in ONE metadb transaction
+        (`write_events`), the group-commit shape for the binlog."""
         if not self.enabled(session) or row_ids.size == 0:
             return
         p = store.partitions[pid]
@@ -74,7 +77,9 @@ class CdcManager:
         cols, rows = _decode_rows(tm, lanes, valid)
         ev = (tm.schema.lower(), tm.name.lower(), kind,
               json.dumps({"columns": cols, "rows": rows}))
-        if txn is not None:
+        if sink is not None:
+            sink.append(ev)
+        elif txn is not None:
             if not hasattr(txn, "cdc_events"):
                 txn.cdc_events = []
             txn.cdc_events.append(ev)
@@ -82,12 +87,18 @@ class CdcManager:
             self._write(ts, [ev])
 
     def capture_range(self, tm, store, pid: int, start: int, n: int,
-                      ts: int, txn=None, session=None):
+                      ts: int, txn=None, session=None, sink=None):
         """Insert event for freshly appended rows [start, start+n)."""
         if n <= 0:
             return
         self.capture_rows(tm, store, pid, np.arange(start, start + n),
-                          "insert", ts, txn, session)
+                          "insert", ts, txn, session, sink=sink)
+
+    def write_events(self, commit_ts: int, events: List[tuple]):
+        """Land collected events in one metadb transaction (flush-group
+        coalescing: one binlog write per DML batch flush, not per member)."""
+        if events:
+            self._write(commit_ts, events)
 
     def flush_txn(self, txn, commit_ts: int):
         evs = getattr(txn, "cdc_events", None)

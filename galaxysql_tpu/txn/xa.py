@@ -230,6 +230,135 @@ def recover_persisted(instance) -> Dict[int, str]:
     return out
 
 
+class _CommitWaiter:
+    __slots__ = ("txn_id", "state", "commit_ts", "event", "ts", "lead",
+                 "failed")
+
+    def __init__(self, txn_id: int, state: str, commit_ts: int = 0):
+        self.txn_id = txn_id
+        self.state = state
+        self.commit_ts = commit_ts
+        self.event = threading.Event()
+        self.ts: Optional[int] = None
+        self.lead = False
+        self.failed = False
+
+
+class GroupCommitGate:
+    """Amortizes the commit-point critical path across CONCURRENT committers.
+
+    Every transaction commit pays a TSO fetch plus a durable metadb write for
+    its commit point — at high session counts those per-txn sqlite commits
+    serialize the whole write path.  This gate is the classic group-commit
+    shape: the first committer to find no flush in progress leads, drains
+    whatever queued while the previous flush was writing, allocates the
+    whole group's commit timestamps in ONE batched TSO call
+    (`TimestampOracle.next_timestamps` — the reference's grouped GTS fetch,
+    ClusterTimestampOracle.java:109-133) and lands every commit-point row in
+    ONE metadb transaction (`tx_log_put_many`).  Batch size ~ arrivals per
+    flush; sequential traffic degenerates to the unbatched path (a group of
+    one) with no added wait — nobody ever sleeps waiting for company.
+
+    `log_state` batches non-allocating writes (DONE markers) the same way.
+    Any flush error falls every member back to its own solo write: group
+    commit is an optimization, never a correctness dependency."""
+
+    def __init__(self, instance):
+        self.instance = instance
+        self._lock = threading.Lock()
+        self._flushing = False
+        self._waiters: List[_CommitWaiter] = []
+        # lazy: the coordinator is constructed before Instance.metrics exists
+        self._counters = None
+
+    def _stat(self):
+        if self._counters is None:
+            m = self.instance.metrics
+            self._counters = (
+                m.counter("group_commit_batches",
+                          "commit-point flush groups written"),
+                m.counter("group_committed_txns",
+                          "transactions whose commit point rode a flush "
+                          "group"))
+        return self._counters
+
+    def commit_point(self, txn_id: int) -> int:
+        """Allocate a commit TSO and durably log `txn_id` COMMITTED at it,
+        grouped with concurrent committers.  Returns the commit_ts."""
+        return self._submit(_CommitWaiter(txn_id, "COMMITTED"))
+
+    def log_state(self, txn_id: int, state: str, commit_ts: int = 0):
+        """Durably log a non-allocating tx-log state (DONE/ABORTED), grouped
+        with concurrent writers of the same gate."""
+        self._submit(_CommitWaiter(txn_id, state, commit_ts))
+
+    def _submit(self, w: _CommitWaiter) -> int:
+        with self._lock:
+            self._waiters.append(w)
+            if not self._flushing:
+                self._flushing = True
+                w.lead = True
+        if not w.lead:
+            # the current leader's flush loop is obligated to either flush us
+            # or hand us leadership; the timeout is a never-hang backstop
+            if not w.event.wait(timeout=30.0):
+                with self._lock:
+                    try:
+                        self._waiters.remove(w)
+                    except ValueError:
+                        w.event.wait()  # a flusher owns us: it WILL finish
+                        return self._resolve(w)
+                return self._solo(w)
+            return self._resolve(w)
+        self._lead_loop()
+        return self._resolve(w)
+
+    def _resolve(self, w: _CommitWaiter) -> int:
+        if w.failed or (w.state == "COMMITTED" and w.ts is None):
+            return self._solo(w)  # flush error fell back member-by-member
+        return w.ts if w.ts is not None else w.commit_ts
+
+    def _solo(self, w: _CommitWaiter) -> int:
+        ts = self.instance.tso.next_timestamp() \
+            if w.state == "COMMITTED" else w.commit_ts
+        self.instance.metadb.tx_log_put(w.txn_id, w.state, ts)
+        return ts
+
+    def _lead_loop(self):
+        while True:
+            with self._lock:
+                batch = self._waiters
+                self._waiters = []
+                if not batch:
+                    self._flushing = False
+                    return
+            self._flush(batch)
+            # wake the batch only after its rows are durable; then loop to
+            # pick up members that queued during the write
+            for w in batch:
+                w.event.set()
+
+    def _flush(self, batch: List[_CommitWaiter]):
+        try:
+            commits = [w for w in batch if w.state == "COMMITTED"]
+            if commits:
+                tss = self.instance.tso.next_timestamps(len(commits))
+                for w, ts in zip(commits, tss):
+                    w.ts = ts
+            self.instance.metadb.tx_log_put_many(
+                [(w.txn_id, w.state,
+                  w.ts if w.ts is not None else w.commit_ts) for w in batch])
+            batches, txns = self._stat()
+            batches.inc()
+            txns.inc(len(batch))
+        except Exception:
+            # every member (DONE markers included) falls back to its own
+            # solo write with per-member error attribution
+            for w in batch:
+                w.ts = None
+                w.failed = True
+
+
 class TwoPhaseCoordinator:
     """The TSO+2PC commit protocol (TsoTransaction.commit analog)."""
 
@@ -238,6 +367,9 @@ class TwoPhaseCoordinator:
         # in-doubt registry: txn_id -> participants (cleared when resolved)
         self._in_doubt: Dict[int, List[StoreParticipant]] = {}
         self._lock = threading.Lock()
+        # commit-point group gate: TSO fetch + durable COMMITTED/DONE rows
+        # amortized across concurrent committers (local TSO policy included)
+        self.group_gate = GroupCommitGate(instance)
 
     def commit(self, txn) -> int:
         parts = participants_of(txn) + remote_participants_of(self.instance, txn)
@@ -257,8 +389,8 @@ class TwoPhaseCoordinator:
         FAIL_POINTS.inject(FP_BEFORE_COMMIT, f"txn {txn.txn_id}")
         # commit point: a fresh TSO value logged durably BEFORE any participant
         # commits (the reference's GlobalTxLogManager.append + commitTimestamp)
-        commit_ts = self.instance.tso.next_timestamp()
-        metadb.tx_log_put(txn.txn_id, "COMMITTED", commit_ts)
+        # — TSO fetch + durable write grouped with concurrent committers
+        commit_ts = self.group_gate.commit_point(txn.txn_id)
         failed = []
         for sp in parts:
             try:
@@ -277,7 +409,7 @@ class TwoPhaseCoordinator:
             # apply commit-dependent follow-ups (CDC flush) at this ts
             err.commit_ts = commit_ts
             raise err
-        metadb.tx_log_put(txn.txn_id, "DONE", commit_ts)
+        self.group_gate.log_state(txn.txn_id, "DONE", commit_ts)
         with self._lock:
             self._in_doubt.pop(txn.txn_id, None)
         return commit_ts

@@ -30,6 +30,14 @@ FP_BACKFILL_PAUSE = "FP_BACKFILL_PAUSE"
 # armed with a key VALUE: the batch scheduler fails exactly that key's
 # sessions inside a flush (error-isolation testing, server/batch_scheduler.py)
 FP_BATCH_POISON_KEY = "FP_BATCH_POISON_KEY"
+# armed with a key VALUE: the DML batch scheduler fails exactly that key's
+# sessions inside a write flush — the duplicate-key/constraint-violation
+# stand-in proving per-session error isolation (server/dml_batch.py)
+FP_DML_POISON_KEY = "FP_DML_POISON_KEY"
+# sleep N ms inside the async applier before each task batch
+# (txn/async_apply.py): makes the GSI/replica apply lag observable so the
+# read-your-writes fence is actually exercised
+FP_APPLY_DELAY_MS = "FP_APPLY_DELAY_MS"
 
 # -- network-plane faults (coordinator-side unless noted) ---------------------
 # drop the request or reply leg of an RPC: the socket dies mid-exchange.  A

@@ -175,6 +175,12 @@ BATCH_GROUP_SIZE = Histogram(
     "batch_group_size", "coalesced point-query group size (requests/flush)")
 BATCH_WAIT_MS = Histogram(
     "batch_wait_ms", "batched point-query collection wait (ms)")
+# batched write path (server/dml_batch.py): coalesced DML group sizes per
+# vectorized flush and per-statement collection wait
+DML_GROUP_SIZE = Histogram(
+    "dml_group_size", "coalesced point-DML group size (statements/flush)")
+DML_WAIT_MS = Histogram(
+    "dml_wait_ms", "batched DML collection wait (ms)")
 
 # fault-tolerance plane (net/dn.py retry/breaker, SyncBus, deadline kills):
 # process-shared like the histograms above — WorkerClient instances have no

@@ -1,0 +1,642 @@
+"""Cross-session DML batching (server/dml_batch.py) + group commit + async
+apply (txn/async_apply.py).
+
+Guards the mega-batched write path: batched table state must be bit-identical
+to sequential execution under heavy concurrency, a poisoned key fails only
+its own session, transactional sessions bypass, reads after an async GSI
+apply honor read-your-writes, replica legs apply exactly once under a
+reply-leg drop, the commit point amortizes across concurrent committers, and
+CDC emission coalesces per flush while replaying to identical state.  Fast
+target: `make dml-smoke`.
+"""
+
+import threading
+
+import pytest
+
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.utils import errors
+from galaxysql_tpu.utils.failpoint import (FAIL_POINTS, FP_APPLY_DELAY_MS,
+                                           FP_DML_POISON_KEY, FP_RPC_DROP)
+
+pytestmark = pytest.mark.dml_batch
+
+DDL = """
+    CREATE TABLE t (
+        id BIGINT NOT NULL PRIMARY KEY,
+        k  INT NOT NULL,
+        v  VARCHAR(20),
+        amt DECIMAL(12,2)
+    ) PARTITION BY HASH(id) PARTITIONS 4
+"""
+
+INS = "INSERT INTO t (id, k, v, amt) VALUES (%d, %d, '%s', %d.25)"
+UPD = "UPDATE t SET amt = %d.99, v = '%s' WHERE id = %d"
+DEL = "DELETE FROM t WHERE id = %d"
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    FAIL_POINTS.clear()
+    yield
+    FAIL_POINTS.clear()
+
+
+def fresh(window_us=3000):
+    inst = Instance()
+    # the closed-loop thread storms here push per-op latency past the TP
+    # AIMD target and the overload plane (correctly) sheds; this suite tests
+    # the batcher's correctness, not the shedder (tests/test_overload.py)
+    inst.config.set_instance("ENABLE_ADMISSION_CONTROL", 0)
+    s = Session(inst)
+    s.execute("CREATE DATABASE dbx")
+    s.execute("USE dbx")
+    s.execute(DDL)
+    # seed + register the three batch plans (first sequential run registers)
+    s.execute(INS % (1, 1, "seed", 1))
+    s.execute(UPD % (1, "seed", 1))
+    s.execute(DEL % 1)
+    if window_us:
+        inst.config.set_instance("DML_BATCH_WINDOW_US", window_us)
+    return inst, s
+
+
+def _run_threads(n, fn):
+    errs = []
+    barrier = threading.Barrier(n)
+
+    def runner(i):
+        try:
+            barrier.wait(timeout=30)
+            fn(i)
+        except Exception as e:  # pragma: no cover - assertion carrier
+            errs.append(e)
+
+    threads = [threading.Thread(target=runner, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return errs
+
+
+def _workload(inst, n_sessions, per):
+    """Deterministic mixed write workload: each session owns a disjoint key
+    range (insert -> update -> insert+delete), so the final table state does
+    not depend on interleaving."""
+    def worker(i):
+        sx = Session(inst, schema="dbx")
+        base = 1000 + i * 100
+        for j in range(per):
+            k = base + j
+            sx.execute(INS % (k, k % 41, f"v{k % 13}", k % 500))
+            if j % 2 == 0:
+                sx.execute(UPD % (k % 300, f"u{k % 7}", k))
+            if j % 3 == 0:
+                sx.execute(INS % (k + 50, k % 41, "tmp", 9))
+                sx.execute(DEL % (k + 50))
+        sx.close()
+    return _run_threads(n_sessions, worker)
+
+
+def _table_state(s):
+    return s.execute("SELECT id, k, v, amt FROM t ORDER BY id").rows
+
+
+def test_batched_bit_identical_100_sessions():
+    """100+ concurrent write sessions: the batched engine's final table
+    state equals the sequential engine's, bit for bit, and groups actually
+    formed (not a fallback parade)."""
+    inst_b, sb = fresh()
+    errs = _workload(inst_b, 104, 6)
+    assert not errs, errs[:3]
+    assert inst_b.metrics.counter("dml_batched_queries").value > 0
+    assert inst_b.metrics.counter("dml_batch_flushes").value > 0
+
+    inst_s, ss = fresh(window_us=0)
+    inst_s.config.set_instance("ENABLE_DML_BATCHING", 0)
+    errs = _workload(inst_s, 104, 6)
+    assert not errs, errs[:3]
+    assert inst_s.metrics.counter("dml_batched_queries").value == 0
+    assert _table_state(sb) == _table_state(ss)
+
+
+def test_affected_counts_and_missing_keys():
+    inst, s = fresh()
+    s.execute(INS % (10, 1, "a", 10))
+    assert s.execute(UPD % (5, "x", 10)).affected == 1
+    assert s.execute(UPD % (5, "x", 999999)).affected == 0
+    assert s.execute(DEL % 999999).affected == 0
+    assert s.execute(DEL % 10).affected == 1
+
+    def worker(i):
+        sx = Session(inst, schema="dbx")
+        assert sx.execute(UPD % (7, "y", 5000 + i)).affected == 0
+        sx.close()
+
+    errs = _run_threads(16, worker)
+    assert not errs, errs[:3]
+
+
+def test_poison_key_isolation():
+    """A poisoned key (the duplicate-key/constraint stand-in) fails ONLY its
+    own session; the rest of the group lands."""
+    inst, s = fresh(window_us=5000)
+    FAIL_POINTS.arm(FP_DML_POISON_KEY, 6666)
+    hit = []
+
+    def worker(i):
+        sx = Session(inst, schema="dbx")
+        k = 6666 if i == 7 else 2000 + i
+        try:
+            sx.execute(INS % (k, i, "p", i))
+        except errors.TddlError:
+            raise
+        except Exception as e:
+            hit.append((k, e))
+        sx.close()
+
+    errs = _run_threads(24, worker)
+    assert not errs, errs[:3]
+    assert len(hit) == 1 and hit[0][0] == 6666
+    FAIL_POINTS.clear()
+    n = s.execute("SELECT count(*) FROM t WHERE id >= 2000 AND id < 2024").rows
+    assert n == [(23,)]
+    assert s.execute("SELECT count(*) FROM t WHERE id = 6666").rows == [(0,)]
+
+
+def test_not_null_violation_isolated():
+    """A NOT NULL violation fails per member, mirroring the sequential
+    store-level error, without poisoning the group."""
+    inst, s = fresh(window_us=5000)
+    tpl = "INSERT INTO t (id, k, v, amt) VALUES (%s, %s, 'n', 3.25)"
+    s.execute(tpl % (300, 3))
+    bad = []
+
+    def worker(i):
+        sx = Session(inst, schema="dbx")
+        try:
+            if i == 3:
+                sx.execute(tpl % (400 + i, "NULL"))
+            else:
+                sx.execute(tpl % (400 + i, i))
+        except errors.TddlError as e:
+            bad.append(str(e))
+        sx.close()
+
+    errs = _run_threads(8, worker)
+    assert not errs, errs[:3]
+    assert len(bad) == 1 and "cannot be null" in bad[0]
+    assert s.execute(
+        "SELECT count(*) FROM t WHERE id >= 400 AND id < 408").rows == [(7,)]
+
+
+def test_own_txn_bypass():
+    """A transaction's writes need own-visibility + undo: they bypass the
+    batcher structurally and keep exact BEGIN/ROLLBACK semantics."""
+    inst, s = fresh()
+    before = inst.metrics.counter("dml_batched_queries").value
+    s.execute("BEGIN")
+    s.execute(INS % (77, 7, "txn", 7))
+    assert s.execute("SELECT v FROM t WHERE id = 77").rows == [("txn",)]
+    s.execute("ROLLBACK")
+    assert s.execute("SELECT count(*) FROM t WHERE id = 77").rows == [(0,)]
+    s.execute("BEGIN")
+    s.execute(INS % (78, 7, "txn2", 7))
+    s.execute("COMMIT")
+    assert s.execute("SELECT v FROM t WHERE id = 78").rows == [("txn2",)]
+    assert inst.metrics.counter("dml_batched_queries").value == before
+
+
+def test_duplicate_key_members_fall_back():
+    """Two members writing the SAME key are order-dependent: both fall back
+    and serialize on the sequential path (bit-identical contract)."""
+    inst, s = fresh(window_us=8000)
+    s.execute(INS % (900, 9, "dup", 1))
+    f0 = inst.metrics.counter("dml_batch_fallbacks").value
+    results = []
+
+    def worker(i):
+        sx = Session(inst, schema="dbx")
+        results.append(sx.execute(UPD % (10 + i, f"w{i}", 900)).affected)
+        sx.close()
+
+    errs = _run_threads(2, worker)
+    assert not errs, errs[:3]
+    assert results == [1, 1]
+    v = s.execute("SELECT v FROM t WHERE id = 900").rows[0][0]
+    assert v in ("w0", "w1")
+    assert inst.metrics.counter("dml_batch_fallbacks").value >= f0 + 2
+
+
+def test_write_conflict_isolated_per_key():
+    """A row already end-stamped by a (future) committer conflicts for ITS
+    member only; the co-batched member lands."""
+    import numpy as np
+    from galaxysql_tpu.storage.table_store import INFINITY_TS
+    inst, s = fresh(window_us=8000)
+    s.execute(INS % (910, 9, "c1", 1))
+    s.execute(INS % (911, 9, "c2", 1))
+    store = inst.store("dbx", "t")
+    # stamp 910's row as deleted by a committer AFTER any snapshot we take
+    future = inst.tso.next_timestamp() + (1 << 40)
+    for p in store.partitions:
+        ids = p.key_candidates("id", 910)
+        live = ids[p.end_ts[ids] == INFINITY_TS]
+        if live.size:
+            p.end_ts[live] = future
+    got = {}
+
+    def worker(i):
+        sx = Session(inst, schema="dbx")
+        key = 910 if i == 0 else 911
+        try:
+            got[key] = sx.execute(UPD % (50 + i, f"z{i}", key)).affected
+        except errors.TransactionError as e:
+            got[key] = e
+        sx.close()
+
+    errs = _run_threads(2, worker)
+    assert not errs, errs[:3]
+    assert isinstance(got[910], errors.TransactionError)
+    assert got[911] == 1
+
+
+class TestAsyncApply:
+    def test_read_your_writes_after_async_gsi_apply(self):
+        """With the applier artificially delayed, a session's batched insert
+        must still be visible to its OWN next read through the GSI route
+        (the fence), and the GSI store converges to the base table."""
+        inst, s = fresh(window_us=5000)
+        s.execute("CREATE GLOBAL INDEX g_k ON t (k) COVERING (amt)")
+        # the DDL bumped schema_version: one sequential run re-registers the
+        # batch plan before the storm
+        s.execute(INS % (2999, 699, "warm", 99))
+        FAIL_POINTS.arm(FP_APPLY_DELAY_MS, 300)
+        errs = []
+
+        def worker(i):
+            sx = Session(inst, schema="dbx")
+            kk = 700 + i
+            sx.execute(INS % (3000 + i, kk, "g", 100 + i))
+            rows = sx.execute("SELECT amt FROM t WHERE k = %d" % kk).rows
+            if rows != [(float(100 + i) + 0.25,)]:
+                errs.append((i, rows))
+            sx.close()
+
+        werrs = _run_threads(12, worker)
+        assert not werrs, werrs[:3]
+        assert not errs, errs[:3]
+        FAIL_POINTS.clear()
+        assert inst.metrics.counter("gsi_async_applies").value > 0
+        assert inst.applier.drain(30.0)
+        base = s.execute("SELECT count(*) FROM t").rows
+        gsi = inst.store("dbx", "t$g_k").row_count()
+        assert base == [(gsi,)]
+
+    def test_update_delete_gsi_convergence(self):
+        """Batched UPDATE/DELETE on a GSI-bearing table: async delete+insert
+        tasks apply FIFO and the index converges exactly."""
+        inst, s = fresh(window_us=5000)
+        s.execute("CREATE GLOBAL INDEX g_k ON t (k) COVERING (amt)")
+        for i in range(16):
+            s.execute(INS % (4000 + i, 800 + i, "u", i))
+        # re-register the update/delete plans post-DDL before the storm
+        s.execute(UPD % (0, "u", 4000))
+        s.execute(DEL % 3999)
+
+        def worker(i):
+            sx = Session(inst, schema="dbx")
+            if i % 2 == 0:
+                sx.execute(UPD % (77, "uu", 4000 + i))
+            else:
+                sx.execute(DEL % (4000 + i))
+            sx.close()
+
+        errs = _run_threads(16, worker)
+        assert not errs, errs[:3]
+        assert inst.applier.drain(30.0)
+        base = s.execute("SELECT count(*) FROM t WHERE k >= 800").rows[0][0]
+        assert base == 8
+        gsi_store = inst.store("dbx", "t$g_k")
+        assert gsi_store.row_count() == \
+            s.execute("SELECT count(*) FROM t").rows[0][0]
+        # the updated rows read back through the index route
+        rows = s.execute(
+            "SELECT amt FROM t WHERE k = 800").rows
+        assert rows == [(77.99,)]
+
+    def test_sync_apply_when_disabled(self):
+        """ENABLE_ASYNC_APPLY=0: GSI maintenance stays inside the flush
+        (no applier involvement), results identical."""
+        inst, s = fresh(window_us=5000)
+        inst.config.set_instance("ENABLE_ASYNC_APPLY", 0)
+        s.execute("CREATE GLOBAL INDEX g_k ON t (k) COVERING (amt)")
+        s.execute(INS % (4999, 899, "warm", 9))
+        a0 = inst.metrics.counter("gsi_async_applies").value
+
+        def worker(i):
+            sx = Session(inst, schema="dbx")
+            sx.execute(INS % (5000 + i, 900 + i, "s", i))
+            sx.close()
+
+        errs = _run_threads(8, worker)
+        assert not errs, errs[:3]
+        assert inst.metrics.counter("gsi_async_applies").value == a0
+        assert inst.store("dbx", "t$g_k").row_count() == \
+            s.execute("SELECT count(*) FROM t").rows[0][0]
+
+
+def test_group_commit_amortizes_commit_points():
+    """64 concurrent explicit txns: every commit lands durably (DONE in the
+    tx log, rows visible) in FEWER metadb flush groups than txns — the
+    commit-point fsync actually amortized."""
+    inst, s = fresh()
+    b0 = inst.metrics.counter("group_commit_batches").value
+    t0 = inst.metrics.counter("group_committed_txns").value
+
+    def worker(i):
+        sx = Session(inst, schema="dbx")
+        sx.execute("BEGIN")
+        sx.execute(INS % (8000 + i, i, "gc", i))
+        sx.execute("COMMIT")
+        sx.close()
+
+    errs = _run_threads(64, worker)
+    assert not errs, errs[:3]
+    txns = inst.metrics.counter("group_committed_txns").value - t0
+    batches = inst.metrics.counter("group_commit_batches").value - b0
+    assert txns >= 64  # every commit point + DONE marker rode the gate
+    assert batches < txns, (batches, txns)
+    assert s.execute(
+        "SELECT count(*) FROM t WHERE id >= 8000 AND id < 8064"
+    ).rows == [(64,)]
+
+
+def test_cdc_coalesced_and_replays_identically():
+    """Batched flushes emit coalesced CDC events (fewer binlog rows than
+    statements) that replay onto a fresh instance to the exact table state."""
+    from galaxysql_tpu.txn.cdc import replay
+    inst, s = fresh(window_us=5000)
+    seq0 = max((r[0] for r in inst.cdc.events(0)), default=0)
+
+    def worker(i):
+        sx = Session(inst, schema="dbx")
+        sx.execute(INS % (9000 + i, i % 5, f"c{i}", i))
+        sx.close()
+
+    errs = _run_threads(32, worker)
+    assert not errs, errs[:3]
+    evs = [e for e in inst.cdc.events(0) if e[0] > seq0]
+    inserts = [e for e in evs if e[4] == "insert"]
+    assert inserts, "no CDC events captured"
+    assert len(inserts) < 32, len(inserts)  # coalesced per flush x partition
+    target = Instance()
+    st = Session(target)
+    st.execute("CREATE DATABASE dbx")
+    st.execute("USE dbx")
+    st.execute(DDL)
+    replay(inst.cdc.events(0), target)
+    assert _table_state(st) == _table_state(s)
+
+
+class TestHatches:
+    def test_param_hatch(self):
+        inst, s = fresh()
+        inst.config.set_instance("ENABLE_DML_BATCHING", 0)
+        before = inst.metrics.counter("dml_batched_queries").value
+
+        def worker(i):
+            sx = Session(inst, schema="dbx")
+            sx.execute(INS % (10000 + i, i, "h", i))
+            sx.close()
+
+        errs = _run_threads(12, worker)
+        assert not errs, errs[:3]
+        assert inst.metrics.counter("dml_batched_queries").value == before
+        assert s.execute(
+            "SELECT count(*) FROM t WHERE id >= 10000").rows == [(12,)]
+
+    def test_env_hatch(self, monkeypatch):
+        from galaxysql_tpu.server import dml_batch
+        monkeypatch.setattr(dml_batch, "ENABLED", False)
+        inst, s = fresh()
+        before = inst.metrics.counter("dml_batched_queries").value
+
+        def worker(i):
+            sx = Session(inst, schema="dbx")
+            sx.execute(INS % (10100 + i, i, "e", i))
+            sx.close()
+
+        errs = _run_threads(8, worker)
+        assert not errs, errs[:3]
+        assert inst.metrics.counter("dml_batched_queries").value == before
+
+    def test_hint_hatch(self):
+        """A hinted DML statement neither registers nor batches — the hint
+        comment structurally pins it to the sequential path."""
+        inst, s = fresh()
+        tpl = ("/*+TDDL: DML_BATCH(OFF)*/ INSERT INTO t (id, k, v, amt) "
+               "VALUES (%d, %d, 'hint', 1.25)")
+        s.execute(tpl % (10200, 1))
+        key_count = len(inst.dml_plans)
+        before = inst.metrics.counter("dml_batched_queries").value
+
+        def worker(i):
+            sx = Session(inst, schema="dbx")
+            sx.execute(tpl % (10201 + i, i))
+            sx.close()
+
+        errs = _run_threads(8, worker)
+        assert not errs, errs[:3]
+        assert len(inst.dml_plans) == key_count
+        assert inst.metrics.counter("dml_batched_queries").value == before
+
+
+def test_statement_summary_and_admission_attribution():
+    """Batched members attribute latency/rows to their OWN digest (not the
+    leader's), and the admission classifier sees the digest as TP."""
+    inst, s = fresh(window_us=5000)
+    # this test asserts the admission classifier's digest feed: re-enable
+    # the gate (16 sessions sit far below the initial TP limit)
+    inst.config.set_instance("ENABLE_ADMISSION_CONTROL", 1)
+
+    def worker(i):
+        sx = Session(inst, schema="dbx")
+        sx.execute(INS % (11000 + i, i, "ss", i))
+        sx.close()
+
+    errs = _run_threads(16, worker)
+    assert not errs, errs[:3]
+    assert inst.metrics.counter("dml_batched_queries").value > 0
+    rows = [r for r in inst.stmt_summary.rows()
+            if "insert into t" in (r[-1] or "").lower()]
+    assert rows, "DML digest missing from the statement summary"
+    row = rows[0]
+    engines = row[3]
+    execs = row[4]
+    assert "dml" in engines
+    assert execs >= 17  # 16 batched members + the seed sequential run
+    digest = row[0]
+    info = inst.admission._digest_cost.get(digest)
+    assert info is not None and info[0] == "TP"
+
+
+def test_steady_state_retrace_and_dispatch_guard():
+    """Steady-state batched flushes compile nothing, and the sequential path
+    (batching off) adds zero device dispatches per DML."""
+    from galaxysql_tpu.exec import operators as _ops
+    inst, s = fresh(window_us=3000)
+
+    def wave(base):
+        def worker(i):
+            sx = Session(inst, schema="dbx")
+            sx.execute(INS % (base + i, i, "w", i))
+            sx.execute(UPD % (i, "w2", base + i))
+            sx.close()
+        return _run_threads(16, worker)
+
+    assert not wave(12000)
+    _ops.reset_compile_stats()
+    assert not wave(12100)
+    assert _ops.COMPILE_STATS["retraces"] == 0
+    inst.config.set_instance("ENABLE_DML_BATCHING", 0)
+    _ops.reset_dispatch_stats()
+    d0 = _ops.DISPATCH_STATS["dispatches"]
+    s.execute(INS % (12999, 1, "d", 1))
+    s.execute(UPD % (2, "d2", 12999))
+    s.execute(DEL % 12999)
+    assert _ops.DISPATCH_STATS["dispatches"] == d0
+
+
+def test_singleton_falls_back_sequential():
+    """A lone writer (group of one) runs the sequential path — batching
+    never taxes unconcurrent traffic with a pointless flush."""
+    inst, s = fresh(window_us=2000)
+    s0 = inst.metrics.counter("dml_batch_singletons").value
+    # no concurrency: the adaptive window is 0 without MIN_INFLIGHT writers,
+    # but even with a pinned window a singleton group must fall back
+    s.execute(INS % (13000, 1, "solo", 1))
+    assert s.execute("SELECT v FROM t WHERE id = 13000").rows == [("solo",)]
+    assert inst.metrics.counter("dml_batch_singletons").value >= s0
+
+
+def test_show_batch_stats_and_info_schema_rows():
+    inst, s = fresh(window_us=3000)
+
+    def worker(i):
+        sx = Session(inst, schema="dbx")
+        sx.execute(INS % (14000 + i, i, "st", i))
+        sx.close()
+
+    assert not _run_threads(12, worker)
+    rows = dict(s.execute("SHOW BATCH STATS").rows)
+    assert rows.get("dml_batched_queries", 0) > 0
+    assert "dml_group_size_p50" in rows
+    assert "gsi_apply_backlog" in rows and "gsi_apply_lag_ms" in rows
+    irows = s.execute(
+        "SELECT stat_name, value FROM information_schema.batch_stats").rows
+    names = {r[0] for r in irows}
+    assert {"dml_batched_queries", "dml_batch_flushes",
+            "gsi_apply_lag_ms"} <= names
+    # typed registry + Prometheus text carry the new families
+    m = dict((r[0], r[2]) for r in inst.metrics.rows())
+    assert "dml_batched_queries" in m
+    assert "gsi_apply_lag_ms" in m
+    text = inst.metrics.prometheus_text()
+    assert "dml_batched_queries" in text
+    assert "gsi_apply_lag_ms" in text
+
+
+class TestReplicaAsyncApply:
+    def test_reply_leg_drop_applies_exactly_once(self):
+        """Chaos reuse (PR 8 failpoints): the async replica leg's dml reply
+        drops AFTER the replica executed it; the applier's retry re-sends
+        the same uid and the dedupe window replays the recorded response —
+        the replica holds the row exactly once, and the writing session's
+        own read (fenced, routed to the replica) sees it."""
+        from test_chaos import WorkerHarness, bounded
+        prim = WorkerHarness(
+            init_sql="CREATE DATABASE w; USE w; "
+                     "CREATE TABLE kv (k BIGINT PRIMARY KEY, v BIGINT); "
+                     "INSERT INTO kv VALUES (1, 10)")
+        rep = WorkerHarness(
+            init_sql="CREATE DATABASE w; USE w; "
+                     "CREATE TABLE kv (k BIGINT PRIMARY KEY, v BIGINT)")
+        inst = Instance()
+        s = Session(inst)
+        try:
+            s.execute("CREATE DATABASE w")
+            s.execute("USE w")
+            inst.attach_remote_table("w", "kv", *prim.addr)
+            # huge weight: reads deterministically route to the replica
+            inst.attach_replica("w", "kv", *rep.addr, weight=10 ** 6)
+            rep_client = inst.workers[rep.addr]
+            st0 = rep_client.sync_action("worker_stats", {})
+            # the applier sleeps first, giving a deterministic window to arm
+            # the reply-leg drop AFTER the primary's synchronous dml is done
+            # — the drop then hits exactly the async replica leg
+            FAIL_POINTS.arm(FP_APPLY_DELAY_MS, 500)
+            rs = bounded(lambda: s.execute("INSERT INTO kv VALUES (42, 420)"))
+            FAIL_POINTS.arm(FP_RPC_DROP, {"op": "dml", "leg": "reply",
+                                          "n": 1})
+            assert rs.affected == 1
+            # read-your-writes: the fenced read waits for the replica apply
+            rows = bounded(
+                lambda: s.execute("SELECT v FROM kv WHERE k = 42").rows)
+            assert rows == [(420,)]
+            FAIL_POINTS.clear()
+            assert inst.applier.drain(30.0)
+            # exactly once ON THE REPLICA: direct count + dedupe-hit proof
+            _c, _t, data, _v = rep_client.execute(
+                "SELECT count(*) FROM kv WHERE k = 42", "w")
+            assert int(next(iter(data.values()))[0]) == 1
+            st1 = rep_client.sync_action("worker_stats", {})
+            assert st1["dedupe_hits"] >= st0["dedupe_hits"] + 1
+            assert inst.metrics.counter("replica_async_applies").value >= 1
+            tm = inst.catalog.table("w", "kv")
+            assert not any(r.get("stale") for r in tm.replicas)
+        finally:
+            FAIL_POINTS.clear()
+            s.close()
+            prim.close()
+            rep.close()
+
+    def test_failed_replica_leg_marks_stale(self):
+        """A replica that dies before its async leg applies goes STALE —
+        excluded from reads until rebuilt (the synchronous contract, late)."""
+        from test_chaos import WorkerHarness, bounded
+        prim = WorkerHarness(
+            init_sql="CREATE DATABASE w; USE w; "
+                     "CREATE TABLE kv (k BIGINT PRIMARY KEY, v BIGINT); "
+                     "INSERT INTO kv VALUES (1, 10)")
+        rep = WorkerHarness(
+            init_sql="CREATE DATABASE w; USE w; "
+                     "CREATE TABLE kv (k BIGINT PRIMARY KEY, v BIGINT)")
+        inst = Instance()
+        s = Session(inst)
+        try:
+            s.execute("CREATE DATABASE w")
+            s.execute("USE w")
+            inst.attach_remote_table("w", "kv", *prim.addr)
+            inst.attach_replica("w", "kv", *rep.addr)
+            FAIL_POINTS.arm(FP_APPLY_DELAY_MS, 200)
+            rep.kill()
+            rs = bounded(lambda: s.execute("INSERT INTO kv VALUES (7, 70)"))
+            assert rs.affected == 1
+            FAIL_POINTS.clear()
+            inst.applier.drain(60.0)
+            tm = inst.catalog.table("w", "kv")
+            entry = [r for r in tm.replicas
+                     if (r["host"], r["port"]) == rep.addr][0]
+            assert entry.get("stale") is True
+            # primary still serves the row (reads skip the stale replica)
+            rows = bounded(
+                lambda: s.execute("SELECT v FROM kv WHERE k = 7").rows)
+            assert rows == [(70,)]
+        finally:
+            FAIL_POINTS.clear()
+            s.close()
+            prim.close()
+            rep.close()
