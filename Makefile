@@ -2,6 +2,22 @@
 
 PY ?= python
 
+# galaxylint: the repo-specific static-analysis suite (lock-order vs the
+# canonical append_lock -> partition -> store/metadb order + blocking ops
+# under hot locks, raw-jax.jit / device-sync jit discipline, typed-error
+# wire-contract swallows and untyped raises, failpoint/metrics hygiene).
+# Exits 0 only with ZERO unsuppressed findings; suppressions live as
+# justified `# galaxylint: disable=<rule> -- why` pragmas or justified
+# entries in galaxysql_tpu/devtools/baseline.json (stale entries fail).
+lint:
+	$(PY) -m galaxysql_tpu.devtools.lint
+
+# lint smoke: the lint marker suite — per-rule positive/negative fixtures,
+# pragma/baseline round-trips, the whole-tree zero-findings self-run, and
+# the runtime lockdep witness incl. the FP_LOCK_INVERT seeded inversion
+lint-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m lint -p no:cacheprovider
+
 # full tier-1 gate (ROADMAP.md)
 tier1:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -64,8 +80,10 @@ bench:
 # bit-identical results under 100+ concurrent sessions, poisoned-key error
 # isolation, snapshot/txn bypass edges, static-bucket retrace guard) plus the
 # closed-loop multi-session serving bench (QPS/chip + p99, batching on vs off)
+# (GALAXYSQL_LOCKDEP=1: every concurrency test doubles as a lock-order
+# proof — the runtime witness fails loudly on any acquisition-graph cycle)
 batch-smoke:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m batching -p no:cacheprovider
+	JAX_PLATFORMS=cpu GALAXYSQL_LOCKDEP=1 $(PY) -m pytest tests/ -q -m batching -p no:cacheprovider
 	JAX_PLATFORMS=cpu BENCH_BATCH_SESSIONS=100,1000 $(PY) bench.py --batch-only
 
 # DML batching smoke: the dml_batch marker suite (batched vs sequential
@@ -73,8 +91,9 @@ batch-smoke:
 # error isolation, own-txn bypass, read-your-writes after async GSI apply,
 # replica reply-leg-drop exactly-once, group commit, CDC coalescing +
 # replay equivalence, the hatch trio, steady-state retrace/dispatch guards)
+# (GALAXYSQL_LOCKDEP=1: the lockdep witness rides every write-path test)
 dml-smoke:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m dml_batch -p no:cacheprovider
+	JAX_PLATFORMS=cpu GALAXYSQL_LOCKDEP=1 $(PY) -m pytest tests/ -q -m dml_batch -p no:cacheprovider
 
 # DML bench: closed-loop point-DML + mixed read/write serving, DML batching
 # on vs off (BENCH json lines on stdout; BENCH_DML_SESSIONS=64,256 default)
@@ -87,8 +106,9 @@ bench-dml:
 # cache healing, XA crash-restart recovery, replica read failover, and the
 # fixed-seed fault-schedule matrix driving TPC-H Q5 + concurrent point DML
 # (bit-identical-or-typed-error, zero hangs, zero double-applies)
+# (GALAXYSQL_LOCKDEP=1: fault-schedule concurrency doubles as a lock proof)
 chaos-smoke:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos -p no:cacheprovider
+	JAX_PLATFORMS=cpu GALAXYSQL_LOCKDEP=1 $(PY) -m pytest tests/ -q -m chaos -p no:cacheprovider
 
 # skew smoke: heavy-hitter hybrid joins + salted aggregation vs SKEW(OFF)
 # bit-identical across the Zipf theta sweep (8-virtual-device mesh), both
@@ -123,4 +143,4 @@ heal-smoke:
 
 .PHONY: tier1 fusion-smoke obs-smoke rf-smoke cache-smoke trace-smoke bench \
 	batch-smoke chaos-smoke skew-smoke bench-skew summary-smoke heal-smoke \
-	overload-smoke bench-overload dml-smoke bench-dml
+	overload-smoke bench-overload dml-smoke bench-dml lint lint-smoke

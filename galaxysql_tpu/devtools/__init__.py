@@ -1,0 +1,14 @@
+"""galaxylint: repo-specific static analysis + the runtime lockdep witness.
+
+The reference system ships correctness *tooling*, not just correctness
+(FastChecker, `executor/fastchecker/FastChecker.java` — ported in
+`utils/fastchecker.py` for data consistency).  This package is the same shape
+of tooling for the ENGINE'S OWN CODE: the hand-enforced invariants that used
+to live in comments and reviewer memory (the append_lock-before-partition-lock
+ordering, the `global_jit` zero-retrace discipline, the typed-error wire
+contract, failpoint/metrics hygiene) are mechanized as AST passes so the next
+PR can't silently regress them.
+
+Entry point: `python -m galaxysql_tpu.devtools.lint` (the `make lint` target).
+The runtime half lives in `utils/lockdep.py`.
+"""

@@ -1,0 +1,17 @@
+"""Checker registry: the four repo-specific galaxylint passes.
+
+Adding a pass = subclass `devtools.lint.Checker`, implement `check`
+(per-file) and/or `finalize` (cross-file), list it here.
+"""
+
+from galaxysql_tpu.devtools.checkers.lock_order import LockOrderChecker
+from galaxysql_tpu.devtools.checkers.jit_discipline import JitDisciplineChecker
+from galaxysql_tpu.devtools.checkers.typed_errors import TypedErrorChecker
+from galaxysql_tpu.devtools.checkers.hygiene import HygieneChecker
+
+ALL_CHECKERS = [
+    LockOrderChecker(),
+    JitDisciplineChecker(),
+    TypedErrorChecker(),
+    HygieneChecker(),
+]

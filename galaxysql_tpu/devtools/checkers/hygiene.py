@@ -1,0 +1,101 @@
+"""dead-failpoint / metric-orphan: chaos + observability hygiene.
+
+Cross-file passes (they run in `finalize`, over the whole project):
+
+- **dead-failpoint**: an `FP_*` key defined in the package but never armed
+  by any test is dead chaos coverage — the failure path it guards is never
+  exercised, which is exactly how exactly-once/recovery bugs hide.  Tests
+  count as coverage by NAME (symbol or string literal) anywhere under
+  tests/.
+- **metric-orphan**: a module-level process-shared metric constant
+  (`NAME = Counter/Gauge/Histogram(...)`) must be BOTH updated somewhere
+  (`.inc/.observe/.set/.dec` — otherwise it's a dead gauge lying on every
+  dashboard) and surfaced (referenced by a module that adopts metrics into
+  the instance registry — otherwise it's invisible to SHOW METRICS,
+  information_schema.metrics, and Prometheus).  Registry-created metrics
+  (`registry.counter(...)`) auto-surface and are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from galaxysql_tpu.devtools.lint import Checker, Finding, Project
+
+_FP_NAME = re.compile(r"^FP_[A-Z0-9_]+$")
+_METRIC_CTORS = ("Counter", "Gauge", "Histogram")
+
+
+class HygieneChecker(Checker):
+    rules = ("dead-failpoint", "metric-orphan")
+    description = ("FP_* keys never armed by any test; process-shared "
+                   "metrics never updated or never adopted/surfaced")
+
+    def finalize(self, project: Project):
+        findings: List[Finding] = []
+        findings.extend(self._dead_failpoints(project))
+        findings.extend(self._metric_orphans(project))
+        return findings
+
+    def _dead_failpoints(self, project: Project):
+        findings = []
+        for mod in project.modules:
+            for node in ast.iter_child_nodes(mod.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and _FP_NAME.match(tgt.id) \
+                            and isinstance(node.value, ast.Constant) \
+                            and isinstance(node.value.value, str):
+                        # word-boundary match: FP_RPC_DELAY must not count
+                        # as covered because tests arm FP_RPC_DELAY_MS
+                        if not re.search(rf"\b{tgt.id}\b",
+                                         project.test_text):
+                            findings.append(self.finding(
+                                mod, node.lineno,
+                                f"fail point {tgt.id} is never armed by any "
+                                f"test: dead chaos coverage — the failure "
+                                f"path it guards is never exercised",
+                                rule="dead-failpoint"))
+        return findings
+
+    def _metric_orphans(self, project: Project):
+        findings = []
+        # modules that adopt process-shared metrics into a registry
+        adopters = [m for m in project.modules if ".adopt(" in m.src]
+        for mod in project.modules:
+            for node in ast.iter_child_nodes(mod.tree):
+                if not isinstance(node, ast.Assign) or \
+                        not isinstance(node.value, ast.Call):
+                    continue
+                fn = node.value.func
+                ctor = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else "")
+                if ctor not in _METRIC_CTORS:
+                    continue
+                for tgt in node.targets:
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    name = tgt.id
+                    updated = re.search(
+                        rf"\b{name}\.(inc|observe|observe_many|set|dec)\b",
+                        project.package_text)
+                    if not updated:
+                        findings.append(self.finding(
+                            mod, node.lineno,
+                            f"metric {name} is registered but never "
+                            f"updated anywhere — a dead metric lying on "
+                            f"every dashboard", rule="metric-orphan"))
+                    surfaced = any(re.search(rf"\b{name}\b", a.src)
+                                   for a in adopters if a is not mod) or \
+                        re.search(rf"adopt\(\s*{name}\b", mod.src)
+                    if not surfaced:
+                        findings.append(self.finding(
+                            mod, node.lineno,
+                            f"process-shared metric {name} is never adopted "
+                            f"into an instance registry — invisible to SHOW "
+                            f"METRICS / information_schema.metrics / "
+                            f"Prometheus", rule="metric-orphan"))
+        return findings

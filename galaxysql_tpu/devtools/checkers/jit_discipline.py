@@ -1,0 +1,130 @@
+"""jit-raw / jit-device-sync: the `global_jit` zero-retrace discipline.
+
+Every perf PR re-proves the same two invariants with dispatch-count guards;
+these passes mechanize them:
+
+- **jit-raw**: a bare `jax.jit(...)` call OUTSIDE a builder passed to
+  `global_jit` compiles a program that is invisible to the process-wide LRU
+  (no cross-execution reuse, no compile-span accounting, no retrace
+  counting) — a plan-cache hit would still pay a full retrace.  A `jax.jit`
+  is legal only inside a function whose name is passed to `global_jit` in
+  the same module (the `def build(): ... return jax.jit(run)` idiom) or in a
+  lambda written directly into a `global_jit(...)` argument.
+- **jit-device-sync**: `.item()` / `.block_until_ready()` on the default
+  query path forces a host<->device sync per call.  Flagged in the hot-path
+  layers (exec/, kernels/, parallel/, chunk/, server/, storage/) unless the
+  enclosing scope is profiling/bench/EXPLAIN machinery (allowlisted by
+  qualname pattern), where the sync is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Set
+
+from galaxysql_tpu.devtools.lint import Checker, Module
+
+HOT_PREFIXES = ("galaxysql_tpu/exec/", "galaxysql_tpu/kernels/",
+                "galaxysql_tpu/parallel/", "galaxysql_tpu/chunk/",
+                "galaxysql_tpu/server/", "galaxysql_tpu/storage/")
+
+# scopes where a device sync is the feature, not a leak: profiling, EXPLAIN
+# ANALYZE, benchmarks, tracing/telemetry observation hooks
+ALLOW_QUAL = re.compile(
+    r"explain|profil|bench|analyz|stats|trace|observe|debug|telemetry",
+    re.IGNORECASE)
+
+
+def _is_jax_jit(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "jit"
+            and isinstance(f.value, ast.Name) and f.value.id == "jax")
+
+
+def _is_global_jit(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id == "global_jit"
+    return isinstance(f, ast.Attribute) and f.attr == "global_jit"
+
+
+class JitDisciplineChecker(Checker):
+    rules = ("jit-raw", "jit-device-sync")
+    description = ("raw jax.jit outside a global_jit builder closure; "
+                   "device-sync primitives on the hot path outside "
+                   "profiling/bench scopes")
+
+    def check(self, mod: Module):
+        findings = []
+        findings.extend(self._check_raw_jit(mod))
+        if mod.relpath.startswith(HOT_PREFIXES):
+            findings.extend(self._check_device_sync(mod))
+        return findings
+
+    # -- jit-raw -------------------------------------------------------------
+
+    def _check_raw_jit(self, mod: Module):
+        builder_names: Set[str] = set()
+        allowed_lambdas: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and _is_global_jit(node):
+                args = list(node.args) + [kw.value for kw in node.keywords]
+                for a in args:
+                    if isinstance(a, ast.Name):
+                        builder_names.add(a.id)
+                for a in args:
+                    for sub in ast.walk(a):
+                        if isinstance(sub, ast.Lambda):
+                            allowed_lambdas.add(id(sub))
+
+        findings = []
+
+        def walk(node: ast.AST, stack: List[ast.AST]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.Call) and _is_jax_jit(child):
+                    ok = False
+                    for s in stack:
+                        if isinstance(s, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) and \
+                                s.name in builder_names:
+                            ok = True
+                            break
+                        if isinstance(s, ast.Lambda) and \
+                                id(s) in allowed_lambdas:
+                            ok = True
+                            break
+                    if not ok:
+                        findings.append(self.finding(
+                            mod, child.lineno,
+                            "raw jax.jit outside a global_jit builder "
+                            "closure: the program escapes the process-wide "
+                            "LRU, retrace accounting, and compile spans",
+                            rule="jit-raw"))
+                walk(child, stack + [child])
+
+        walk(mod.tree, [])
+        return findings
+
+    # -- jit-device-sync -----------------------------------------------------
+
+    def _check_device_sync(self, mod: Module):
+        findings = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            if f.attr not in ("item", "block_until_ready"):
+                continue
+            qual = mod.qualname_at(node.lineno)
+            if ALLOW_QUAL.search(qual or ""):
+                continue
+            findings.append(self.finding(
+                mod, node.lineno,
+                f".{f.attr}() forces a host<->device sync; on the default "
+                f"query path every call stalls the dispatch pipeline "
+                f"(profiling/bench scopes are allowlisted by name)",
+                rule="jit-device-sync", severity="warn"))
+        return findings

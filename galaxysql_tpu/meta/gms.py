@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from galaxysql_tpu.meta.catalog import (Catalog, ColumnMeta, IndexMeta, PartitionInfo,
                                         TableMeta)
 from galaxysql_tpu.types import datatype as dt
+from galaxysql_tpu.utils.lockdep import named_lock
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS schemata (
@@ -75,7 +76,9 @@ class MetaDb:
         if path:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._conn = sqlite3.connect(self.path, check_same_thread=False)
-        self._lock = threading.RLock()
+        # named for the lockdep witness: rank 2 in the canonical order
+        # (append_lock -> partition -> metadb); plain RLock when disarmed
+        self._lock = named_lock("metadb")
         with self._lock:
             self._conn.executescript(_SCHEMA)
             self._conn.commit()

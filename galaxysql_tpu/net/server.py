@@ -134,7 +134,7 @@ class Connection:
             self.session.close()
             try:
                 self.writer.close()
-            except Exception:
+            except Exception:  # galaxylint: disable=swallow -- client already vanished; socket close is best-effort
                 pass
 
     async def _run_inner(self):

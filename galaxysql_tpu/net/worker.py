@@ -111,8 +111,16 @@ class Worker:
             try:
                 resp["wl"] = {"q": len(self._active),
                               "mt": self.instance.admission.governor.tier()}
-            except Exception:
-                pass  # load telemetry must never fail a data request
+            except Exception as tex:
+                # load telemetry must never fail a data request — but a
+                # BROKEN piggyback means the coordinator routes blind, so
+                # journal it once instead of swallowing (lint: typed-error
+                # discipline); deduped: one event, not one per reply
+                from galaxysql_tpu.utils import events
+                events.publish(
+                    "worker_telemetry_failed",
+                    f"load piggyback failed: {type(tex).__name__}: {tex}",
+                    severity="warn", dedupe="worker-wl")
         return resp, out
 
     def _handle_epochs(self, header: dict, arrays: Dict[str, np.ndarray]):
@@ -425,7 +433,7 @@ class Worker:
             return None
         try:
             return int(json.loads(v)["txn_id"])
-        except Exception:
+        except Exception:  # galaxylint: disable=swallow -- kv probe: None means no such branch, the caller's contract
             return None
 
     def _finalize_stamps(self, txn_id: int, commit_ts):
@@ -531,7 +539,7 @@ class Worker:
             try:
                 if json.loads(v).get("state") == "PREPARED":
                     xids.append(k[len("xa.branch."):])
-            except Exception:
+            except Exception:  # galaxylint: disable=swallow -- one corrupt branch record must not hide the other in-doubt xids
                 continue
         return {"ok": True, "xids": xids}, {}
 

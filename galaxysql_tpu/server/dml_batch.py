@@ -295,7 +295,7 @@ class DmlBatchScheduler(BatchScheduler):
                  reqs: List[BatchRequest]):
         inst = self.instance
         if inst.catalog.schema_version != pp["schema_version"]:
-            raise RuntimeError("schema changed under the group")  # -> fallback
+            raise RuntimeError("schema changed under the group")  # galaxylint: disable=untyped-raise -- group fallback signal caught by the flush; never crosses the wire
         tm = inst.catalog.table(pp["schema"], pp["table"])
         store = inst.store(pp["schema"], pp["table"])
         inst_key = f"{tm.schema.lower()}.{tm.name.lower()}"
@@ -304,7 +304,7 @@ class DmlBatchScheduler(BatchScheduler):
             # statements go sequential directly instead of paying a window +
             # fallback on every execution
             inst.dml_plans.pop((gkey[0], gkey[1]), None)
-            raise RuntimeError("archive-backed table")  # group falls back
+            raise RuntimeError("archive-backed table")  # galaxylint: disable=untyped-raise -- group fallback signal (archive) caught by the flush; never crosses the wire
         # ONE shared flush-time TSO: every member's write stamps at the same
         # instant they linearize at (group commit for autocommit writes)
         ts = inst.tso.next_timestamp()

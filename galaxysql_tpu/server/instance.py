@@ -153,7 +153,10 @@ class Instance:
         self.admission = AdmissionController(self)
         from galaxysql_tpu.server.maintain import RecycleBin
         self.recycle = RecycleBin(self)
-        self.lock = threading.RLock()
+        # named for the lockdep witness (unranked class "instance"); a plain
+        # RLock when lockdep is disarmed — the default
+        from galaxysql_tpu.utils.lockdep import named_lock
+        self.lock = named_lock("instance")
         self.next_conn_id = 1
         self.sessions: Dict[int, object] = {}
         self.catalog.create_schema("information_schema", if_not_exists=True)
@@ -359,7 +362,8 @@ class Instance:
         client = self.worker_client(host, port)
         tm = self.catalog.table(schema, name)
         if getattr(tm, "remote", None) is None:
-            raise ValueError(f"{schema}.{name} is not a remote table")
+            raise errors.NotSupportedError(
+                f"{schema}.{name} is not a remote table")
         entry = next((r for r in tm.replicas
                       if (r["host"], r["port"]) == key), None)
         if entry is not None and entry.get("stale") and backfill is not True:
@@ -466,7 +470,8 @@ class Instance:
            primary endpoint swaps."""
         tm = self.catalog.table(schema, name)
         if getattr(tm, "remote", None) is None:
-            raise ValueError(f"{schema}.{name} is not a remote table")
+            raise errors.NotSupportedError(
+                f"{schema}.{name} is not a remote table")
         src = self.workers[(tm.remote["host"], tm.remote["port"])]
         dst = self.worker_client(host, port)
         # target bootstrap: schema + table shape from this CN's meta

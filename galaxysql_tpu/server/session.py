@@ -218,10 +218,23 @@ class Session:
         return [self._execute_one(s, params) for s in stmts] if stmts else [ok()]
 
     def close(self):
-        if self.txn is not None:
-            self._rollback()
-        self.instance.locks.release_all(self.conn_id)
-        self.instance.sessions.pop(self.conn_id, None)
+        # session exit ramp: a failed rollback must NOT leak the session's
+        # advisory locks or registry entry (other sessions would block on
+        # GET_LOCK forever) — and must not vanish silently either: the
+        # failure lands in the journal as a severity-tagged event
+        try:
+            if self.txn is not None:
+                self._rollback()
+        except Exception as rex:
+            from galaxysql_tpu.utils import events
+            events.publish(
+                "session_close_failed",
+                f"rollback on session close failed for conn "
+                f"{self.conn_id}: {type(rex).__name__}: {rex}",
+                severity="warn", node=self.instance.node_id)
+        finally:
+            self.instance.locks.release_all(self.conn_id)
+            self.instance.sessions.pop(self.conn_id, None)
 
     def _lock_fn(self, name: str, vals: list):
         """GET_LOCK family (LockingFunctionManager.java analog)."""
@@ -1438,6 +1451,7 @@ class Session:
         data = {tm.column(c).name: vals for c, vals in data.items()}
         # append_lock: the appended-range derivation must not interleave
         # with a concurrent writer's appends (see TableStore.append_lock)
+        store._lockdep_probe()  # FP_LOCK_INVERT only; disarmed = one bool
         with store.append_lock:
             before_counts = [p.num_rows for p in store.partitions]
             n = store.insert_pylists(data, ts)
@@ -1604,8 +1618,18 @@ class Session:
                         self.instance.workers[addr].request(
                             {"op": "xa_rollback", "xid": xid},
                             deadline=time.time() + 5.0)
-                    except Exception:
-                        pass
+                    except Exception as cex:
+                        # the stale-mark above already fences the replica;
+                        # journal the stranded branch so operators see WHY
+                        # xa_recover has work (lint: typed-error discipline)
+                        from galaxysql_tpu.utils import events
+                        events.publish(
+                            "replica_cleanup_failed",
+                            f"replica rollback for {xid} at {addr} failed "
+                            f"({type(cex).__name__}); branch resolves via "
+                            f"xa_recover", severity="warn",
+                            node=self.instance.node_id,
+                            dedupe=f"dml-rb:{addr}")
                     continue
                 if auto:
                     self._rollback()
@@ -2197,7 +2221,7 @@ def _fold_constant(e: ir.Expr) -> ir.Literal:
     d, v = f({})
     if v is not None and not np.all(np.asarray(v)):
         return ir.Literal(None, e.dtype)
-    val = np.asarray(d).item()
+    val = np.asarray(d).item()  # galaxylint: disable=jit-device-sync -- np-backend constant fold at bind time: d is a host numpy scalar, no device involved
     if e.dtype.clazz == dt.TypeClass.DECIMAL:
         val = val / (10 ** e.dtype.scale)
     return ir.Literal(val, e.dtype)

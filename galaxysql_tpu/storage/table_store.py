@@ -27,6 +27,8 @@ from galaxysql_tpu.chunk.batch import Column, ColumnBatch, Dictionary, column_fr
 from galaxysql_tpu.meta.catalog import PartitionRouter, TableMeta
 from galaxysql_tpu.types import datatype as dt
 from galaxysql_tpu.utils import errors
+from galaxysql_tpu.utils.failpoint import FAIL_POINTS, FP_LOCK_INVERT
+from galaxysql_tpu.utils.lockdep import named_lock
 
 INFINITY_TS = (1 << 63) - 1  # int64 max; must exceed any TSO value (phys_ms << 22 ~ 7.5e18)
 
@@ -43,7 +45,12 @@ class Partition:
             c.name: np.zeros(0, dtype=np.bool_) for c in table.columns}
         self.begin_ts = np.zeros(0, dtype=np.int64)
         self.end_ts = np.zeros(0, dtype=np.int64)
-        self.lock = threading.RLock()
+        # lockdep class splits base tables from GSI stores ($-named): the
+        # write path legitimately nests base-partition -> gsi-partition
+        # (e.g. UPDATE holds the base row lock while maintaining the index),
+        # which is a cross-class ORDER, not a same-class hazard
+        self.lock = named_lock(
+            "partition.gsi" if "$" in table.name else "partition")
         # append-aware sorted key indexes: col -> (lane_gen, n0, perm, sorted_keys)
         # where perm sorts rows [0, n0).  Appends don't invalidate (MVCC rows are
         # immutable; the [n0, n) tail is probed linearly until it outgrows
@@ -155,9 +162,24 @@ class TableStore:
         # OTHER's rows to their own [start, n) range — double-captured CDC,
         # double-propagated GSI rows, mis-ranged txn undo entries.  Partition
         # locks only make each append atomic, not the count arithmetic.
-        self.append_lock = threading.RLock()
+        self.append_lock = named_lock(
+            "append_lock.gsi" if "$" in table.name else "append_lock")
 
     # -- write path ----------------------------------------------------------
+
+    def _lockdep_probe(self):
+        """FP_LOCK_INVERT: deliberately acquire a partition lock and THEN the
+        append_lock — the reverse of the canonical order — on the real insert
+        ramp, so the lockdep witness test proves the runtime cycle check trips
+        where it matters.  Disarmed (always, outside that test), this is one
+        bool read.  Called BEFORE the ramp takes append_lock: a nested
+        re-entrant acquisition would not create a graph edge."""
+        if FAIL_POINTS.active and FAIL_POINTS.value(FP_LOCK_INVERT) \
+                and self.partitions:
+            p = self.partitions[0]
+            with p.lock:
+                with self.append_lock:  # galaxylint: disable=lock-order -- deliberate seeded inversion proving the lockdep witness trips (tests/test_lint.py)
+                    pass
 
     def insert_pylists(self, data: Dict[str, List[Any]], begin_ts: int) -> int:
         """Encode python values and route rows to partitions.  Returns rows inserted."""

@@ -161,7 +161,7 @@ class AsyncApplier:
                                 severity="error",
                                 node=self.instance.node_id,
                                 kind=task.get("kind", ""))
-                        except Exception:
+                        except Exception:  # galaxylint: disable=swallow -- guards the journal itself; there is nowhere left to report to
                             pass
             self._finish_batch(touched)
             with self._cond:
@@ -192,7 +192,8 @@ class AsyncApplier:
         elif kind == "replica":
             self._apply_replica(task)
         else:  # pragma: no cover - queue corruption guard
-            raise ValueError(f"unknown async apply task kind {kind!r}")
+            from galaxysql_tpu.utils import errors
+            raise errors.TddlError(f"unknown async apply task kind {kind!r}")
 
     def _touch_gsi(self, tm, touched: Dict[str, Any]):
         from galaxysql_tpu.server import session as _sess
@@ -241,8 +242,18 @@ class AsyncApplier:
                 try:
                     client.request({"op": "xa_rollback", "xid": xid},
                                    deadline=time.time() + 5.0)
-                except Exception:
-                    pass
+                except Exception as cex:
+                    # the branch stays in doubt until xa_recover resolves
+                    # it — journal the stranded xid instead of dropping the
+                    # failure on the floor (lint: typed-error discipline)
+                    from galaxysql_tpu.utils import events
+                    events.publish(
+                        "replica_cleanup_failed",
+                        f"replica rollback for {xid} failed "
+                        f"({type(cex).__name__}); branch resolves via "
+                        f"xa_recover", severity="warn",
+                        node=self.instance.node_id,
+                        dedupe=f"apply-rb:{task.get('addr')}")
             raise
 
     def _mark_stale(self, task: dict):

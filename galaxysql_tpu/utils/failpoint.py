@@ -22,7 +22,6 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, Optional
 
-FP_RANDOM_CRASH = "FP_RANDOM_CRASH"
 FP_BEFORE_DDL_TASK = "FP_BEFORE_DDL_TASK"
 FP_AFTER_DDL_TASK = "FP_AFTER_DDL_TASK"
 FP_BEFORE_COMMIT = "FP_BEFORE_COMMIT"
@@ -60,6 +59,11 @@ FP_WORKER_SLOW_DRAIN = "FP_WORKER_SLOW_DRAIN"
 # governor's computed tier.  Arm value: "elevated" | "critical" | a float
 # usage fraction (e.g. 0.95) fed through the normal thresholds.
 FP_MEM_PRESSURE = "FP_MEM_PRESSURE"
+# lockdep witness proof (tests/test_lint.py): the DML insert ramp performs a
+# DELIBERATE partition-lock -> append_lock acquisition (the reverse of the
+# canonical order) so the runtime lock-order witness provably trips on a
+# real engine code path (storage/table_store.py `_lockdep_probe`)
+FP_LOCK_INVERT = "FP_LOCK_INVERT"
 
 
 class FailPointError(RuntimeError):

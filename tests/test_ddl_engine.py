@@ -35,6 +35,20 @@ class TestAlterTable:
         with pytest.raises(errors.UnknownColumnError):
             session.execute("SELECT b FROM t")
 
+    def test_crash_before_first_task_recovers(self, session):
+        """FP_BEFORE_DDL_TASK: a crash BEFORE any task of a DDL job runs
+        leaves the job RUNNING with zero tasks done; recovery completes it
+        with no partial state (the galaxylint dead-failpoint pass keeps
+        this key armed — it was dead chaos coverage before)."""
+        session.execute("CREATE TABLE bt (a BIGINT, b BIGINT)")
+        session.execute("INSERT INTO bt VALUES (1, 2)")
+        FAIL_POINTS.arm("FP_BEFORE_DDL_TASK", 1)
+        with pytest.raises(FailPointError):
+            session.execute("ALTER TABLE bt ADD COLUMN c BIGINT DEFAULT 5")
+        FAIL_POINTS.clear()
+        assert session.instance.ddl_engine.recover()
+        assert session.execute("SELECT a, c FROM bt").rows == [(1, 5)]
+
     def test_rename(self, session):
         session.execute("CREATE TABLE r1 (a BIGINT)")
         session.execute("INSERT INTO r1 VALUES (5)")
