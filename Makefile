@@ -131,6 +131,31 @@ bench-skew:
 summary-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m summary -p no:cacheprovider
 
+# rebalance smoke: partition-granular elasticity — SPLIT/MERGE/MOVE PARTITION
+# end-to-end (bucket-map conversion routing identity, shadow backfill + CDC
+# catchup + FastChecker verify + TSO-fenced cutover), crash-resume from every
+# checkpoint, verify-mismatch rollback restoring the source byte-identically,
+# the open-transaction cutover drain, the heat-driven balancer policy with
+# its admission-pressure yield, and the SHOW REBALANCE surfaces
+# (GALAXYSQL_LOCKDEP=1: the move path's partition/router lock choreography
+# doubles as a lock-order proof)
+rebalance-smoke:
+	JAX_PLATFORMS=cpu GALAXYSQL_LOCKDEP=1 $(PY) -m pytest tests/ -q -m rebalance -p no:cacheprovider
+
+# rebalance chaos: crash schedules at EVERY job state transition (task
+# boundaries, mid-backfill chunk, mid-catchup page, inside the cutover before
+# and after the swap) with DML racing the move and readers watching —
+# bit-identical-or-typed-error, zero lost/duplicated acked writes, and
+# crash-resume completing from the last checkpoint (or undo restoring the
+# source exactly)
+chaos-rebalance:
+	JAX_PLATFORMS=cpu GALAXYSQL_LOCKDEP=1 $(PY) -m pytest tests/ -q -m rebalance_chaos -p no:cacheprovider
+
+# rebalance bench: closed-loop point serving measured quiesced vs during a
+# live SPLIT (rebalance-while-serving QPS dip + p99; BENCH json on stdout)
+bench-rebalance:
+	JAX_PLATFORMS=cpu $(PY) bench.py --rebalance-only
+
 # self-heal smoke: the quarantine state machine end-to-end — a genuine
 # stats-driven join-order regression auto-rolls-back, verifies over
 # PLAN_HEAL_VERIFY_EXECS executions, and promotes (bit-identical results,
@@ -143,4 +168,5 @@ heal-smoke:
 
 .PHONY: tier1 fusion-smoke obs-smoke rf-smoke cache-smoke trace-smoke bench \
 	batch-smoke chaos-smoke skew-smoke bench-skew summary-smoke heal-smoke \
-	overload-smoke bench-overload dml-smoke bench-dml lint lint-smoke
+	overload-smoke bench-overload dml-smoke bench-dml lint lint-smoke \
+	rebalance-smoke chaos-rebalance bench-rebalance

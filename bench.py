@@ -1149,6 +1149,107 @@ def overload_bench(inst, s, data, platform):
     }]
 
 
+def rebalance_bench(inst, s, platform):
+    """`bench.py --rebalance-only` (make bench-rebalance): point serving
+    measured quiesced, then DURING a live SPLIT PARTITION job — the
+    rebalance-while-serving QPS dip and p99 inflation the elasticity plane
+    promises to bound, plus the data-movement throughput itself.
+
+    The split is slowed to bench scale (small chunks) so the measured
+    closed-loop window genuinely overlaps the backfill+catchup+cutover
+    pipeline rather than sampling an already-finished job."""
+    import threading
+    from galaxysql_tpu.ddl import rebalance as rb
+
+    n_rows = int(os.environ.get("BENCH_REBALANCE_ROWS", "200000"))
+    n_sessions = int(os.environ.get("BENCH_REBALANCE_SESSIONS", "32"))
+    s.execute("CREATE DATABASE IF NOT EXISTS rbench")
+    s.execute("USE rbench")
+    s.execute("CREATE TABLE rt (id BIGINT PRIMARY KEY, grp BIGINT, "
+              "v BIGINT) PARTITION BY HASH(id) PARTITIONS 4")
+    store = inst.store("rbench", "rt")
+    store.insert_pylists(
+        {"id": list(range(n_rows)), "grp": [i % 97 for i in range(n_rows)],
+         "v": list(range(n_rows))}, inst.tso.next_timestamp())
+    tpl = "select v from rt where id = %d"
+    keys = list(range(0, n_rows, max(1, n_rows // 4096)))
+    nkeys = len(keys)
+    s.execute(tpl % keys[0])  # register + warm the PointPlan
+    s.execute(tpl % keys[0])
+
+    def _loop(n, per):
+        return _closed_loop_ops(
+            inst, "rbench", n, per,
+            lambda sx, i, j: sx.execute(tpl % keys[(i * 31 + j * 7) % nkeys]))
+
+    _loop(n_sessions, 4)  # ramp
+    per = max(4, int(os.environ.get("BENCH_REBALANCE_PER_SESSION", "24")))
+    qps0, p99_0, errs0 = _loop(n_sessions, per)
+
+    old_chunk = rb.RebalanceBackfillTask.CHUNK
+    rb.RebalanceBackfillTask.CHUNK = max(
+        256, n_rows // (4 * 64))  # ~64 checkpointed chunks per partition
+    job_wall = [0.0]
+    job_err: list = []
+
+    def _run_split():
+        sx = Session(inst, schema="rbench")
+        t0 = time.perf_counter()
+        try:
+            sx.execute("ALTER TABLE rt SPLIT PARTITION p1 INTO 2")
+        except Exception as e:  # pragma: no cover - surfaced in the json
+            job_err.append(repr(e))
+        finally:
+            job_wall[0] = time.perf_counter() - t0
+            sx.close()
+
+    mover = threading.Thread(target=_run_split)
+    mover.start()
+    lats_qps = []
+    try:
+        # keep the closed loop running until the job finishes so the
+        # measurement covers backfill, catchup, AND the fenced cutover
+        while mover.is_alive():
+            lats_qps.append(_loop(n_sessions, per))
+    finally:
+        mover.join()
+        rb.RebalanceBackfillTask.CHUNK = old_chunk
+    if not lats_qps:
+        # split finished before the first overlap window (tiny table / fast
+        # box): report the quiesced numbers as a degenerate 1.0x overlap
+        lats_qps = [(qps0, p99_0, [])]
+    qps1 = min(q for q, _, _ in lats_qps)
+    p99_1 = max(p for _, p, _ in lats_qps)
+    errs1 = sum(len(e) for _, _, e in lats_qps)
+    moved = sum(p.num_rows for p in store.partitions[1:2]) + \
+        store.partitions[-1].num_rows
+    return [{
+        "metric": "rebalance_while_serving_qps_per_chip",
+        "value": round(qps1, 1), "unit": "qps",
+        "vs_baseline": round(qps1 / max(qps0, 1e-9), 3),
+        "quiesced_qps": round(qps0, 1),
+        "quiesced_p99_ms": round(p99_0, 3),
+        "during_p99_ms": round(p99_1, 3),
+        "p99_inflation": round(p99_1 / max(p99_0, 1e-9), 2),
+        "sessions": n_sessions,
+        "rebalance_wall_s": round(job_wall[0], 2),
+        "rows_moved": int(moved),
+        "move_rows_per_sec": round(moved / max(job_wall[0], 1e-9), 1),
+        "job_errors": job_err, "serve_errors": len(errs0) + errs1,
+        "windows_during": len(lats_qps),
+        "platform": platform,
+    }]
+
+
+def rebalance_only_main():
+    """`bench.py --rebalance-only` (make bench-rebalance): fresh instance,
+    no TPC-H load needed — the driver builds its own serving table."""
+    inst = Instance()
+    s = Session(inst)
+    for out in rebalance_bench(inst, s, jax.devices()[0].platform):
+        print(json.dumps(out))
+
+
 def overload_only_main():
     """`bench.py --overload-only` (make bench-overload): TP serving under an
     AP flood with admission control engaged, on a small TPC-H load."""
@@ -1186,5 +1287,7 @@ if __name__ == "__main__":
         skew_only_main()
     elif "--overload-only" in sys.argv:
         overload_only_main()
+    elif "--rebalance-only" in sys.argv:
+        rebalance_only_main()
     else:
         main()

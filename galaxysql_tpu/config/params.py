@@ -221,6 +221,55 @@ PLAN_REGRESSION_MIN_EXECS = _p(
     "successful executions needed to freeze a digest's latency baseline "
     "(median of them), and per window before the sentinel will judge it")
 
+# --- elastic rebalancing (ddl/rebalance.py + server/balancer.py) ---------------
+ENABLE_REBALANCE = _p(
+    "ENABLE_REBALANCE", True,
+    "heat-driven balancer: propose + execute partition split/merge/move "
+    "from observed per-partition heat (manual ALTER ... SPLIT/MERGE/MOVE "
+    "PARTITION jobs run regardless)")
+REBALANCE_THROTTLE_MS = _p(
+    "REBALANCE_THROTTLE_MS", 20,
+    "backfill pacing sleep per chunk while the memory governor reports "
+    "pressure (rebalance yields to serving); 0 disables pacing")
+REBALANCE_DRAIN_TIMEOUT_S = _p(
+    "REBALANCE_DRAIN_TIMEOUT_S", 30.0,
+    "cutover bound on waiting for open transactions that hold provisional "
+    "rows in the table's store; expiry aborts the job typed (source keeps "
+    "serving)")
+REBALANCE_VERIFY_LAG_MS = _p(
+    "REBALANCE_VERIFY_LAG_MS", 5000,
+    "the ONLINE verify gate checksums source vs shadow this far in the "
+    "past: binlog writes trail row visibility, so rows younger than the "
+    "margin may have unapplied events on the shadow (the cutover re-checks "
+    "exactly at the fence with writes drained)")
+REBALANCE_SPLIT_FACTOR = _p(
+    "REBALANCE_SPLIT_FACTOR", 2.0,
+    "balancer: split the hottest partition when its heat exceeds factor x "
+    "the table's mean partition heat")
+REBALANCE_MERGE_FACTOR = _p(
+    "REBALANCE_MERGE_FACTOR", 0.25,
+    "balancer: merge the two coldest partitions when their combined heat "
+    "is below factor x the mean")
+REBALANCE_HOT_WEIGHT = _p(
+    "REBALANCE_HOT_WEIGHT", 4.0,
+    "rows-equivalent weight of one sketch-observed hot-key occurrence in "
+    "partition heat (traffic counts more than resident bytes)")
+REBALANCE_MIN_ROWS = _p(
+    "REBALANCE_MIN_ROWS", 1000,
+    "tables with less total heat than this never rebalance (moving tiny "
+    "tables costs more than it saves)")
+REBALANCE_MAX_PARTITIONS = _p(
+    "REBALANCE_MAX_PARTITIONS", 64,
+    "balancer stops proposing splits at this partition count")
+REBALANCE_MIN_TRAFFIC_MS = _p(
+    "REBALANCE_MIN_TRAFFIC_MS", 0.0,
+    "statement-summary gate: tables whose digests consumed less total time "
+    "are skipped by the balancer (0 = consider every table)")
+REBALANCE_GROUPS = _p(
+    "REBALANCE_GROUPS", "",
+    "csv of placement group labels the balancer may MOVE partitions "
+    "across (empty = no cross-group move proposals)")
+
 # --- self-healing plan management (plan/spm.py quarantine machine) -------------
 ENABLE_PLAN_AUTOHEAL = _p(
     "ENABLE_PLAN_AUTOHEAL", True,

@@ -51,6 +51,9 @@ class DdlContext:
     def __init__(self, instance, schema: str):
         self.instance = instance
         self.schema = schema
+        # set by the engine before tasks run: rebalance tasks key their
+        # persisted kv descriptor/progress on the owning job
+        self.job_id: Optional[int] = None
 
     def table(self, name: str) -> TableMeta:
         return self.instance.catalog.table(self.schema, name)
@@ -412,6 +415,7 @@ class DdlEngine:
 
     def _execute(self, job: DdlJob, start_from: int = 0):
         ctx = DdlContext(self.instance, job.schema)
+        ctx.job_id = job.job_id
         db = self.metadb
 
         def checkpoint_task(tid, t, state):

@@ -43,6 +43,24 @@ class PartitionInfo:
     columns: List[str] = dataclasses.field(default_factory=list)
     count: int = 1
     boundaries: List[Tuple[str, List[Any]]] = dataclasses.field(default_factory=list)
+    # partition-granular elasticity (ddl/rebalance.py): when set, hash/key
+    # routing goes value -> bucket (mix % len(bucket_map)) -> partition
+    # bucket_map[bucket].  The bucket space is a fixed multiple of the count
+    # the table had when it was converted, and the initial assignment
+    # b -> b % count is routing-identical to the plain modulo (x % (n*K)) % n
+    # == x % n), so conversion is metadata-only; SPLIT/MERGE then reassign
+    # only the affected partition's buckets.
+    bucket_map: Optional[List[int]] = None
+    # per-partition placement group labels (parallel to partition ids;
+    # padded with DEFAULT_GROUP).  The balancer proposes MOVEs across groups;
+    # MOVE PARTITION rewrites one entry at cutover.
+    placement: List[str] = dataclasses.field(default_factory=list)
+
+    DEFAULT_GROUP = "g0"
+
+    def group_of(self, pid: int) -> str:
+        return self.placement[pid] if pid < len(self.placement) \
+            else self.DEFAULT_GROUP
 
     @property
     def num_partitions(self) -> int:
@@ -307,9 +325,20 @@ class PartitionRouter:
     Reference analog: `PartitionPruner.java:39` building `PartitionPruneStep` (§2.5).
     """
 
-    def __init__(self, table: TableMeta):
+    # monotonic mint for router identities: every swap installs a router
+    # with a fresh epoch so caches/tests can prove they re-keyed
+    _epoch_mint = itertools.count(1)
+
+    def __init__(self, table: TableMeta, info: Optional[PartitionInfo] = None):
+        """`info` overrides the table's live partitioning: the rebalance
+        backfill routes rows by the TARGET map while the table still serves
+        from the old one."""
         self.table = table
-        self.info = table.partition
+        self.info = info if info is not None else table.partition
+        self.epoch = next(PartitionRouter._epoch_mint)
+        # bucket indirection cached as a lane for vectorized routing
+        self._bucket_arr = (np.asarray(self.info.bucket_map, dtype=np.int32)
+                            if self.info.bucket_map is not None else None)
 
     def route_rows(self, key_arrays: List[np.ndarray]) -> np.ndarray:
         info = self.info
@@ -321,6 +350,9 @@ class PartitionRouter:
             for k in key_arrays[1:]:
                 with np.errstate(over="ignore"):
                     h = (h * 31 + k.astype(np.int64))
+            if self._bucket_arr is not None:
+                return self._bucket_arr[
+                    hash_partition_of(h, self._bucket_arr.shape[0])]
             return hash_partition_of(h, info.count)
         if info.method in ("range", "range_columns"):
             bounds = [b[1][0] for b in info.boundaries]

@@ -106,6 +106,8 @@ class MetaDb:
             "partition": {
                 "method": tm.partition.method, "columns": tm.partition.columns,
                 "count": tm.partition.count, "boundaries": tm.partition.boundaries,
+                "bucket_map": tm.partition.bucket_map,
+                "placement": tm.partition.placement,
             },
             "indexes": [{
                 "name": i.name, "columns": i.columns, "unique": i.unique,
@@ -155,7 +157,9 @@ class MetaDb:
             part = PartitionInfo(meta["partition"]["method"],
                                  meta["partition"]["columns"],
                                  meta["partition"]["count"],
-                                 [tuple(b) for b in meta["partition"]["boundaries"]])
+                                 [tuple(b) for b in meta["partition"]["boundaries"]],
+                                 meta["partition"].get("bucket_map"),
+                                 meta["partition"].get("placement") or [])
             idx = [IndexMeta(i["name"], i["columns"], i["unique"], i["global"],
                              i["covering"], status=i.get("status", "PUBLIC"))
                    for i in meta.get("indexes", [])]

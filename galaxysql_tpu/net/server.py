@@ -137,6 +137,27 @@ class Connection:
             except Exception:  # galaxylint: disable=swallow -- client already vanished; socket close is best-effort
                 pass
 
+    async def _upgrade_tls(self):
+        """Switch the accepted plaintext stream to TLS in place (SSLRequest).
+
+        `StreamWriter.start_tls` only exists on py>=3.11; on 3.10 this replays
+        its implementation over `loop.start_tls`: wrap the raw transport in an
+        SSL transport and repoint the writer + stream protocol at it (the
+        reader keeps the raw transport — it is only used for flow control,
+        exactly what CPython's 3.11 `_replace_writer` does)."""
+        ctx = self.server.ssl_context
+        if hasattr(self.writer, "start_tls"):
+            await self.writer.start_tls(ctx)
+            return
+        loop = asyncio.get_running_loop()
+        protocol = self.writer.transport.get_protocol()
+        await self.writer.drain()
+        new_tr = await loop.start_tls(self.writer.transport, protocol, ctx,
+                                      server_side=True)
+        self.writer._transport = new_tr
+        protocol._transport = new_tr
+        protocol._over_ssl = True
+
     async def _run_inner(self):
         # salt bytes must avoid NUL: clients read the second half null-terminated
         seed = bytes(secrets.choice(range(1, 256)) for _ in range(20))
@@ -155,7 +176,7 @@ class Connection:
                                        "SSL is not enabled on this server"))
                 await self.flush()
                 return
-            await self.writer.start_tls(self.server.ssl_context)
+            await self._upgrade_tls()
             payload = await self.read_packet()
         creds = P.parse_handshake_response(payload)
         if not self.server.authenticate(creds["user"], creds["auth"], seed):

@@ -103,6 +103,13 @@ _DEFS: Dict[str, List] = {
     # typed instance-event journal (utils/events.py; SHOW EVENTS twin)
     "events": [("seq", _I), ("at", _D), ("kind", _V), ("severity", _V),
                ("node", _V), ("detail", _V), ("attrs", _V)],
+    # elastic-rebalance jobs (ddl/rebalance.py; SHOW REBALANCE twin):
+    # live job phase/progress + bounded finished-job history
+    "rebalance_jobs": [
+        ("job_id", _I), ("table_name", _V), ("kind", _V), ("state", _V),
+        ("phase", _V), ("src_partitions", _V), ("targets", _I),
+        ("rows_copied", _I), ("events_applied", _I), ("catchup_lag_ms", _D),
+        ("last_checkpoint", _V), ("router_epoch", _I)],
     # SPM plan baselines incl. the self-heal quarantine machine
     # (plan/spm.py; SHOW BASELINE twin)
     "plan_baselines": [
@@ -246,3 +253,5 @@ def refresh(instance, session=None):
                      e.detail, _json.dumps(e.attrs, default=str)[:512]]
                     for e in EVENTS.entries()))
     fill("plan_baselines", (list(r) for r in instance.planner.spm.rows()))
+    from galaxysql_tpu.ddl.rebalance import progress_rows
+    fill("rebalance_jobs", (list(r) for r in progress_rows(instance)))

@@ -153,6 +153,12 @@ class Instance:
         self.admission = AdmissionController(self)
         from galaxysql_tpu.server.maintain import RecycleBin
         self.recycle = RecycleBin(self)
+        # elastic rebalancing (ddl/rebalance.py + server/balancer.py): the
+        # in-memory half of live jobs' shadow partitions, and the heat-driven
+        # proposal/execution policy the maintain loop ticks
+        self.rebalance_shadows: Dict[str, object] = {}
+        from galaxysql_tpu.server.balancer import Balancer
+        self.balancer = Balancer(self)
         # named for the lockdep witness (unranked class "instance"); a plain
         # RLock when lockdep is disarmed — the default
         from galaxysql_tpu.utils.lockdep import named_lock

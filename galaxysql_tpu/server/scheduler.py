@@ -52,6 +52,22 @@ def _run_analyze(instance, schema: str, table: str, params: dict) -> str:
     return "statistics refreshed"
 
 
+@job_kind("rebalance")
+def _run_rebalance(instance, schema: str, table: str, params: dict) -> str:
+    """Maintain-loop tick of the heat-driven balancer (server/balancer.py):
+    propose partition split/merge/move from observed heat and execute at most
+    one per tick.  Yields (proposes nothing) under admission pressure."""
+    props = instance.balancer.run_once(schema or None, table or None,
+                                       apply=bool(params.get("apply", True)))
+    if not props:
+        return "balanced (no proposals)"
+    first = props[0]
+    applied = f" job={first.get('job_id')}" if first.get("applied") else \
+        f" NOT applied ({first.get('error', 'apply=0')})"
+    return (f"{len(props)} proposal(s); first: {first['op']} "
+            f"{first['table']} p{first['pids']}{applied}")
+
+
 @job_kind("purge_tx_log")
 def _run_purge_tx_log(instance, schema: str, table: str, params: dict) -> str:
     keep_s = float(params.get("keep_seconds", 86400))

@@ -356,6 +356,7 @@ class Session:
         ast.CreateDatabase: "CREATE", ast.DropDatabase: "DROP",
         ast.CheckTable: "SELECT", ast.FlashbackTable: "CREATE",
         ast.PurgeRecycleBin: "DROP", ast.AdviseIndex: "SELECT",
+        ast.Rebalance: "ALTER",
     }
 
     @staticmethod
@@ -505,6 +506,8 @@ class Session:
             return self._sync_privileges()
         if isinstance(stmt, ast.AlterTable):
             return self._run_alter(stmt, sql)
+        if isinstance(stmt, ast.Rebalance):
+            return self._run_rebalance(stmt)
         if isinstance(stmt, (ast.CreateIndex, ast.DropIndex)):
             return self._run_index_ddl(stmt, sql)
         raise errors.NotSupportedError(f"statement {type(stmt).__name__}")
@@ -607,6 +610,13 @@ class Session:
                 raise errors.NotSupportedError(
                     "PARTITION BY cannot be combined with other ALTER actions")
             return self._run_repartition(stmt, sql, schema)
+        if any(a[0] in ("split_partition", "merge_partitions",
+                        "move_partition") for a in stmt.actions):
+            if len(stmt.actions) != 1:
+                raise errors.NotSupportedError(
+                    "SPLIT/MERGE/MOVE PARTITION cannot be combined with "
+                    "other ALTER actions")
+            return self._run_partition_rebalance(stmt, sql, schema)
         job = alter_table_job(schema, sql, stmt.table.table, stmt.actions)
         self.instance.ddl_engine.submit_and_run(job)
         return ok()
@@ -631,6 +641,44 @@ class Session:
         job = repartition_job(schema, sql, stmt.table.table, method, cols, count)
         self.instance.ddl_engine.submit_and_run(job)
         return ok()
+
+    def _run_partition_rebalance(self, stmt: ast.AlterTable, sql: str,
+                                 schema: str) -> ResultSet:
+        """Online elastic rebalancing at partition scope: shadow backfill +
+        CDC catchup + FastChecker verify + TSO-fenced cutover under the
+        exclusive MDL (ddl/rebalance.py; Balancer.java data-movement analog)."""
+        from galaxysql_tpu.ddl import rebalance as rb
+        action = stmt.actions[0]
+        table = stmt.table.table
+        if action[0] == "split_partition":
+            job = rb.split_partition_job(schema, sql, table, action[1],
+                                         into=action[3], at=action[2])
+        elif action[0] == "merge_partitions":
+            job = rb.merge_partitions_job(schema, sql, table, action[1],
+                                          action[2])
+        else:
+            job = rb.move_partition_job(schema, sql, table, action[1],
+                                        action[2])
+        self.instance.ddl_engine.submit_and_run(job)
+        return ok()
+
+    def _run_rebalance(self, stmt: ast.Rebalance) -> ResultSet:
+        """REBALANCE TABLE/DATABASE: one synchronous balancer pass; rows are
+        the proposals (and, unless DRY RUN, what happened to the first)."""
+        schema = stmt.schema or (None if stmt.table is None
+                                 else self._require_schema())
+        props = self.instance.balancer.run_once(
+            schema, stmt.table, apply=not stmt.dry_run)
+        rows = [(p["table"], p["op"], ",".join(str(i) for i in p["pids"]),
+                 p.get("group", ""), p["why"],
+                 "applied" if p.get("applied") else
+                 p.get("error", "proposed"), p.get("job_id") or 0)
+                for p in props]
+        from galaxysql_tpu.types import datatype as dt
+        return ResultSet(
+            ["TABLE_NAME", "OP", "PARTITIONS", "TARGET_GROUP", "REASON",
+             "STATUS", "JOB_ID"],
+            [dt.VARCHAR] * 6 + [dt.BIGINT], rows)
 
     def _run_index_ddl(self, stmt, sql: str) -> ResultSet:
         from galaxysql_tpu.ddl.jobs import create_index_job, drop_index_job

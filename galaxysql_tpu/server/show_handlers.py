@@ -237,6 +237,17 @@ def handle(session, stmt: ast.Show):
             ["Seq", "At", "Kind", "Severity", "Node", "Detail", "Attrs"],
             [dt.BIGINT, dt.DOUBLE, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR,
              dt.VARCHAR, dt.VARCHAR], rows)
+    if kind == "rebalance":
+        # SHOW REBALANCE: live elastic-rebalance jobs (phase, rows copied,
+        # catchup lag, last checkpoint) + bounded finished-job history
+        from galaxysql_tpu.ddl.rebalance import progress_rows
+        return ResultSet(
+            ["JOB_ID", "TABLE_NAME", "KIND", "STATE", "PHASE", "SRC_PARTITIONS",
+             "TARGETS", "ROWS_COPIED", "EVENTS_APPLIED", "CATCHUP_LAG_MS",
+             "LAST_CHECKPOINT", "ROUTER_EPOCH"],
+            [dt.BIGINT, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR,
+             dt.VARCHAR, dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.DOUBLE,
+             dt.VARCHAR, dt.BIGINT], progress_rows(inst))
     if kind == "workers":
         # SHOW WORKERS: attached worker endpoints with fence + circuit-breaker
         # state and lifetime retry/failure counters (the fault-tolerance

@@ -355,7 +355,19 @@ class AlterTable(Statement):
     # actions: ("add_column", ColumnDef, after|None) | ("drop_column", name)
     #        | ("add_index", IndexDef) | ("drop_index", name) | ("rename", new_name)
     #        | ("modify_column", ColumnDef)
+    #        | ("split_partition", pid, at_literal|None, into)
+    #        | ("merge_partitions", pid_a, pid_b)
+    #        | ("move_partition", pid, group)
     actions: List[Tuple] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Rebalance(Statement):
+    """REBALANCE TABLE t | REBALANCE DATABASE [s]: run the heat-driven
+    balancer synchronously and return its decisions (server/balancer.py)."""
+    schema: Optional[str] = None
+    table: Optional[str] = None
+    dry_run: bool = False
 
 
 @dataclasses.dataclass

@@ -202,6 +202,21 @@ class Parser:
             return self._grant(revoke=False)
         if t.is_kw("REVOKE"):
             return self._grant(revoke=True)
+        if t.is_kw("REBALANCE"):
+            # REBALANCE TABLE t | REBALANCE DATABASE [s]  [DRY RUN]
+            self.next()
+            if self.accept_kw("DATABASE"):
+                sch = self.next().text if self.peek().kind == T.IDENT and \
+                    not self.at_kw("DRY") else None
+                stmt = ast.Rebalance(schema=sch)
+            else:
+                self.expect_kw("TABLE")
+                tn = self._table_name()
+                stmt = ast.Rebalance(schema=tn.schema, table=tn.table)
+            if self.accept_kw("DRY"):
+                self.expect_kw("RUN")
+                stmt.dry_run = True
+            return stmt
         if t.is_kw("KILL"):
             self.next()
             query_only = self.accept_kw("QUERY")
@@ -1322,6 +1337,39 @@ class Parser:
             elif self.accept_kw("RENAME"):
                 self.accept_kw("TO")
                 stmt.actions.append(("rename", self._table_name().table))
+            elif self.accept_kw("SPLIT"):
+                # online elastic split: ALTER TABLE t SPLIT PARTITION p1
+                #   [AT (literal)] [INTO n]       (ddl/rebalance.py)
+                self.expect_kw("PARTITION")
+                pid = self._partition_ref()
+                at = None
+                into = 2
+                if self.accept_kw("AT"):
+                    self.expect_op("(")
+                    at = self._partition_literal()
+                    self.expect_op(")")
+                if self.accept_kw("INTO"):
+                    nt = self.next()
+                    if nt.kind != T.NUMBER:
+                        raise self.error("expected partition count after INTO")
+                    into = int(nt.text)
+                stmt.actions.append(("split_partition", pid, at, into))
+            elif self.accept_kw("MERGE"):
+                # ALTER TABLE t MERGE PARTITIONS p0, p1
+                self.expect_kw("PARTITIONS")
+                a = self._partition_ref()
+                self.expect_op(",")
+                b = self._partition_ref()
+                stmt.actions.append(("merge_partitions", a, b))
+            elif self.accept_kw("MOVE"):
+                # ALTER TABLE t MOVE PARTITION p0 TO 'group'
+                self.expect_kw("PARTITION")
+                pid = self._partition_ref()
+                self.expect_kw("TO")
+                gt = self.next()
+                if gt.kind not in (T.IDENT, T.STRING):
+                    raise self.error("expected placement group after TO")
+                stmt.actions.append(("move_partition", pid, gt.text))
             elif self.at_kw("PARTITION", "DBPARTITION"):
                 # online repartition: ALTER TABLE t PARTITION BY HASH(c) PARTITIONS n
                 stmt.actions.append(("repartition", self._partition_def()))
@@ -1330,6 +1378,27 @@ class Parser:
             if not self.accept_op(","):
                 break
         return stmt
+
+    def _partition_ref(self) -> int:
+        """A partition id: `p3` (the information_schema naming) or bare `3`."""
+        t = self.next()
+        if t.kind == T.NUMBER:
+            return int(t.text)
+        txt = t.text.lower()
+        if t.kind == T.IDENT and txt.startswith("p") and txt[1:].isdigit():
+            return int(txt[1:])
+        raise self.error("expected a partition (pN or N)")
+
+    def _partition_literal(self):
+        """The AT (...) split point: a number or string literal."""
+        neg = self.accept_op("-")
+        t = self.next()
+        if t.kind == T.NUMBER:
+            v = float(t.text) if "." in t.text else int(t.text)
+            return -v if neg else v
+        if t.kind == T.STRING and not neg:
+            return t.text
+        raise self.error("expected a literal split point")
 
     def _create_ccl_rule(self) -> ast.CreateCclRule:
         """CREATE CCL_RULE [IF NOT EXISTS] name WITH opt = val [, ...] —

@@ -59,6 +59,24 @@ FP_WORKER_SLOW_DRAIN = "FP_WORKER_SLOW_DRAIN"
 # governor's computed tier.  Arm value: "elevated" | "critical" | a float
 # usage fraction (e.g. 0.95) fed through the normal thresholds.
 FP_MEM_PRESSURE = "FP_MEM_PRESSURE"
+# -- elastic rebalancing (ddl/rebalance.py) ----------------------------------
+# crash inside the shadow backfill's chunk loop, AFTER the [src, offset]
+# checkpoint persisted (crash-resume granularity proof)
+FP_REBALANCE_CHUNK = "FP_REBALANCE_CHUNK"
+# crash inside the CDC catchup loop, between event pages (the persisted seq
+# watermark makes the re-applied page idempotent)
+FP_REBALANCE_CATCHUP = "FP_REBALANCE_CATCHUP"
+# force the verify gate to see a checksum mismatch: drives the engine's
+# REAL TddlError -> reverse-order-undo path (rollback restores the source)
+FP_REBALANCE_VERIFY_MISMATCH = "FP_REBALANCE_VERIFY_MISMATCH"
+# crash inside the cutover critical section BEFORE the partition/router swap
+# (resume must redo the final catchup + swap)
+FP_REBALANCE_BEFORE_SWAP = "FP_REBALANCE_BEFORE_SWAP"
+# crash AFTER the swap + durable cutover marker but before cache
+# invalidation/cleanup (resume must detect the swap already happened and
+# NOT re-run it)
+FP_REBALANCE_AFTER_SWAP = "FP_REBALANCE_AFTER_SWAP"
+
 # lockdep witness proof (tests/test_lint.py): the DML insert ramp performs a
 # DELIBERATE partition-lock -> append_lock acquisition (the reverse of the
 # canonical order) so the runtime lock-order witness provably trips on a

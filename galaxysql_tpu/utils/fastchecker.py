@@ -30,18 +30,35 @@ def _mix(h: np.ndarray) -> np.ndarray:
 def table_checksum(store, columns: List[str], snapshot_ts: Optional[int] = None
                    ) -> Tuple[int, int]:
     """(row_count, order-insensitive checksum) over visible rows of `columns`."""
+    return partitions_checksum(store.partitions, columns, snapshot_ts)
+
+
+def partitions_checksum(partitions, columns: List[str],
+                        snapshot_ts: Optional[int] = None) -> Tuple[int, int]:
+    """table_checksum over an explicit partition list: the rebalance verify
+    gate compares one table's SOURCE partitions against the job's shadow
+    partitions (which live outside any store until cutover)."""
     total = np.uint64(0)
     count = 0
     with np.errstate(over="ignore"):
-        for p in store.partitions:
-            vis = p.visible_mask(snapshot_ts)
+        for p in partitions:
+            # a consistent cut per partition: a concurrent append rebinds the
+            # lane arrays, so visibility and lanes read OUTSIDE the lock can
+            # disagree on length (torn read -> bogus mismatch/IndexError).
+            # Appends never mutate the [0, n) prefix, so slicing to one
+            # locked row count is exact.
+            with p.lock:
+                n_rows = p.num_rows
+                vis = p.visible_mask(snapshot_ts)[:n_rows]
+                raws = {c: p.lanes[c][:n_rows][vis] for c in columns}
+                valids = {c: p.valid[c][:n_rows][vis] for c in columns}
             n = int(vis.sum())
             if not n:
                 continue
             count += n
             h = np.zeros(n, dtype=np.uint64)
             for c in columns:
-                raw = p.lanes[c][vis]
+                raw = raws[c]
                 if raw.dtype.kind == "f":
                     # hash the BIT PATTERN: astype would truncate fractions and
                     # miss sub-integer corruption
@@ -49,8 +66,8 @@ def table_checksum(store, columns: List[str], snapshot_ts: Optional[int] = None
                                     else np.uint64).astype(np.uint64)
                 else:
                     lane = raw.astype(np.int64).astype(np.uint64)
-                valid = p.valid[c][vis]
-                lane = np.where(valid, _mix(lane), np.uint64(0xdeadbeefcafebabe))
+                lane = np.where(valids[c], _mix(lane),
+                                np.uint64(0xdeadbeefcafebabe))
                 h = _mix(h * np.uint64(31) + lane)
             total = (total + h.sum(dtype=np.uint64)) & _MASK
     return count, int(total)

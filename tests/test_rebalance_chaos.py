@@ -1,0 +1,240 @@
+"""Chaos-hardened elastic rebalancing: crashes at every state transition.
+
+The fault matrix drives SPLIT / MERGE / MOVE jobs into a crash at each
+failpoint (per-task boundaries via FP_BEFORE_DDL_TASK plus the in-task
+checkpoints: mid-backfill chunk, mid-catchup page, inside the cutover
+critical section before and after the swap) while DML races the move and
+readers watch — asserting, for every schedule:
+
+- queries observe bit-identical-or-typed-error results (never a torn map),
+- zero lost and zero duplicated writes among acknowledged DML,
+- crash-resume completes the job from its last checkpoint — or, for the
+  verify-mismatch schedule, reverse-order undo restores the source exactly
+  (FastChecker-proven) and the table keeps serving.
+
+`make chaos-rebalance` runs this file with GALAXYSQL_LOCKDEP=1.
+"""
+
+import threading
+
+import pytest
+
+from galaxysql_tpu.ddl import rebalance as rb
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.utils import errors
+from galaxysql_tpu.utils.failpoint import (FAIL_POINTS, FP_BEFORE_DDL_TASK,
+                                           FP_REBALANCE_AFTER_SWAP,
+                                           FP_REBALANCE_BEFORE_SWAP,
+                                           FP_REBALANCE_CATCHUP,
+                                           FP_REBALANCE_CHUNK,
+                                           FP_REBALANCE_VERIFY_MISMATCH,
+                                           FailPointError)
+from galaxysql_tpu.utils.fastchecker import partitions_checksum
+
+pytestmark = pytest.mark.rebalance_chaos
+
+N_SEED = 3000
+
+# (failpoint key, arm value) — one crash site per schedule.  The
+# FP_BEFORE_DDL_TASK arms fire on the N-th task boundary, covering the
+# transitions the in-task failpoints don't.
+SCHEDULES = [
+    (FP_BEFORE_DDL_TASK, 3),        # before backfill starts
+    (FP_REBALANCE_CHUNK, 3),        # mid-copy, after a persisted checkpoint
+    (FP_BEFORE_DDL_TASK, 4),        # before catchup
+    (FP_REBALANCE_CATCHUP, 1),      # mid-catchup, after a persisted page
+    (FP_BEFORE_DDL_TASK, 5),        # before verify
+    (FP_BEFORE_DDL_TASK, 6),        # before cutover
+    (FP_REBALANCE_BEFORE_SWAP, 1),  # inside cutover, swap not yet applied
+    (FP_REBALANCE_AFTER_SWAP, 1),   # swap durable, cleanup not yet run
+    (FP_BEFORE_DDL_TASK, 7),        # before cleanup
+]
+
+OPS = [
+    ("ALTER TABLE t SPLIT PARTITION p1 INTO 2", 5),
+    ("ALTER TABLE t MERGE PARTITIONS p0, p2", 3),
+    ("ALTER TABLE t MOVE PARTITION p0 TO 'g1'", 4),
+]
+
+
+@pytest.fixture()
+def harness():
+    inst = Instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE cz")
+    s.execute("USE cz")
+    s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, grp BIGINT, "
+              "val VARCHAR(16)) PARTITION BY HASH(id) PARTITIONS 4")
+    store = inst.store("cz", "t")
+    store.insert_pylists(
+        {"id": list(range(N_SEED)), "grp": [i % 37 for i in range(N_SEED)],
+         "val": [f"v{i % 11}" for i in range(N_SEED)]},
+        inst.tso.next_timestamp())
+    old_chunk = rb.RebalanceBackfillTask.CHUNK
+    rb.RebalanceBackfillTask.CHUNK = 256
+    yield inst, s, store
+    rb.RebalanceBackfillTask.CHUNK = old_chunk
+    FAIL_POINTS.clear()
+    s.close()
+
+
+class _Traffic:
+    """Concurrent writers (acked-op ledger) + readers (typed-or-correct)."""
+
+    def __init__(self, inst, n_writers=2):
+        self.inst = inst
+        self.stop = threading.Event()
+        self.acked_ins = []
+        self.acked_del = []
+        self.reader_violations = []
+        self.threads = [
+            threading.Thread(target=self._writer, args=(1_000_000 * (k + 1),))
+            for k in range(n_writers)
+        ] + [threading.Thread(target=self._reader)]
+
+    def _writer(self, base):
+        s = Session(self.inst, "cz")
+        try:
+            i = 0
+            while not self.stop.is_set() and i < 500:
+                wid = base + i
+                try:
+                    s.execute(f"INSERT INTO t VALUES ({wid}, {wid % 37}, 'w')")
+                    self.acked_ins.append(wid)
+                    if i % 5 == 2:
+                        s.execute(f"DELETE FROM t WHERE id = {wid}")
+                        self.acked_del.append(wid)
+                except errors.TddlError:
+                    pass  # typed refusal (MDL wait etc.) is in-contract
+                i += 1
+        finally:
+            s.close()
+
+    def _reader(self):
+        s = Session(self.inst, "cz")
+        try:
+            while not self.stop.is_set():
+                try:
+                    rows = s.execute(
+                        "SELECT count(*) FROM t WHERE id < 1000000").rows
+                    if rows != [(N_SEED,)]:
+                        self.reader_violations.append(rows)
+                    s.execute("SELECT grp, val FROM t WHERE id = 17")
+                except errors.TddlError:
+                    pass  # typed error is the contract under faults
+                except Exception as e:  # noqa: BLE001 - the assertion target
+                    self.reader_violations.append(repr(e))
+        finally:
+            s.close()
+
+    def __enter__(self):
+        for t in self.threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop.set()
+        for t in self.threads:
+            t.join()
+
+
+def _assert_final_state(inst, s, store):
+    """Zero lost/duplicated writes + structural integrity after the storm."""
+    rows = s.execute("SELECT id FROM t ORDER BY id").rows
+    ids = [r[0] for r in rows]
+    assert len(ids) == len(set(ids)), "duplicated rows after rebalance"
+    assert [i for i in ids if i < 1_000_000] == list(range(N_SEED))
+    # routing invariant: every row is where the live router puts it
+    tm = inst.catalog.table("cz", "t")
+    cols = [tm.column(c).name for c in tm.partition.columns]
+    for pid, p in enumerate(store.partitions):
+        if p.num_rows:
+            got = store.router.route_rows(
+                [p.lanes[c][:p.num_rows] for c in cols])
+            assert (got == pid).all()
+    check = s.execute("CHECK TABLE t").rows
+    assert check[-1][-1] == "OK", check
+
+
+@pytest.mark.parametrize("fp_key,arm", SCHEDULES,
+                         ids=[f"{k}@{v}" for k, v in SCHEDULES])
+def test_crash_schedule_resumes_exactly_once(harness, fp_key, arm):
+    inst, s, store = harness
+    acked = None
+    with _Traffic(inst) as traffic:
+        FAIL_POINTS.arm(fp_key, arm)
+        with pytest.raises(FailPointError):
+            s.execute("ALTER TABLE t SPLIT PARTITION p1 INTO 2")
+        FAIL_POINTS.clear()
+        # serving continues while the job is parked RUNNING
+        assert s.execute("SELECT count(*) FROM t WHERE id < 1000000"
+                         ).rows == [(N_SEED,)]
+        resumed = inst.ddl_engine.recover()
+        assert resumed, "crashed job did not resume"
+    acked = (set(traffic.acked_ins), set(traffic.acked_del))
+    assert traffic.reader_violations == []
+    tm = inst.catalog.table("cz", "t")
+    assert tm.partition.num_partitions == 5
+    got = {r[0] for r in s.execute(
+        "SELECT id FROM t WHERE id >= 1000000").rows}
+    assert got == acked[0] - acked[1], "lost or duplicated racing writes"
+    _assert_final_state(inst, s, store)
+
+
+@pytest.mark.parametrize("sql,expect_parts", OPS,
+                         ids=["split", "merge", "move"])
+def test_each_op_under_traffic_no_faults(harness, sql, expect_parts):
+    inst, s, store = harness
+    with _Traffic(inst) as traffic:
+        s.execute(sql)
+    assert traffic.reader_violations == []
+    tm = inst.catalog.table("cz", "t")
+    assert tm.partition.num_partitions == expect_parts
+    got = {r[0] for r in s.execute(
+        "SELECT id FROM t WHERE id >= 1000000").rows}
+    assert got == set(traffic.acked_ins) - set(traffic.acked_del)
+    _assert_final_state(inst, s, store)
+
+
+def test_verify_mismatch_under_traffic_rolls_back_clean(harness):
+    inst, s, store = harness
+    tm = inst.catalog.table("cz", "t")
+    with _Traffic(inst) as traffic:
+        FAIL_POINTS.arm(FP_REBALANCE_VERIFY_MISMATCH, True)
+        with pytest.raises(errors.TddlError, match="verify failed"):
+            s.execute("ALTER TABLE t SPLIT PARTITION p1 INTO 2")
+        FAIL_POINTS.clear()
+    assert traffic.reader_violations == []
+    # undo restored the source exactly: still the old map, no shadow state,
+    # every acked write present, and FastChecker agrees with a fresh scan
+    assert tm.partition.num_partitions == 4
+    assert not inst.rebalance_shadows
+    got = {r[0] for r in s.execute(
+        "SELECT id FROM t WHERE id >= 1000000").rows}
+    assert got == set(traffic.acked_ins) - set(traffic.acked_del)
+    ts = inst.tso.next_timestamp()
+    n, _ = partitions_checksum(store.partitions, tm.column_names(), ts)
+    assert n == N_SEED + len(got)
+    _assert_final_state(inst, s, store)
+
+
+def test_double_crash_same_job(harness):
+    """Two consecutive crashes (backfill, then cutover) on one job: each
+    resume continues from the newest checkpoint."""
+    inst, s, store = harness
+    FAIL_POINTS.arm(FP_REBALANCE_CHUNK, 2)
+    with pytest.raises(FailPointError):
+        s.execute("ALTER TABLE t MOVE PARTITION p0 TO 'g1'")
+    FAIL_POINTS.clear()
+    s.execute("INSERT INTO t VALUES (7777777, 1, 'between')")
+    FAIL_POINTS.arm(FP_REBALANCE_BEFORE_SWAP, 1)
+    with pytest.raises(FailPointError):
+        inst.ddl_engine.recover()
+    FAIL_POINTS.clear()
+    assert inst.ddl_engine.recover()
+    tm = inst.catalog.table("cz", "t")
+    assert tm.partition.group_of(0) == "g1"
+    assert s.execute("SELECT count(*) FROM t").rows == [(N_SEED + 1,)]
+    assert s.execute("SELECT grp FROM t WHERE id = 7777777").rows == [(1,)]
+    _assert_final_state(inst, s, store)
