@@ -156,6 +156,21 @@ chaos-rebalance:
 bench-rebalance:
 	JAX_PLATFORMS=cpu $(PY) bench.py --rebalance-only
 
+# kernel smoke: the kernel-tier matrix — Pallas join/agg vs reference
+# bit-identity (NULL keys, empty build, duplicate keys, overflow-ladder
+# doubling, both hybrid orientations, TPC-H Q5/Q9 on-vs-off), the
+# escape-hatch trio proven structurally off-path, and the persistent AOT
+# compile cache (restart round trip with zero steady retraces, corrupted
+# entries recompiling, metrics/EXPLAIN surfaces)
+kernel-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m kernel -p no:cacheprovider
+
+# kernel bench: Pallas-vs-reference join/agg rows/s (interpret mode on CPU —
+# the honest number until a TPU answers) + the AOT compile-cache cold-vs-warm
+# restart compile_ms comparison (BENCH json on stdout)
+bench-kernels:
+	JAX_PLATFORMS=cpu $(PY) bench.py --kernels-only
+
 # self-heal smoke: the quarantine state machine end-to-end — a genuine
 # stats-driven join-order regression auto-rolls-back, verifies over
 # PLAN_HEAL_VERIFY_EXECS executions, and promotes (bit-identical results,
@@ -169,4 +184,5 @@ heal-smoke:
 .PHONY: tier1 fusion-smoke obs-smoke rf-smoke cache-smoke trace-smoke bench \
 	batch-smoke chaos-smoke skew-smoke bench-skew summary-smoke heal-smoke \
 	overload-smoke bench-overload dml-smoke bench-dml lint lint-smoke \
-	rebalance-smoke chaos-rebalance bench-rebalance
+	rebalance-smoke chaos-rebalance bench-rebalance kernel-smoke \
+	bench-kernels

@@ -1268,6 +1268,134 @@ def batch_only_main():
         print(json.dumps(out))
 
 
+def kernels_bench(platform: str):
+    """Kernel tier: Pallas join/agg formulations vs the reference ones
+    (direct steady-state kernel calls), plus the persistent AOT compile
+    cache measured as a cold-vs-warm restart of the same query.  On CPU the
+    Pallas kernels run in INTERPRET mode (the TPU compiled path has no chip
+    to answer here) — reported as pallas_mode so the number is honest."""
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from galaxysql_tpu.exec import operators as ops
+    from galaxysql_tpu.kernels import relational as R
+
+    runs = max(int(os.environ.get("BENCH_RUNS", "3")), 3)
+    pallas_mode = "compiled" if jax.default_backend() == "tpu" \
+        else "interpret"
+
+    def best_of(fn):
+        fn()  # compile
+        best = None
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            el = time.perf_counter() - t0
+            best = el if best is None or el < best else best
+        return best
+
+    # -- grouped aggregation ------------------------------------------------
+    n = 1 << 17
+    rng = np.random.default_rng(42)
+    g = jnp.asarray(rng.integers(0, 1024, n).astype(np.int64))
+    v = jnp.asarray(rng.integers(0, 1000, n).astype(np.int64))
+    live = jnp.ones(n, bool)
+    specs = [R.AggSpec("sum", 0), R.AggSpec("count_star", -1)]
+
+    def gb(mode):
+        def run():
+            with R.kernel_scope(mode):
+                return R.hash_groupby([(g, None)], [(v, None)], specs, live,
+                                      2048)
+        return run
+
+    agg = {label: n / best_of(gb(mode))
+           for mode, label in (("off", "reference"), ("pallas", "pallas"))}
+    yield {"metric": "kernel_groupby_rows_per_sec_per_chip",
+           "value": round(agg["pallas"], 1), "unit": "rows/s",
+           "vs_baseline": round(agg["pallas"] / agg["reference"], 3),
+           "reference_rows_per_sec": round(agg["reference"], 1),
+           "pallas_mode": pallas_mode, "rows": n, "platform": platform}
+
+    # -- hash join ----------------------------------------------------------
+    nb, npr = 1 << 15, 1 << 17
+    bk = jnp.asarray(rng.integers(0, 1 << 14, nb).astype(np.int64))
+    pk = jnp.asarray(rng.integers(0, 1 << 14, npr).astype(np.int64))
+    b_live = jnp.ones(nb, bool)
+    p_live = jnp.ones(npr, bool)
+    cap = 1 << 19
+
+    def jn(mode):
+        def run():
+            with R.kernel_scope(mode):
+                return R.hash_join_pairs([(bk, None)], [(pk, None)], b_live,
+                                         p_live, cap)
+        return run
+
+    join = {label: npr / best_of(jn(mode))
+            for mode, label in (("off", "reference"), ("pallas", "pallas"))}
+    yield {"metric": "kernel_join_probe_rows_per_sec_per_chip",
+           "value": round(join["pallas"], 1), "unit": "rows/s",
+           "vs_baseline": round(join["pallas"] / join["reference"], 3),
+           "reference_rows_per_sec": round(join["reference"], 1),
+           "pallas_mode": pallas_mode, "build_rows": nb, "probe_rows": npr,
+           "platform": platform}
+
+    # -- persistent AOT compile cache: cold vs warm restart -----------------
+    def fresh_process():
+        with ops._JIT_CACHE_LOCK:
+            ops._JIT_CACHE.clear()
+        jax.clear_caches()
+        ops.reset_compile_stats()
+
+    d = os.path.join(tempfile.mkdtemp(prefix="gx_bench_cc_"), "db")
+    q = "SELECT g, SUM(v), COUNT(*) FROM t GROUP BY g ORDER BY g"
+    fresh_process()
+    inst = Instance(data_dir=d)
+    s = Session(inst)
+    s.execute("CREATE DATABASE cc; USE cc")
+    s.execute("CREATE TABLE t (g BIGINT, v BIGINT) "
+              "PARTITION BY HASH(g) PARTITIONS 4")
+    inst.store("cc", "t").insert_arrays(
+        {"g": rng.integers(0, 64, 1 << 16).astype(np.int64),
+         "v": rng.integers(0, 1000, 1 << 16).astype(np.int64)},
+        inst.tso.next_timestamp())
+    ops.reset_compile_stats()
+    s.execute(q)
+    cold_ms = ops.COMPILE_STATS["compile_ms"]
+    cold_retraces = ops.COMPILE_STATS["retraces"]
+    s.execute(q)  # steady: everything the next process should replay
+    inst.save()
+    s.close()
+
+    fresh_process()
+    inst2 = Instance(data_dir=d)
+    s2 = Session(inst2)
+    s2.execute("USE cc")
+    s2.execute(q)
+    warm_ms = ops.COMPILE_STATS["compile_ms"]
+    hits = ops.COMPILE_STATS["cache_hits"]
+    retr = ops.COMPILE_STATS["retraces"]
+    s2.close()
+    yield {"metric": "compile_cache_restart_compile_ms_speedup",
+           "value": round(cold_ms / max(warm_ms, 1e-9), 1), "unit": "x",
+           "cold_compile_ms": round(cold_ms, 1),
+           "warm_compile_ms": round(warm_ms, 1),
+           "cold_retraces": cold_retraces,
+           "retraces_after_restart": retr,
+           "cache_hits_after_restart": hits,
+           "replay_fraction": round(hits / max(1, hits + retr), 3),
+           "platform": platform}
+
+
+def kernels_only_main():
+    """`bench.py --kernels-only` (make bench-kernels): the kernel-tier
+    microbench + the AOT compile-cache restart comparison (no TPC-H load)."""
+    for out in kernels_bench(jax.devices()[0].platform):
+        print(json.dumps(out))
+
+
 def dml_only_main():
     """`bench.py --dml-only` (make bench-dml): the closed-loop DML + mixed
     read/write serving bench on a fresh instance (no TPC-H load needed —
@@ -1289,5 +1417,7 @@ if __name__ == "__main__":
         overload_only_main()
     elif "--rebalance-only" in sys.argv:
         rebalance_only_main()
+    elif "--kernels-only" in sys.argv:
+        kernels_only_main()
     else:
         main()

@@ -108,6 +108,24 @@ ENABLE_SKEW_EXECUTION = _p(
     "broadcast/shuffle joins and salted aggregation on the MPP mesh; "
     "planted skew plans go inert when off (cached plans stay valid)")
 
+# --- kernel tier / compile cache ----------------------------------------------
+ENABLE_PALLAS_KERNELS = _p(
+    "ENABLE_PALLAS_KERNELS", True,
+    "Pallas join/agg kernel tier (kernels/pallas_join.py, pallas_agg.py): "
+    "auto-selected on TPU above the stats row floor; the reference "
+    "formulations remain the CPU path and correctness oracle.  Per-statement "
+    "override via KERNEL(OFF|PALLAS|ON) hint; GALAXYSQL_PALLAS=0 env kills "
+    "the tier process-wide")
+ENABLE_COMPILE_CACHE = _p(
+    "ENABLE_COMPILE_CACHE", True,
+    "persistent AOT compile cache under data_dir (exec/compile_cache.py): "
+    "Instance.save serializes compiled steady-state programs, a restarted "
+    "coordinator replays them instead of recompiling (corruption-tolerant: "
+    "a bad entry recompiles, never errors)")
+COMPILE_CACHE_BYTES = _p(
+    "COMPILE_CACHE_BYTES", 256 << 20,
+    "on-disk byte budget for the persistent compile cache (LRU by mtime)")
+
 # --- CCL ----------------------------------------------------------------------
 CCL_MAX_CONCURRENCY = _p("CCL_MAX_CONCURRENCY", 0, "0 = unlimited")
 CCL_WAIT_QUEUE_SIZE = _p("CCL_WAIT_QUEUE_SIZE", 64, "")

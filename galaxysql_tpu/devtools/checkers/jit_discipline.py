@@ -1,6 +1,6 @@
-"""jit-raw / jit-device-sync: the `global_jit` zero-retrace discipline.
+"""jit-raw / pallas-raw / jit-device-sync: the `global_jit` discipline.
 
-Every perf PR re-proves the same two invariants with dispatch-count guards;
+Every perf PR re-proves the same invariants with dispatch-count guards;
 these passes mechanize them:
 
 - **jit-raw**: a bare `jax.jit(...)` call OUTSIDE a builder passed to
@@ -10,6 +10,10 @@ these passes mechanize them:
   is legal only inside a function whose name is passed to `global_jit` in
   the same module (the `def build(): ... return jax.jit(run)` idiom) or in a
   lambda written directly into a `global_jit(...)` argument.
+- **pallas-raw**: `pl.pallas_call(...)` constructs a kernel program with the
+  exact same escape hazard — same rule shape: legal only inside a
+  `global_jit` builder, so Pallas kernels are cached per static shape and
+  counted like every other program (kernels/pallas_join.py idiom).
 - **jit-device-sync**: `.item()` / `.block_until_ready()` on the default
   query path forces a host<->device sync per call.  Flagged in the hot-path
   layers (exec/, kernels/, parallel/, chunk/, server/, storage/) unless the
@@ -42,6 +46,12 @@ def _is_jax_jit(call: ast.Call) -> bool:
             and isinstance(f.value, ast.Name) and f.value.id == "jax")
 
 
+def _is_pallas_call(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "pallas_call"
+            and isinstance(f.value, ast.Name) and f.value.id == "pl")
+
+
 def _is_global_jit(call: ast.Call) -> bool:
     f = call.func
     if isinstance(f, ast.Name):
@@ -50,10 +60,10 @@ def _is_global_jit(call: ast.Call) -> bool:
 
 
 class JitDisciplineChecker(Checker):
-    rules = ("jit-raw", "jit-device-sync")
-    description = ("raw jax.jit outside a global_jit builder closure; "
-                   "device-sync primitives on the hot path outside "
-                   "profiling/bench scopes")
+    rules = ("jit-raw", "pallas-raw", "jit-device-sync")
+    description = ("raw jax.jit / pl.pallas_call outside a global_jit "
+                   "builder closure; device-sync primitives on the hot path "
+                   "outside profiling/bench scopes")
 
     def check(self, mod: Module):
         findings = []
@@ -62,7 +72,7 @@ class JitDisciplineChecker(Checker):
             findings.extend(self._check_device_sync(mod))
         return findings
 
-    # -- jit-raw -------------------------------------------------------------
+    # -- jit-raw / pallas-raw ------------------------------------------------
 
     def _check_raw_jit(self, mod: Module):
         builder_names: Set[str] = set()
@@ -80,27 +90,35 @@ class JitDisciplineChecker(Checker):
 
         findings = []
 
+        def in_builder(stack: List[ast.AST]) -> bool:
+            for s in stack:
+                if isinstance(s, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)) and \
+                        s.name in builder_names:
+                    return True
+                if isinstance(s, ast.Lambda) and id(s) in allowed_lambdas:
+                    return True
+            return False
+
         def walk(node: ast.AST, stack: List[ast.AST]):
             for child in ast.iter_child_nodes(node):
-                if isinstance(child, ast.Call) and _is_jax_jit(child):
-                    ok = False
-                    for s in stack:
-                        if isinstance(s, (ast.FunctionDef,
-                                          ast.AsyncFunctionDef)) and \
-                                s.name in builder_names:
-                            ok = True
-                            break
-                        if isinstance(s, ast.Lambda) and \
-                                id(s) in allowed_lambdas:
-                            ok = True
-                            break
-                    if not ok:
-                        findings.append(self.finding(
-                            mod, child.lineno,
-                            "raw jax.jit outside a global_jit builder "
-                            "closure: the program escapes the process-wide "
-                            "LRU, retrace accounting, and compile spans",
-                            rule="jit-raw"))
+                if isinstance(child, ast.Call) and _is_jax_jit(child) \
+                        and not in_builder(stack):
+                    findings.append(self.finding(
+                        mod, child.lineno,
+                        "raw jax.jit outside a global_jit builder "
+                        "closure: the program escapes the process-wide "
+                        "LRU, retrace accounting, and compile spans",
+                        rule="jit-raw"))
+                if isinstance(child, ast.Call) and _is_pallas_call(child) \
+                        and not in_builder(stack):
+                    findings.append(self.finding(
+                        mod, child.lineno,
+                        "raw pl.pallas_call outside a global_jit builder "
+                        "closure: the kernel program escapes the "
+                        "process-wide LRU, retrace accounting, and compile "
+                        "spans",
+                        rule="pallas-raw"))
                 walk(child, stack + [child])
 
         walk(mod.tree, [])

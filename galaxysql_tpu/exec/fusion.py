@@ -263,7 +263,9 @@ class FusedSegment:
             picked = run_stats if stats else run
             return jax.jit(picked) if jit else picked
         key = (backend, "stats" if stats else "prod") + self.key()
-        f = ops.global_jit(key, build, built_flag=self._built_now)
+        # np-backend programs are plain closures — nothing to AOT-serialize,
+        # so keep them out of the persistent compile cache's lookups
+        f = ops.global_jit(key, build, built_flag=self._built_now, persist=jit)
         self._prog_memo[(jit, stats)] = f
         return f
 

@@ -70,7 +70,7 @@ DISPATCH_STATS = {"dispatches": 0}
 # synchronously traces + compiles before dispatching.  Host-side plain adds;
 # bench.py snapshots these per query so compile-cache regressions surface in
 # the perf trajectory, and traced queries get one `compile` span per event.
-COMPILE_STATS = {"retraces": 0, "compile_ms": 0.0}
+COMPILE_STATS = {"retraces": 0, "compile_ms": 0.0, "cache_hits": 0}
 
 
 def reset_dispatch_stats():
@@ -80,9 +80,10 @@ def reset_dispatch_stats():
 def reset_compile_stats():
     COMPILE_STATS["retraces"] = 0
     COMPILE_STATS["compile_ms"] = 0.0
+    COMPILE_STATS["cache_hits"] = 0
 
 
-def _timed_first_call(key, f):
+def _timed_first_call(key, f, persist=True):
     """Wrap a freshly built program so its first invocation — where jax pays
     the synchronous trace+compile — is timed into COMPILE_STATS and, when a
     query is being traced, recorded as a `compile` span attributed to the
@@ -104,6 +105,11 @@ def _timed_first_call(key, f):
             if _JIT_CACHE.get(key) is wrapper:
                 _JIT_CACHE[key] = f
         COMPILE_STATS["compile_ms"] += dt_ms
+        if persist and not k:
+            # record the input signature so Instance.save can AOT-serialize
+            # this program into the persistent compile cache (no-op detached)
+            from galaxysql_tpu.exec import compile_cache as _cc
+            _cc.GLOBAL_COMPILE_CACHE.observe(key, f, a, k)
         from galaxysql_tpu.utils import tracing as _tr
         tc = _tr.current()
         if tc is not None:
@@ -115,7 +121,7 @@ def _timed_first_call(key, f):
     return wrapper
 
 
-def global_jit(key: Tuple, builder, built_flag=None):
+def global_jit(key: Tuple, builder, built_flag=None, persist=True):
     """Process-wide LRU cache of jitted operator kernels.
 
     Operator instances are rebuilt per execution (plans are immutable, contexts are
@@ -128,16 +134,42 @@ def global_jit(key: Tuple, builder, built_flag=None):
     overflow) — a full clear at the limit would thundering-herd every hot query
     into a simultaneous retrace+recompile.  `built_flag`, when given, is called
     iff the builder actually ran (compile-vs-cached observability for tracing).
-    Builder runs also feed COMPILE_STATS + the active trace's compile spans."""
+    Builder runs also feed COMPILE_STATS + the active trace's compile spans.
+
+    On an in-memory miss, the persistent AOT cache (exec/compile_cache.py) is
+    consulted first: a disk hit restores the compiled executable WITHOUT a
+    retrace (counted as COMPILE_STATS['cache_hits']) — how a restarted
+    coordinator skips the compile storm.  `persist=False` opts a program out
+    (host-np closures that cannot serialize and would only churn lookups)."""
     with _JIT_CACHE_LOCK:
         f = _JIT_CACHE.get(key)
         if f is not None:
             _JIT_CACHE.move_to_end(key)
             return f
+    if persist:
+        from galaxysql_tpu.exec import compile_cache as _cc
+        g = _cc.GLOBAL_COMPILE_CACHE
+        if g.attached:
+            f = g.load(key, builder)
+            if f is not None:
+                with _JIT_CACHE_LOCK:
+                    if key not in _JIT_CACHE:
+                        while len(_JIT_CACHE) >= _JIT_CACHE_LIMIT:
+                            _JIT_CACHE.popitem(last=False)
+                        _JIT_CACHE[key] = f
+                    else:
+                        f = _JIT_CACHE[key]
+                    _JIT_CACHE.move_to_end(key)
+                return f
     f = builder()
-    COMPILE_STATS["retraces"] += 1
+    if persist:
+        # persist=False marks host-side np closures: rebuilding one costs
+        # microseconds and compiles nothing, so it is not a retrace — the
+        # counter tracks the XLA trace+compile storms the AOT cache exists
+        # to eliminate.
+        COMPILE_STATS["retraces"] += 1
     if callable(f):
-        f = _timed_first_call(key, f)
+        f = _timed_first_call(key, f, persist=persist)
     if built_flag is not None:
         built_flag()
     with _JIT_CACHE_LOCK:
@@ -529,7 +561,9 @@ class FilterOp(Operator):
             return run
         key = ("filter-np", tkeys if tkeys is not None
                else expr_cache_key(self.predicate))
-        return global_jit(key, build), (lift.values() if lift is not None else ())
+        # host-np closure: nothing to AOT-serialize, skip persistent lookups
+        return global_jit(key, build, persist=False), \
+            (lift.values() if lift is not None else ())
 
     def batches(self) -> Iterator[ColumnBatch]:
         f = lits = fnp = None
@@ -612,7 +646,9 @@ class ProjectOp(Operator):
         else:
             key = ("project-np",
                    tuple((n, expr_cache_key(e)) for n, e in self.exprs))
-        return global_jit(key, build), (lift.values() if lift is not None else ())
+        # host-np closure: nothing to AOT-serialize, skip persistent lookups
+        return global_jit(key, build, persist=False), \
+            (lift.values() if lift is not None else ())
 
     def batches(self) -> Iterator[ColumnBatch]:
         f = lits = fnp = None
@@ -725,7 +761,8 @@ class HashAggOp(Operator):
     def _partial_fn(self, max_groups: int):
         domains = self._matmul_domains()
         prelude = self.prelude
-        key = ("agg_partial", jax.default_backend(), self._cache_key(), max_groups,
+        key = ("agg_partial", jax.default_backend(), K.kernel_selector_key(),
+               self._cache_key(), max_groups,
                tuple(domains) if domains is not None else None,
                prelude.key() if prelude is not None else None)
 
@@ -772,7 +809,8 @@ class HashAggOp(Operator):
                   merge_specs: Tuple[K.AggSpec, ...]):
         # shared across ALL aggregations: behavior depends only on the merge specs and
         # capacity (key/agg lane dtypes are part of jit's own trace signature)
-        key = ("agg_merge", jax.default_backend(), max_groups, n_keys, merge_specs)
+        key = ("agg_merge", jax.default_backend(), K.kernel_selector_key(),
+               max_groups, n_keys, merge_specs)
 
         def build():
             def run(key_lanes, input_lanes, live):
@@ -1119,7 +1157,8 @@ class HashJoinOp(Operator):
 
     def _pairs_fn(self, cap: int):
         prelude = self.probe_prelude
-        key = ("join_pairs", jax.default_backend(), cap,
+        key = ("join_pairs", jax.default_backend(), K.kernel_selector_key(),
+               cap,
                tuple(expr_cache_key(e) for e in self.build_keys),
                tuple(expr_cache_key(e) for e in self.probe_keys),
                prelude.key() if prelude is not None else None)
@@ -1149,7 +1188,8 @@ class HashJoinOp(Operator):
         whole join (the CSR is also reused across probe batches/retries)."""
         nb = build_batch.capacity
         M = 1 << max(4, int(nb * 4 - 1).bit_length())
-        key = ("join_build_slots", jax.default_backend(), nb, M,
+        key = ("join_build_slots", jax.default_backend(),
+               K.kernel_selector_key(), nb, M,
                tuple(expr_cache_key(e) for e in self.build_keys))
 
         def build_fn():
@@ -1169,7 +1209,8 @@ class HashJoinOp(Operator):
 
     def _probe_csr_fn(self, cap: int, M: int, nb: int):
         prelude = self.probe_prelude
-        key = ("join_probe_csr", jax.default_backend(), cap, M, nb,
+        key = ("join_probe_csr", jax.default_backend(),
+               K.kernel_selector_key(), cap, M, nb,
                tuple(expr_cache_key(e) for e in self.build_keys),
                tuple(expr_cache_key(e) for e in self.probe_keys),
                prelude.key() if prelude is not None else None)

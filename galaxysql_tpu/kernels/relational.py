@@ -22,6 +22,9 @@ All kernels are fixed-shape: output capacity is a static argument and kernels re
 
 from __future__ import annotations
 
+import contextlib
+import os
+import threading
 from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -60,6 +63,106 @@ def hash_columns(cols: Sequence[Tuple[Any, Optional[Any]]]) -> Any:
         h = lane if h is None else _mix64(h * np.uint64(31) + lane + _GOLDEN)
     assert h is not None
     return h
+
+
+# ---------------------------------------------------------------------------
+# kernel-tier selector: Pallas vs reference formulation
+# ---------------------------------------------------------------------------
+# The hatch trio, outermost wins:  GALAXYSQL_PALLAS=0 env kills the tier for
+# the whole process; the ENABLE_PALLAS_KERNELS param (via `exec_kernel_mode`)
+# gates it per instance/session; the KERNEL(OFF|PALLAS|ON) hint per statement.
+# Selection happens at TRACE time (row counts are static shapes), so the mode
+# must ride the `global_jit` key (`kernel_selector_key`) — a flipped hint is a
+# DIFFERENT program, not a silent reuse of the wrong formulation.
+
+_PALLAS_ENV_OFF = os.environ.get("GALAXYSQL_PALLAS", "1") == "0"
+
+# stats-driven row floor for auto mode: below this the fixed kernel-launch
+# overhead beats any VMEM-locality win, so small batches keep the reference
+# formulation (which is also the correctness oracle and the only CPU path)
+PALLAS_MIN_ROWS = 65536
+
+# trace-time selection counters — the dispatch-count guards in the `kernel`
+# test matrix prove a gated-off selector never even CONSIDERED Pallas for a
+# traced program (structurally off-path, not merely numerically equal)
+KERNEL_STATS = {"pallas": 0, "reference": 0}
+
+_KERNEL_TLS = threading.local()
+
+
+def kernel_mode() -> str:
+    """Current thread's selector mode: 'auto' | 'off' | 'pallas'."""
+    return getattr(_KERNEL_TLS, "mode", "auto")
+
+
+@contextlib.contextmanager
+def kernel_scope(mode: str):
+    """Scope the selector mode for one statement (thread-local: concurrent
+    sessions pick their own formulation without racing)."""
+    prev = getattr(_KERNEL_TLS, "mode", "auto")
+    _KERNEL_TLS.mode = mode
+    try:
+        yield
+    finally:
+        _KERNEL_TLS.mode = prev
+
+
+def kernel_selector_key() -> str:
+    """Token for `global_jit` keys of programs that trace through the
+    selector (join/agg operator and MPP programs)."""
+    return "k=" + kernel_mode()
+
+
+_PALLAS_OK: Optional[bool] = None
+
+
+def _pallas_ok() -> bool:
+    """Import gate: jax.experimental.pallas may be absent or broken in a
+    stripped runtime — the tier then degrades to the reference formulation
+    instead of erroring (checked once, cached)."""
+    global _PALLAS_OK
+    if _PALLAS_OK is None:
+        try:
+            from galaxysql_tpu.kernels import pallas_agg  # noqa: F401
+            from galaxysql_tpu.kernels import pallas_join  # noqa: F401
+            _PALLAS_OK = True
+        except Exception:
+            _PALLAS_OK = False
+    return _PALLAS_OK
+
+
+def use_pallas(n: int) -> bool:
+    """Trace-time formulation choice for one kernel call site (`n` is the
+    static row count of the array the kernel sweeps)."""
+    mode = kernel_mode()
+    if _PALLAS_ENV_OFF or mode == "off" or not _pallas_ok():
+        KERNEL_STATS["reference"] += 1
+        return False
+    if mode == "pallas":
+        KERNEL_STATS["pallas"] += 1
+        return True
+    hit = jax.default_backend() == "tpu" and n >= PALLAS_MIN_ROWS
+    KERNEL_STATS["pallas" if hit else "reference"] += 1
+    return hit
+
+
+def exec_kernel_mode(hints, instance, session_overlay=None) -> str:
+    """Resolve the selector mode for one statement: KERNEL hint beats the
+    ENABLE_PALLAS_KERNELS param (session > instance > default); the env hatch
+    is enforced inside `use_pallas` and beats everything.  KERNEL(PALLAS)
+    forces the Pallas tier below the auto row floor; KERNEL(ON) restores
+    auto selection under a disabling param."""
+    h = (hints or {}).get("kernel")
+    if h == "off":
+        return "off"
+    if h == "pallas":
+        return "pallas"
+    if h == "on":
+        return "auto"
+    if instance is not None and getattr(instance, "config", None) is not None:
+        if not instance.config.get("ENABLE_PALLAS_KERNELS", session_overlay):
+            return "off"
+    return "auto"
 
 
 # ---------------------------------------------------------------------------
@@ -431,6 +534,43 @@ def _ident_lanes(keys):
     return out
 
 
+def _hash_place(ident: Sequence[Tuple[Any, Optional[Any]]], live: Any,
+                s0: Any, step: Any, M: int, max_rounds: int):
+    """Reference slot placement for `hash_groupby` — and the correctness
+    oracle the Pallas kernel (`pallas_agg.hash_place`) must match bit-for-bit.
+    Vectorized scatter-min election rounds with an early-exit while_loop."""
+    n = live.shape[0]
+    rowid = jnp.arange(n, dtype=jnp.int32)
+    sentinel = jnp.int32(n)
+
+    def cond(state):
+        r, rep, resolved, gid = state
+        return (r < max_rounds) & jnp.any(~resolved)
+
+    def body(state):
+        r, rep, resolved, gid = state
+        s = ((s0 + r.astype(jnp.uint64) * step) &
+             jnp.uint64(M - 1)).astype(jnp.int32)
+        occupied = rep[s] != sentinel
+        cand = jnp.where(resolved | occupied, sentinel, rowid)
+        rep = rep.at[s].min(cand)
+        owner = rep[s]
+        safe = jnp.clip(owner, 0, max(n - 1, 0))
+        same = owner != sentinel
+        for d, valid in ident:
+            same = same & (d[safe] == d)
+            if valid is not None:
+                same = same & (valid[safe] == valid)
+        newly = ~resolved & same
+        gid = jnp.where(newly, s, gid)
+        return r + jnp.uint64(1), rep, resolved | newly, gid
+
+    state = (jnp.uint64(0), jnp.full(M, sentinel, jnp.int32),
+             ~live, jnp.zeros(n, jnp.int32))
+    _, rep, resolved, gid = jax.lax.while_loop(cond, body, state)
+    return rep, resolved, gid
+
+
 def hash_groupby(keys: Sequence[Tuple[Any, Optional[Any]]],
                  inputs: Sequence[Tuple[Any, Optional[Any]]],
                  specs: Sequence[AggSpec],
@@ -463,34 +603,13 @@ def hash_groupby(keys: Sequence[Tuple[Any, Optional[Any]]],
     # odd stride => full cycle mod the power-of-two table size
     step = ((h >> jnp.uint64(32)) << jnp.uint64(1)) | jnp.uint64(1)
 
-    rowid = jnp.arange(n, dtype=jnp.int32)
     sentinel = jnp.int32(n)
-
-    def cond(state):
-        r, rep, resolved, gid = state
-        return (r < max_rounds) & jnp.any(~resolved)
-
-    def body(state):
-        r, rep, resolved, gid = state
-        s = ((s0 + r.astype(jnp.uint64) * step) &
-             jnp.uint64(M - 1)).astype(jnp.int32)
-        occupied = rep[s] != sentinel
-        cand = jnp.where(resolved | occupied, sentinel, rowid)
-        rep = rep.at[s].min(cand)
-        owner = rep[s]
-        safe = jnp.clip(owner, 0, max(n - 1, 0))
-        same = owner != sentinel
-        for d, valid in ident:
-            same = same & (d[safe] == d)
-            if valid is not None:
-                same = same & (valid[safe] == valid)
-        newly = ~resolved & same
-        gid = jnp.where(newly, s, gid)
-        return r + jnp.uint64(1), rep, resolved | newly, gid
-
-    state = (jnp.uint64(0), jnp.full(M, sentinel, jnp.int32),
-             ~live, jnp.zeros(n, jnp.int32))
-    _, rep, resolved, gid = jax.lax.while_loop(cond, body, state)
+    if n > 0 and use_pallas(n):
+        from galaxysql_tpu.kernels import pallas_agg
+        rep, resolved, gid = pallas_agg.hash_place(ident, live, s0, step,
+                                                   M, max_rounds)
+    else:
+        rep, resolved, gid = _hash_place(ident, live, s0, step, M, max_rounds)
     overflow = jnp.any(~resolved)
 
     placed = resolved & live
@@ -690,63 +809,47 @@ def _hash_join_pairs_sorted(build_keys, probe_keys, build_live, probe_live,
     return JoinPairs(b_of, p_of, verified, probe_matched, starts, offsets, overflow)
 
 
-def _hash_join_pairs_table(build_keys, probe_keys, build_live, probe_live,
-                           cap: int) -> JoinPairs:
-    """CPU join: slot-table CSR over the build side, gather-probe, scatter expand.
-
-    Build rows land in hash slots (M = 4x build capacity => expected <=0.25
-    collision candidates per probe, filtered by key verification like the
-    sorted path); a counting-sort arranges build row ids contiguously per slot
-    (one argsort of the SMALL side only — no probe-side binary search).  The
-    ragged probe->pair expansion replaces searchsorted(offsets, arange(cap))
-    with scatter-of-starts + cummax, which XLA:CPU runs ~10x faster."""
-    b_live = _effective_live(build_keys, build_live)
-    p_live = _effective_live(probe_keys, probe_live)
-    nb = build_keys[0][0].shape[0]
-    npr = probe_keys[0][0].shape[0]
-
+def _device_csr(build_keys, build_live, nb: int):
+    """Device-side CSR over the build slots: (perm, slot_starts, slot_counts,
+    M).  One argsort of the SMALL side groups build row ids contiguously per
+    slot; M = 4x build capacity => expected <=0.25 collision candidates per
+    probe, filtered by key verification like the sorted path."""
     M = 1 << max(4, int(nb * 4 - 1).bit_length())
     # slot-id lane shared with the host-CSR path (hash + dead-row masking):
-    # one definition both join formulations and the hybrid union probe reuse
+    # one definition every join formulation and the hybrid union probe reuse
     s_b = hash_join_build_slots(build_keys, build_live, M)
-    # CSR: build row ids grouped by slot (argsort of the small side)
     perm = jnp.argsort(s_b).astype(jnp.int32)
     slot_counts = jax.ops.segment_sum(jnp.ones(nb, jnp.int32), s_b,
                                       num_segments=M + 1)[:M]
     slot_ends = jnp.cumsum(slot_counts)
     slot_starts = slot_ends - slot_counts
+    return perm, slot_starts, slot_counts, M
 
-    h_p = hash_columns(probe_keys)
-    s_p = (h_p & jnp.uint64(M - 1)).astype(jnp.int32)
-    counts = jnp.where(p_live, slot_counts[s_p].astype(jnp.int64), 0)
 
-    offsets = jnp.cumsum(counts)
-    total = offsets[-1] if npr else jnp.int64(0)
-    overflow = total > cap
-    starts = offsets - counts
-
-    # expansion: scatter each non-empty probe row's id at its first pair slot,
-    # then forward-fill with cummax (starts are unique among non-empty rows)
-    slots = jnp.arange(cap, dtype=jnp.int64)
+def _expand_offsets(counts, starts, npr: int, cap: int):
+    """Ragged probe->pair expansion: scatter each non-empty probe row's id at
+    its first pair slot, then forward-fill with cummax (starts are unique
+    among non-empty rows) — ~10x faster than searchsorted(offsets,
+    arange(cap)) on XLA:CPU.  Selector-gated: the Pallas variant runs the
+    same scatter + running-max sweep in VMEM."""
+    if npr > 0 and cap > 0 and use_pallas(cap):
+        from galaxysql_tpu.kernels import pallas_join
+        return pallas_join.expand_offsets(counts, starts, cap)
     scatter_at = jnp.where(counts > 0, starts, jnp.int64(cap))
     p_of = jnp.zeros(cap, jnp.int32).at[scatter_at].max(
         jnp.arange(npr, dtype=jnp.int32), mode="drop")
-    p_of = jax.lax.cummax(p_of)
-    k = slots - starts[p_of]
-    pair_live = slots < jnp.minimum(total, cap)
-    bpos = jnp.clip(slot_starts[s_p[p_of]].astype(jnp.int64) + k, 0,
-                    max(nb - 1, 0))
-    b_of = perm[bpos]
+    return jax.lax.cummax(p_of)
 
-    verified = pair_live
-    for (bd, bv), (pd, pv) in zip(build_keys, probe_keys):
-        verified = verified & (bd[b_of] == pd[p_of])
-    verified = verified & b_live[b_of] & p_live[p_of]
 
-    probe_matched = probe_matched_from(verified, starts, offsets) \
-        if npr else jnp.zeros(0, jnp.bool_)
-
-    return JoinPairs(b_of, p_of, verified, probe_matched, starts, offsets, overflow)
+def _hash_join_pairs_table(build_keys, probe_keys, build_live, probe_live,
+                           cap: int) -> JoinPairs:
+    """CPU join: slot-table CSR over the build side, gather-probe, scatter
+    expand.  Thin composition of `_device_csr` + `hash_join_probe_csr` — the
+    hybrid probe and the Pallas tier ride the exact same pipeline."""
+    nb = build_keys[0][0].shape[0]
+    perm, slot_starts, slot_counts, M = _device_csr(build_keys, build_live, nb)
+    return hash_join_probe_csr(build_keys, probe_keys, build_live, probe_live,
+                               perm, slot_starts, slot_counts, M, cap)
 
 
 def hash_join_build_slots(build_keys: Sequence[Tuple[Any, Optional[Any]]],
@@ -759,6 +862,10 @@ def hash_join_build_slots(build_keys: Sequence[Tuple[Any, Optional[Any]]],
     computes the slot id lane (hash + mask) that both sides must agree on.
     Dead/NULL-key rows get the scratch slot M."""
     b_live = _effective_live(build_keys, build_live)
+    nb = build_keys[0][0].shape[0]
+    if nb > 0 and use_pallas(nb):
+        from galaxysql_tpu.kernels import pallas_join
+        return pallas_join.build_slots(build_keys, b_live, M)
     h_b = hash_columns(build_keys)
     s_b = (h_b & jnp.uint64(M - 1)).astype(jnp.int32)
     return jnp.where(b_live, s_b, jnp.int32(M))
@@ -778,8 +885,12 @@ def hash_join_probe_csr(build_keys, probe_keys, build_live, probe_live,
     nb = build_keys[0][0].shape[0]
     npr = probe_keys[0][0].shape[0]
 
-    h_p = hash_columns(probe_keys)
-    s_p = (h_p & jnp.uint64(M - 1)).astype(jnp.int32)
+    if npr > 0 and use_pallas(npr):
+        from galaxysql_tpu.kernels import pallas_join
+        s_p = pallas_join.hash_slots(probe_keys, M)
+    else:
+        h_p = hash_columns(probe_keys)
+        s_p = (h_p & jnp.uint64(M - 1)).astype(jnp.int32)
     counts = jnp.where(p_live, slot_counts[s_p].astype(jnp.int64), 0)
 
     offsets = jnp.cumsum(counts)
@@ -788,10 +899,7 @@ def hash_join_probe_csr(build_keys, probe_keys, build_live, probe_live,
     starts = offsets - counts
 
     slots = jnp.arange(cap, dtype=jnp.int64)
-    scatter_at = jnp.where(counts > 0, starts, jnp.int64(cap))
-    p_of = jnp.zeros(cap, jnp.int32).at[scatter_at].max(
-        jnp.arange(npr, dtype=jnp.int32), mode="drop")
-    p_of = jax.lax.cummax(p_of)
+    p_of = _expand_offsets(counts, starts, npr, cap)
     k = slots - starts[p_of]
     pair_live = slots < jnp.minimum(total, cap)
     bpos = jnp.clip(slot_starts[s_p[p_of]].astype(jnp.int64) + k, 0,
@@ -836,10 +944,14 @@ def hash_join_probe_hybrid(build_keys: Sequence[Tuple[Any, Optional[Any]]],
     partitions (locally-kept hot rows + shuffled cold rows); this entry
     enumerates verified pairs over the union in ONE pass with the standard
     fixed-shape/overflow contract.  Both lanes go through the same build-slot
-    construction (`hash_join_build_slots` inside the table formulation), so
-    the hybrid probe costs one program, not one per lane, and shares its
-    backend-adaptive formulation with `hash_join_pairs`."""
-    return hash_join_pairs(build_keys, probe_keys, build_live, probe_live, cap)
+    construction (`hash_join_build_slots` inside `_device_csr`), and the
+    probe rides `hash_join_probe_csr` on EVERY backend — one implementation
+    shared with the batch-streamed CSR probe and the Pallas probe kernel
+    instead of a re-derived pair enumeration per entry point."""
+    nb = build_keys[0][0].shape[0]
+    perm, slot_starts, slot_counts, M = _device_csr(build_keys, build_live, nb)
+    return hash_join_probe_csr(build_keys, probe_keys, build_live, probe_live,
+                               perm, slot_starts, slot_counts, M, cap)
 
 
 def probe_matched_from(pair_live: Any, starts: Any, offsets: Any) -> Any:

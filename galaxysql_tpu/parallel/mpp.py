@@ -527,7 +527,7 @@ class MppExecutor:
 
     def _agg_round(self, groups, child, inputs, specs, merge_specs, G,
                    prelude=None):
-        key = ("mpp_agg", jax.default_backend(),
+        key = ("mpp_agg", jax.default_backend(), K.kernel_selector_key(),
                tuple((n, expr_cache_key(e)) for n, e in groups),
                tuple(expr_cache_key(e) for e in inputs), specs, G,
                child.replicated, self.S,
@@ -621,7 +621,7 @@ class MppExecutor:
 
     def _salted_agg_round(self, groups, child, inputs, specs, merge_specs,
                           G, factor, quota, prelude=None):
-        key = ("mpp_agg_salt", jax.default_backend(),
+        key = ("mpp_agg_salt", jax.default_backend(), K.kernel_selector_key(),
                tuple((n, expr_cache_key(e)) for n, e in groups),
                tuple(expr_cache_key(e) for e in inputs), specs, G, factor,
                self.S, quota,
@@ -816,7 +816,7 @@ class MppExecutor:
         probe_R = int(probe.live.shape[0]) // self.S
         cap = bucket_capacity(max(probe_R * 2, 1024))
         while True:
-            key = ("mpp_bjoin", node.kind,
+            key = ("mpp_bjoin", node.kind, K.kernel_selector_key(),
                    tuple(expr_cache_key(e) for e in build_keys),
                    tuple(expr_cache_key(e) for e in probe_keys),
                    expr_cache_key(node.residual) if node.residual is not None else None,
@@ -873,7 +873,7 @@ class MppExecutor:
         quota_p = max(2 * pR // self.S, 128)
         cap = bucket_capacity(max(2 * quota_p * self.S, 1024))
         while True:
-            key = ("mpp_sjoin", node.kind,
+            key = ("mpp_sjoin", node.kind, K.kernel_selector_key(),
                    tuple(expr_cache_key(e) for e in build_keys),
                    tuple(expr_cache_key(e) for e in probe_keys),
                    expr_cache_key(node.residual) if node.residual is not None else None,
@@ -988,7 +988,8 @@ class MppExecutor:
         # holds where the plain shuffle's hot shard overflows it
         cap = bucket_capacity(max(2 * quota_p * self.S, 1024))
         while True:
-            key = ("mpp_hybrid_join", node.kind, active.orientation,
+            key = ("mpp_hybrid_join", node.kind, K.kernel_selector_key(),
+                   active.orientation,
                    tuple(expr_cache_key(e) for e in build_keys),
                    tuple(expr_cache_key(e) for e in probe_keys),
                    expr_cache_key(node.residual)

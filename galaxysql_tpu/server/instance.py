@@ -199,6 +199,18 @@ class Instance:
 
     def boot(self):
         """Load persisted metadata + data, then recover interrupted DDL jobs."""
+        # persistent AOT compile cache: attach FIRST so every program traced
+        # during/after boot can be replayed from disk on the next restart.
+        # Booting without a data_dir DETACHES — the cache is process-global
+        # and a later memory-only instance must not inherit another's dir.
+        from galaxysql_tpu.exec.compile_cache import GLOBAL_COMPILE_CACHE
+        if self.data_dir and self.config.get("ENABLE_COMPILE_CACHE"):
+            GLOBAL_COMPILE_CACHE.attach(
+                os.path.join(self.data_dir, "compile_cache"),
+                budget=int(self.config.get("COMPILE_CACHE_BYTES")))
+            GLOBAL_COMPILE_CACHE.bind_metrics(self.metrics)
+        else:
+            GLOBAL_COMPILE_CACHE.detach()
         self.planner.spm.attach(self.metadb)
         self.config_listener.bind("config.params", self._reload_global_config)
         self._reload_global_config()
@@ -284,6 +296,14 @@ class Instance:
         self.metadb.kv_put("catalog.versions", json.dumps(
             [self.catalog.version, self.catalog.schema_version,
              self.catalog.stats_version]))
+        # AOT-serialize this process's steady-state programs alongside the
+        # checkpoint; best-effort — a program that won't serialize must never
+        # fail a data checkpoint
+        try:
+            from galaxysql_tpu.exec.compile_cache import GLOBAL_COMPILE_CACHE
+            GLOBAL_COMPILE_CACHE.flush()
+        except Exception:  # galaxylint: disable=swallow -- best-effort AOT flush: a serialization failure must never fail the data checkpoint (per-entry errors are already handled inside flush)
+            pass
 
     def allocate_conn_id(self) -> int:
         with self.lock:

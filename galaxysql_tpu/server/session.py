@@ -1048,7 +1048,14 @@ class Session:
                 int(self.instance.config.get("QUERY_MEM_BYTES", self.vars)
                     or (4 << 30)))
         try:
-            with self.instance.mdl.shared(mdl_keys):
+            # kernel-tier selector mode for the statement (KERNEL hint >
+            # ENABLE_PALLAS_KERNELS param): thread-local scope, so programs
+            # traced below pick their join/agg formulation — and carry the
+            # mode in their global_jit keys — without racing other sessions
+            from galaxysql_tpu.kernels import relational as _K
+            with self.instance.mdl.shared(mdl_keys), \
+                    _K.kernel_scope(_K.exec_kernel_mode(
+                        ctx.hints, self.instance, self.vars)):
                 return self._run_query_locked(plan, ctx, sql, t0, prof)
         finally:
             # per-query pool teardown: releases any bytes a failed operator
@@ -2159,8 +2166,11 @@ class Session:
             t0 = time.time()
             # statement-scope shared MDL: concurrent column DDL must not swap
             # partition lanes mid-execution (same torn-read class as SELECT)
+            from galaxysql_tpu.kernels import relational as _K
             with self.instance.mdl.shared(mdl_keys), \
-                    SEGMENT_TRACER.scoped(prof.segments):
+                    SEGMENT_TRACER.scoped(prof.segments), \
+                    _K.kernel_scope(_K.exec_kernel_mode(
+                        ctx.hints, self.instance, self.vars)):
                 # same engine dispatch as _run_query_locked: ANALYZE numbers
                 # must describe the engine users actually run — an AP query
                 # above the MPP threshold reports its SPMD stages (per-shard
@@ -2181,11 +2191,13 @@ class Session:
                                                         None))
             d_retr = COMPILE_STATS["retraces"] - c0["retraces"]
             d_cms = COMPILE_STATS["compile_ms"] - c0["compile_ms"]
+            d_cached = COMPILE_STATS["cache_hits"] - c0.get("cache_hits", 0)
             d_bytes = TRANSFER_STATS["bytes"] - x0["bytes"]
             d_xfers = TRANSFER_STATS["transfers"] - x0["transfers"]
             lines += [f"-- trace_id: {prof.trace_id}", f"-- rows: {rows}",
                       f"-- elapsed: {elapsed:.3f}s",
-                      f"-- compile: retraces={d_retr} wall={d_cms:.3f}ms",
+                      f"-- compile: retraces={d_retr} wall={d_cms:.3f}ms "
+                      f"cached={d_cached}",
                       f"-- transfer: h2d_bytes={d_bytes} "
                       f"transfers={d_xfers}"] + \
                 [f"-- {t}" for t in ctx.trace]

@@ -37,6 +37,10 @@ behind them:
   (exec/skew.py): OFF skips the planning pass entirely — no node carries a
   skew plan, so the hybrid/salted paths are structurally unreachable;
   JOIN/AGG restrict planting to that feature.  `=` syntax accepted.
+- KERNEL(OFF|PALLAS|ON)    per-statement control of the kernel-tier selector
+  (kernels/relational.py): OFF pins the reference join/agg formulations,
+  PALLAS forces the Pallas kernels below the auto row floor, ON restores
+  auto selection under a disabling ENABLE_PALLAS_KERNELS.  `=` accepted.
 - BASELINE_OFF             bypass SPM for the statement (plan as costed)
 
 Unknown directives are ignored (hints must never break a query), matching the
@@ -102,6 +106,13 @@ def parse_hints(comment: Optional[str]) -> Dict[str, object]:
             mode = arglist[0].lower()
             if mode in ("off", "join", "agg", "on"):
                 out["skew"] = mode
+        elif name == "KERNEL" and arglist:
+            # kernel-tier selector (kernels/relational.py): OFF pins the
+            # reference formulation, PALLAS forces the Pallas tier below the
+            # auto row floor, ON restores auto under a disabling param
+            mode = arglist[0].lower()
+            if mode in ("off", "pallas", "on"):
+                out["kernel"] = mode
         elif name == "MAX_EXECUTION_TIME" and arglist:
             try:
                 ms = int(arglist[0])

@@ -573,6 +573,11 @@ class TestXaCrashRecovery:
                 "in doubt" in str(ei.value)
             h.wait_dead()
             h.restart()
+            # the failed commit opened the client breaker; an idle box
+            # restarts the worker inside cooldown_s and recover_remote()
+            # skips open-breaker workers by design — wait out the cooldown
+            # so the half-open probe can close it
+            time.sleep(client.cooldown_s + 0.05)
             out = bounded(lambda: inst.xa_coordinator.recover_remote())
             assert any(v == "committed" for v in out.values()), out
             inst.ha.fence_worker(h.addr, False)

@@ -140,6 +140,26 @@ class TestJitRules:
             "galaxysql_tpu/exec/x.py")
         assert rules_of(fs) == []
 
+    def test_raw_pallas_call_flagged(self):
+        fs = L.lint_source(
+            "from jax.experimental import pallas as pl\n"
+            "def f(shape):\n"
+            "    return pl.pallas_call(lambda r, o: None, out_shape=shape)\n",
+            "galaxysql_tpu/kernels/x.py")
+        assert rules_of(fs) == ["pallas-raw"]
+
+    def test_pallas_call_in_builder_clean(self):
+        fs = L.lint_source(
+            "from jax.experimental import pallas as pl\n"
+            "def wrap(key, shape):\n"
+            "    def build():\n"
+            "        def kernel(r, o):\n"
+            "            pass\n"
+            "        return pl.pallas_call(kernel, out_shape=shape)\n"
+            "    return global_jit(key, build)\n",
+            "galaxysql_tpu/kernels/x.py")
+        assert rules_of(fs) == []
+
     def test_device_sync_in_hot_dir_flagged(self):
         fs = L.lint_source(
             "def drain(v):\n"
@@ -395,8 +415,8 @@ class TestTreeClean:
     def test_rules_registered(self):
         rules = {r for ck in ALL_CHECKERS for r in ck.rules}
         assert rules == {"lock-order", "lock-blocking", "jit-raw",
-                         "jit-device-sync", "swallow", "untyped-raise",
-                         "dead-failpoint", "metric-orphan"}
+                         "pallas-raw", "jit-device-sync", "swallow",
+                         "untyped-raise", "dead-failpoint", "metric-orphan"}
 
     def test_cli_exits_zero(self, capsys):
         assert L.main([]) == 0
