@@ -181,8 +181,23 @@ bench-kernels:
 heal-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m selfheal -p no:cacheprovider
 
+# SLO-plane smoke: the slo marker suite — deterministic burn/recover under
+# an injected latency failpoint (fast+slow window burn, slo_burn/critical,
+# /health degraded, recovery re-arm), compile-retrace anomaly detection,
+# CREATE/DROP SLO restart persistence, the SHOW/info-schema/web surfaces,
+# and the zero-dispatch / zero-transfer sampler guards
+slo-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m slo -p no:cacheprovider
+
+# SLO bench: steady-state serving snapshotted through the metric history
+# (slo_snapshot: history-derived qps + p99 + burn state) and the sampler
+# overhead measurement — closed-loop QPS with the history/SLO tick on vs
+# hatched off (target: <= 3% delta; BENCH json on stdout)
+bench-slo:
+	JAX_PLATFORMS=cpu $(PY) bench.py --slo-only
+
 .PHONY: tier1 fusion-smoke obs-smoke rf-smoke cache-smoke trace-smoke bench \
 	batch-smoke chaos-smoke skew-smoke bench-skew summary-smoke heal-smoke \
 	overload-smoke bench-overload dml-smoke bench-dml lint lint-smoke \
 	rebalance-smoke chaos-rebalance bench-rebalance kernel-smoke \
-	bench-kernels
+	bench-kernels slo-smoke bench-slo

@@ -288,6 +288,58 @@ REBALANCE_GROUPS = _p(
     "csv of placement group labels the balancer may MOVE partitions "
     "across (empty = no cross-group move proposals)")
 
+# --- SLO plane (utils/metric_history.py + server/slo.py) -----------------------
+ENABLE_METRIC_HISTORY = _p(
+    "ENABLE_METRIC_HISTORY", True,
+    "sample every registry counter/gauge/histogram plus admission and "
+    "statement-summary class aggregates into a bounded delta-encoded ring "
+    "each maintain tick; host-side reads only — zero device syncs, never "
+    "on the query path (env hatch: GALAXYSQL_METRIC_HISTORY=0)")
+METRIC_HISTORY_INTERVAL_S = _p(
+    "METRIC_HISTORY_INTERVAL_S", 5.0,
+    "seconds between history samples (the maintain loop's poll gates on "
+    "this; SLO burn windows are counted in samples, so they scale with it)")
+METRIC_HISTORY_SAMPLES = _p(
+    "METRIC_HISTORY_SAMPLES", 360,
+    "samples retained in the ring (delta-encoded; 360 x 5s = 30 min); "
+    "evicted deltas fold into the base snapshot so replay stays exact")
+SLO_TP_P99_MS = _p(
+    "SLO_TP_P99_MS", 250.0,
+    "built-in tp_latency_p99 objective: recent-window TP p99 target (ms)")
+SLO_AP_P99_MS = _p(
+    "SLO_AP_P99_MS", 4000.0,
+    "built-in ap_latency_p99 objective: recent-window AP p99 target (ms)")
+SLO_ERROR_RATIO = _p(
+    "SLO_ERROR_RATIO", 0.01,
+    "built-in typed_error_ratio objective: errored / executed over the "
+    "burn window")
+SLO_FAST_WINDOW_SAMPLES = _p(
+    "SLO_FAST_WINDOW_SAMPLES", 3,
+    "fast burn window in history samples (catches the page)")
+SLO_SLOW_WINDOW_SAMPLES = _p(
+    "SLO_SLOW_WINDOW_SAMPLES", 12,
+    "slow burn window in history samples (suppresses blips: both windows "
+    "must burn before an slo_burn event fires)")
+SLO_BURN_FAST = _p(
+    "SLO_BURN_FAST", 2.0,
+    "fast-window burn-rate threshold (measured/target; >= 2x its value "
+    "escalates event severity to critical)")
+SLO_BURN_SLOW = _p(
+    "SLO_BURN_SLOW", 1.0,
+    "slow-window burn-rate threshold (measured/target)")
+ANOMALY_EWMA_ALPHA = _p(
+    "ANOMALY_EWMA_ALPHA", 0.3,
+    "EWMA smoothing for the counter-rate anomaly detector's per-metric "
+    "baseline mean and mean-absolute-deviation")
+ANOMALY_SIGMA = _p(
+    "ANOMALY_SIGMA", 8.0,
+    "metric_anomaly fires when a counter's per-tick rate exceeds "
+    "baseline mean + sigma x deviation (robust-EWMA, detection only)")
+ANOMALY_MIN_RATE = _p(
+    "ANOMALY_MIN_RATE", 10.0,
+    "absolute floor (events/s) below which the anomaly detector never "
+    "fires — quiet counters twitching from 0 to 1 are not storms")
+
 # --- self-healing plan management (plan/spm.py quarantine machine) -------------
 ENABLE_PLAN_AUTOHEAL = _p(
     "ENABLE_PLAN_AUTOHEAL", True,

@@ -14,6 +14,15 @@ Cross-file passes (they run in `finalize`, over the whole project):
   the instance registry — otherwise it's invisible to SHOW METRICS,
   information_schema.metrics, and Prometheus).  Registry-created metrics
   (`registry.counter(...)`) auto-surface and are exempt.
+- **event-untested**: every typed journal event kind published anywhere in
+  the package (a string-literal first argument to `publish(...)`) must be
+  named by at least one test — an alert nobody has ever armed or asserted
+  is an alert that silently rots (the SLO plane's slo_burn/metric_anomaly
+  events are load-bearing precisely because tests drive them).
+- **histogram-unsampled**: every process-shared histogram adopted into the
+  registry must be named by a test so its expansion (`<name>_p99` etc.)
+  provably appears in a metric-history sample — otherwise the SLO plane's
+  windows can lose an input without any test noticing.
 """
 
 from __future__ import annotations
@@ -29,14 +38,19 @@ _METRIC_CTORS = ("Counter", "Gauge", "Histogram")
 
 
 class HygieneChecker(Checker):
-    rules = ("dead-failpoint", "metric-orphan")
+    rules = ("dead-failpoint", "metric-orphan", "event-untested",
+             "histogram-unsampled")
     description = ("FP_* keys never armed by any test; process-shared "
-                   "metrics never updated or never adopted/surfaced")
+                   "metrics never updated or never adopted/surfaced; "
+                   "journal event kinds / adopted histograms never "
+                   "exercised by any test")
 
     def finalize(self, project: Project):
         findings: List[Finding] = []
         findings.extend(self._dead_failpoints(project))
         findings.extend(self._metric_orphans(project))
+        findings.extend(self._untested_events(project))
+        findings.extend(self._unsampled_histograms(project))
         return findings
 
     def _dead_failpoints(self, project: Project):
@@ -98,4 +112,68 @@ class HygieneChecker(Checker):
                             f"into an instance registry — invisible to SHOW "
                             f"METRICS / information_schema.metrics / "
                             f"Prometheus", rule="metric-orphan"))
+        return findings
+
+    def _untested_events(self, project: Project):
+        """Every string-literal kind passed to `publish(...)` anywhere in
+        the package must appear (word-boundary) somewhere under tests/.
+        Variable kinds can't be checked statically and are skipped."""
+        findings = []
+        seen = set()  # report each kind once, at its first publish site
+        for mod in project.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                fn = node.func
+                fname = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else "")
+                if fname != "publish":
+                    continue
+                arg = node.args[0]
+                if not (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    continue
+                kind = arg.value
+                if kind in seen:
+                    continue
+                seen.add(kind)
+                if not re.search(rf"\b{re.escape(kind)}\b",
+                                 project.test_text):
+                    findings.append(self.finding(
+                        mod, node.lineno,
+                        f"journal event kind '{kind}' is published here but "
+                        f"never named by any test — an alert nobody has "
+                        f"armed or asserted silently rots",
+                        rule="event-untested"))
+        return findings
+
+    def _unsampled_histograms(self, project: Project):
+        """Every module-level `NAME = Histogram("metric", ...)` must have
+        its METRIC NAME (the ctor's string argument, not the Python
+        symbol) appear in tests/ — the SLO-plane suite asserts each one's
+        `<name>_p99` expansion lands in a history sample."""
+        findings = []
+        for mod in project.modules:
+            for node in ast.iter_child_nodes(mod.tree):
+                if not isinstance(node, ast.Assign) or \
+                        not isinstance(node.value, ast.Call):
+                    continue
+                fn = node.value.func
+                ctor = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else "")
+                if ctor != "Histogram" or not node.value.args:
+                    continue
+                arg = node.value.args[0]
+                if not (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    continue
+                metric = arg.value
+                if not re.search(rf"\b{re.escape(metric)}\b",
+                                 project.test_text):
+                    findings.append(self.finding(
+                        mod, node.lineno,
+                        f"histogram '{metric}' is never named by any test — "
+                        f"nothing proves its quantile expansion reaches a "
+                        f"metric-history sample",
+                        rule="histogram-unsampled"))
         return findings

@@ -249,6 +249,28 @@ class _Entry:
         self.baseline_samples: List[float] = []
 
 
+class _ClassRoll:
+    """Per-(schema, workload-class) rollup for SLO scoping: cumulative
+    exec/error counts (the history ring turns them into rates) plus a
+    small ring of recent successful latencies for a recent-window p99.
+    The 128-observation window is count-bounded, not time-bounded, so
+    burn/recover tests are deterministic: 128 good queries fully flush
+    an injected-latency storm out of the window."""
+
+    __slots__ = ("execs", "errors", "recent")
+
+    def __init__(self):
+        self.execs = 0
+        self.errors = 0
+        self.recent: "collections.deque" = collections.deque(maxlen=128)
+
+    def recent_p99(self) -> float:
+        if not self.recent:
+            return 0.0
+        vals = sorted(self.recent)
+        return vals[int(0.99 * (len(vals) - 1))]
+
+
 class StatementSummaryStore:
     """Per-Instance digest x plan x window aggregator + regression sentinel.
 
@@ -262,6 +284,10 @@ class StatementSummaryStore:
         # (schema, ptext) -> _Entry, LRU by last update for digest eviction
         self._entries: "collections.OrderedDict[Tuple[str, str], _Entry]" = \
             collections.OrderedDict()
+        # ("" | schema, workload-class) -> _ClassRoll: the SLO plane's
+        # per-tenant scoping signal, tagged with the digest's schema at
+        # record time; ("", wl) aggregates across all schemas
+        self._class_roll: Dict[Tuple[str, str], _ClassRoll] = {}
         self._regressions = instance.metrics.counter(
             "plan_regressions",
             "digests whose windowed latency regressed vs their plan baseline")
@@ -356,6 +382,18 @@ class StatementSummaryStore:
                         bx[k] += v
                         ax[k] += v
             self.recorded.inc()
+            wl = (workload or "TP").upper()
+            for rkey in (("", wl), (schema.lower(), wl)):
+                roll = self._class_roll.get(rkey)
+                if roll is None:
+                    if rkey[0] and len(self._class_roll) >= 512:
+                        continue  # tenant-cardinality bound; globals always fit
+                    roll = self._class_roll[rkey] = _ClassRoll()
+                roll.execs += 1
+                if error:
+                    roll.errors += 1
+                else:
+                    roll.recent.append(elapsed_ms)
             flagged = self._sentinel(e, agg, b, elapsed_ms, now) \
                 if not error else None
         if flagged is not None:
@@ -363,6 +401,23 @@ class StatementSummaryStore:
             # the store lock: every query's exit ramp contends on it, and a
             # slow persist must not stall concurrent sessions
             self._flag(e, agg, flagged)
+
+    def class_stats_rows(self) -> List[Tuple[str, str, float]]:
+        """(name, kind, value) rows the metric-history sampler folds into
+        each snapshot (prefixed `stmt_`): per-class and per-tenant
+        cumulative execs/errors plus the recent-window p99 the SLO
+        burn-rate windows judge.  `class_<wl>_*` aggregates all schemas;
+        `tenant_<schema>_<wl>_*` is the per-tenant cut."""
+        out: List[Tuple[str, str, float]] = []
+        with self._lock:
+            for (schema, wl), roll in self._class_roll.items():
+                base = (f"tenant_{schema}_{wl.lower()}" if schema
+                        else f"class_{wl.lower()}")
+                out.append((f"{base}_execs", "counter", float(roll.execs)))
+                out.append((f"{base}_errors", "counter", float(roll.errors)))
+                out.append((f"{base}_recent_p99_ms", "gauge",
+                            float(roll.recent_p99())))
+        return out
 
     # -- plan-regression sentinel -------------------------------------------
 

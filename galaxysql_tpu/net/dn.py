@@ -262,6 +262,10 @@ class WorkerClient:
         self.load_q = 0
         self.load_tier = 0
         self.load_at = 0.0
+        # SLO-plane piggyback twin: worker uptime + history sample count
+        # feed the pull-free cluster-health view
+        self.load_up = 0.0
+        self.load_samples = 0
         # sync-epoch plane: bound by SyncBus.attach; adds {se, origin} to
         # every request so the worker can detect missed broadcasts
         self._sync_bus = None
@@ -642,6 +646,8 @@ class WorkerClient:
             try:
                 self.load_q = int(wl.get("q", 0))
                 self.load_tier = int(wl.get("mt", 0))
+                self.load_up = float(wl.get("up", 0.0))
+                self.load_samples = int(wl.get("ns", 0))
                 self.load_at = time.time()
             except (TypeError, ValueError, AttributeError):
                 pass  # malformed piggyback must never fail a data request

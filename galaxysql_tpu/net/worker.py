@@ -109,8 +109,13 @@ class Worker:
             # backpressure piggyback: queue depth + memory-pressure tier ride
             # every reply (one list len + one pool division — no syncs)
             try:
+                # "up"/"ns" (uptime, history samples) feed the pull-free
+                # cluster-health view (Instance.cluster_health(pull=False))
                 resp["wl"] = {"q": len(self._active),
-                              "mt": self.instance.admission.governor.tier()}
+                              "mt": self.instance.admission.governor.tier(),
+                              "up": round(
+                                  _time.time() - self.instance.started_at, 1),
+                              "ns": self.instance.metric_history.samples_count}
             except Exception as tex:
                 # load telemetry must never fail a data request — but a
                 # BROKEN piggyback means the coordinator routes blind, so
@@ -864,6 +869,21 @@ class Worker:
                         "dedupe_hits": self.dedupe_hits,
                         "heals": self.heals,
                         "sync_epochs": dict(self._sync_epochs)}, {}
+        if action == "health":
+            # SLO-plane cluster view: workers run the same sampler over
+            # their own registries (the Worker's Instance constructs one);
+            # a health pull takes an interval-gated sample, then reports a
+            # snapshot summary — pull-driven, so an idle worker pays zero
+            mh = inst.metric_history
+            mh.maybe_sample()
+            return {"ok": True, "action": action, "node": inst.node_id,
+                    "uptime_s": round(_time.time() - inst.started_at, 3),
+                    "active": float(len(self._active)),
+                    "qps": round(mh.rate("queries_total"), 3),
+                    "error_rate": round(mh.rate("query_errors"), 6),
+                    "mem_tier": int(inst.admission.governor.tier()),
+                    "samples": int(mh.summary()["samples"]),
+                    "burning": inst.slo.burning_names()}, {}
         return {"error": f"unknown sync action {action!r}"}, {}
 
     # -- server loop ---------------------------------------------------------

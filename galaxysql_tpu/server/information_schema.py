@@ -117,6 +117,21 @@ _DEFS: Dict[str, List] = {
         ("accepted_plan", _V), ("origin", _V), ("runs", _I), ("avg_ms", _D),
         ("candidate_plan", _V), ("regressions", _I), ("last_regression", _V),
         ("state", _V), ("rollbacks", _I), ("last_heal", _V)],
+    # SLO plane (server/slo.py + utils/metric_history.py; SHOW SLO /
+    # SHOW METRIC HISTORY / SHOW CLUSTER HEALTH twins)
+    "slo_status": [
+        ("slo_name", _V), ("kind", _V), ("schema_name", _V),
+        ("workload", _V), ("target", _D), ("measured", _D),
+        ("fast_burn", _D), ("slow_burn", _D), ("state", _V),
+        ("since", _D), ("source", _V)],
+    "metric_history": [
+        ("metric_name", _V), ("points", _I), ("latest", _D),
+        ("min_value", _D), ("max_value", _D), ("rate_per_s", _D)],
+    "cluster_health": [
+        ("node_id", _V), ("role", _V), ("addr", _V), ("state", _V),
+        ("leader", _I), ("uptime_s", _D), ("sessions", _D), ("qps", _D),
+        ("error_rate", _D), ("mem_tier", _I), ("burning_slos", _V),
+        ("samples", _I)],
 }
 
 
@@ -255,3 +270,11 @@ def refresh(instance, session=None):
     fill("plan_baselines", (list(r) for r in instance.planner.spm.rows()))
     from galaxysql_tpu.ddl.rebalance import progress_rows
     fill("rebalance_jobs", (list(r) for r in progress_rows(instance)))
+    slo = getattr(instance, "slo", None)
+    fill("slo_status", (list(r) for r in (slo.rows() if slo else [])))
+    mh = getattr(instance, "metric_history", None)
+    fill("metric_history", (list(r) for r in (mh.rows() if mh else [])))
+    # pull=False: info_schema refresh renders piggybacked worker telemetry
+    # only — a wedged worker must not stall an unrelated catalog query
+    fill("cluster_health",
+         (list(r) for r in instance.cluster_health(pull=False)))

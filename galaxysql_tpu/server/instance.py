@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time as _time
 import uuid
 from typing import Dict, Optional
 
@@ -58,6 +59,7 @@ class Instance:
         self.archive = ArchiveManager(
             os.path.join(data_dir, "archive") if data_dir else None)
         self.node_id = f"cn-{uuid.uuid4().hex[:8]}"
+        self.started_at = _time.time()  # /health + cluster-view uptime
         from galaxysql_tpu.net.dn import SyncBus
         self.workers: Dict[tuple, object] = {}  # (host, port) -> WorkerClient
         # origin rides every RPC with the bus epoch: workers key their
@@ -151,6 +153,15 @@ class Instance:
         # governor (tiered fragment-cache/spill/AP-refusal responses)
         from galaxysql_tpu.server.admission import AdmissionController
         self.admission = AdmissionController(self)
+        # SLO plane (utils/metric_history.py + server/slo.py): bounded
+        # delta-encoded history of every scalar this node exposes, and the
+        # burn-rate / anomaly engine judging it.  Sampled by the maintain
+        # loop via slo_tick(); workers run the same sampler over their own
+        # registries and the `health` sync action pulls their snapshots.
+        from galaxysql_tpu.utils.metric_history import MetricHistory
+        self.metric_history = MetricHistory(self)
+        from galaxysql_tpu.server.slo import SloEngine
+        self.slo = SloEngine(self)
         from galaxysql_tpu.server.maintain import RecycleBin
         self.recycle = RecycleBin(self)
         # elastic rebalancing (ddl/rebalance.py + server/balancer.py): the
@@ -341,6 +352,74 @@ class Instance:
                          bk["consec_failures"], bk["retries"], bk["failures"],
                          bk["opens"], bk["last_error"],
                          int(budget.remaining()) if budget is not None else 0))
+        return rows
+
+    # -- SLO plane ------------------------------------------------------------
+
+    def slo_tick(self, now: Optional[float] = None,
+                 force: bool = False) -> bool:
+        """One SLO-plane tick: take a history sample (interval-gated
+        unless `force`) and, when one lands, burn-rate every objective
+        and rate-anomaly every counter.  Driven by the maintain loop on
+        every poll (per-node — NOT leader-gated like scheduled jobs) and
+        by tests with synthetic `now` stamps.  Advisory: never raises."""
+        try:
+            mh = self.metric_history
+            sampled = mh.sample(now=now) if force else mh.maybe_sample(now=now)
+            if sampled is None:
+                return False
+            self.slo.evaluate(now=now)
+            return True
+        except Exception:  # galaxylint: disable=swallow -- advisory plane: a sampler fault must never affect serving (pragma: no cover)
+            return False
+
+    def cluster_health(self, pull: bool = True):
+        """Cluster-wide health rows: this coordinator first, then one row
+        per attached worker.  `pull=True` issues the `health` sync action
+        (fresh per-worker sampler snapshots; an unreachable worker gets an
+        UNREACHABLE row, never an exception); `pull=False` renders from
+        piggybacked reply telemetry only — info_schema refresh uses that
+        so a wedged worker cannot stall a catalog query."""
+        mh = self.metric_history
+        burning = self.slo.burning_names()
+        rows = [(self.node_id, "coordinator", "local",
+                 "BURNING" if burning else "OK",
+                 1 if self.ha.is_leader() else 0,
+                 round(_time.time() - self.started_at, 3),
+                 float(len(getattr(self, "sessions", []) or [])),
+                 round(mh.rate("queries_total"), 3),
+                 round(mh.rate("query_errors"), 6),
+                 int(self.admission.governor.tier()),
+                 ",".join(burning), int(mh.summary()["samples"]))]
+        for (host, port), client in sorted(self.workers.items()):
+            addr = f"{host}:{port}"
+            fenced = self.ha.worker_fenced((host, port))
+            if pull:
+                try:
+                    resp = client.sync_action("health", {})
+                except Exception:  # galaxylint: disable=swallow -- the UNREACHABLE row below IS the failure report; the sync client journals breaker state
+                    resp = None
+                if not (isinstance(resp, dict) and resp.get("ok")):
+                    rows.append((addr, "worker", addr, "UNREACHABLE",
+                                 0, 0.0, 0.0, 0.0, 0.0, 0,
+                                 "", 0))
+                    continue
+                rows.append((resp.get("node", addr), "worker", addr,
+                             "FENCED" if fenced else "OK", 0,
+                             round(float(resp.get("uptime_s", 0.0)), 3),
+                             float(resp.get("active", 0)),
+                             round(float(resp.get("qps", 0.0)), 3),
+                             round(float(resp.get("error_rate", 0.0)), 6),
+                             int(resp.get("mem_tier", 0)), "",
+                             int(resp.get("samples", 0))))
+            else:
+                rows.append((addr, "worker", addr,
+                             "FENCED" if fenced else "OK", 0,
+                             round(float(getattr(client, "load_up", 0.0)), 3),
+                             float(getattr(client, "load_q", 0) or 0),
+                             0.0, 0.0,
+                             int(getattr(client, "load_tier", 0) or 0), "",
+                             int(getattr(client, "load_samples", 0) or 0)))
         return rows
 
     def attach_remote_table(self, schema: str, name: str, host: str,
@@ -666,6 +745,18 @@ class Instance:
         if action == "invalidate_privilege_cache":
             self.privileges.invalidate_cache()
             return {"ok": True, "action": action, "node": self.node_id}
+        if action == "health":
+            # peer coordinators answer the same health pull workers do
+            mh = self.metric_history
+            mh.maybe_sample()
+            return {"ok": True, "action": action, "node": self.node_id,
+                    "uptime_s": round(_time.time() - self.started_at, 3),
+                    "active": float(len(self.sessions)),
+                    "qps": round(mh.rate("queries_total"), 3),
+                    "error_rate": round(mh.rate("query_errors"), 6),
+                    "mem_tier": int(self.admission.governor.tier()),
+                    "samples": int(mh.summary()["samples"]),
+                    "burning": self.slo.burning_names()}
         return {"ok": False, "error": f"unknown sync action {action!r}"}
 
     def sync_peer(self):

@@ -180,6 +180,13 @@ class ScheduledJobManager:
                     self.run_due()
                 except Exception:
                     pass
+                # SLO-plane sampler rides the same maintain poll but is
+                # per-node, NOT leader-gated like run_due: every node keeps
+                # its own history (interval-gated inside slo_tick)
+                try:
+                    self.instance.slo_tick()
+                except Exception:
+                    pass
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="scheduled-jobs")

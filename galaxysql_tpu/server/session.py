@@ -32,6 +32,7 @@ from galaxysql_tpu.storage.table_store import INFINITY_TS
 from galaxysql_tpu.types import datatype as dt
 from galaxysql_tpu.utils import errors, tracing
 from galaxysql_tpu.utils.ccl import GLOBAL_CCL
+from galaxysql_tpu.utils.failpoint import FAIL_POINTS, FP_SLO_LATENCY_MS
 
 
 @dataclasses.dataclass
@@ -483,6 +484,12 @@ class Session:
             if not GLOBAL_CCL.drop_rule(stmt.name) and not stmt.if_exists:
                 raise errors.TddlError(f"unknown CCL rule '{stmt.name}'")
             return ok()
+        if isinstance(stmt, ast.CreateSlo):
+            self.instance.slo.create_sql(stmt)
+            return ok()
+        if isinstance(stmt, ast.DropSlo):
+            self.instance.slo.drop_sql(stmt.name, stmt.if_exists)
+            return ok()
         if isinstance(stmt, ast.BaselineStmt):
             return self._run_baseline(stmt)
         if isinstance(stmt, ast.LoadData):
@@ -828,6 +835,21 @@ class Session:
         bump the metrics registry, aggregate into the statement-summary
         store, and apply the slow-SQL gate (the one home for the SLOW_SQL_MS
         check — point, local, and MPP paths all land here)."""
+        if FAIL_POINTS.active:
+            # SLO-plane burn determinism: inflate the OBSERVED latency of
+            # matching queries (no sleeping) so the latency histogram,
+            # statement summary, and burn windows all see the storm
+            spec = FAIL_POINTS.value(FP_SLO_LATENCY_MS)
+            if spec is not None:
+                if isinstance(spec, dict):
+                    wl_want = str(spec.get("workload", "") or "").upper()
+                    sch_want = str(spec.get("schema", "") or "").lower()
+                    if (not wl_want or wl_want == (workload or "").upper()) \
+                            and (not sch_want or sch_want ==
+                                 (prof.schema or "").lower()):
+                        elapsed += float(spec.get("ms", 0.0)) / 1000.0
+                else:
+                    elapsed += float(spec) / 1000.0
         prof.workload = workload
         prof.engine = engine
         prof.rows = rows

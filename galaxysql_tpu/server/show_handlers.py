@@ -225,14 +225,22 @@ def handle(session, stmt: ast.Show):
              dt.VARCHAR],
             ss.rows())
     if kind == "events":
-        # SHOW EVENTS: the typed instance-event journal (utils/events.py) —
-        # DDL, breaker transitions, failovers, sync heals, skew decisions,
-        # batch fallbacks, plan regressions — newest first
+        # SHOW EVENTS [WARN|INFO|CRITICAL] [LIKE 'kind%']: the typed
+        # instance-event journal (utils/events.py) — newest first.  The
+        # optional severity word and kind LIKE-pattern make slo_burn /
+        # metric_anomaly triage a one-liner instead of a journal scroll.
         import json as _json
         from galaxysql_tpu.utils.events import EVENTS
+        severity = (stmt.target or "").lower()
+        if severity and severity not in ("info", "warn", "critical"):
+            raise errors.NotSupportedError(
+                f"SHOW EVENTS severity '{stmt.target}' "
+                "(expected INFO|WARN|CRITICAL)")
         rows = [(e.seq, round(e.at, 3), e.kind, e.severity, e.node, e.detail,
                  _json.dumps(e.attrs, default=str)[:512])
-                for e in reversed(EVENTS.entries())]
+                for e in reversed(EVENTS.entries(
+                    severity=severity or None,
+                    kind_like=stmt.like or None))]
         return ResultSet(
             ["Seq", "At", "Kind", "Severity", "Node", "Detail", "Attrs"],
             [dt.BIGINT, dt.DOUBLE, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR,
@@ -352,4 +360,33 @@ def handle(session, stmt: ast.Show):
                 [dt.VARCHAR, dt.VARCHAR, dt.BIGINT, dt.VARCHAR, dt.VARCHAR,
                  dt.BIGINT], rows)
         return ResultSet(["Variable_name", "Value"], [dt.VARCHAR, dt.VARCHAR], [])
+    if kind == "slo":
+        # SHOW SLO: every objective (built-in + CREATE SLO) with its live
+        # fast/slow burn ratios and BURNING/OK state (server/slo.py)
+        return ResultSet(
+            ["Name", "Kind", "Schema", "Class", "Target", "Measured",
+             "Fast_burn", "Slow_burn", "State", "Since", "Source"],
+            [dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.DOUBLE,
+             dt.DOUBLE, dt.DOUBLE, dt.DOUBLE, dt.VARCHAR, dt.DOUBLE,
+             dt.VARCHAR],
+            session.instance.slo.rows())
+    if kind == "metric_history":
+        # SHOW METRIC HISTORY [LIKE pattern]: per-metric window summaries
+        # from the delta-encoded ring (utils/metric_history.py)
+        return ResultSet(
+            ["Metric", "Points", "Latest", "Min", "Max", "Rate_per_s"],
+            [dt.VARCHAR, dt.BIGINT, dt.DOUBLE, dt.DOUBLE, dt.DOUBLE,
+             dt.DOUBLE],
+            session.instance.metric_history.rows(stmt.like))
+    if kind == "cluster_health":
+        # SHOW CLUSTER HEALTH: this coordinator + a fresh `health` pull
+        # from every attached worker (UNREACHABLE rows, never errors)
+        return ResultSet(
+            ["Node", "Role", "Addr", "State", "Leader", "Uptime_s",
+             "Sessions", "Qps", "Error_rate", "Mem_tier", "Burning_slos",
+             "Samples"],
+            [dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.BIGINT,
+             dt.DOUBLE, dt.DOUBLE, dt.DOUBLE, dt.DOUBLE, dt.BIGINT,
+             dt.VARCHAR, dt.BIGINT],
+            session.instance.cluster_health(pull=True))
     raise errors.NotSupportedError(f"SHOW {kind}")

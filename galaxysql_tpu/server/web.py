@@ -21,6 +21,13 @@ resources served by the MPP coordinator's HTTP server).  Endpoints:
                      mesh shard, compile/transfer events attributed in place)
 - /metrics           the typed counter/gauge registry in Prometheus text
                      exposition format (the scrape endpoint)
+- /health            machine-readable liveness/readiness: SLO burn state,
+                     per-worker breaker/fence telemetry, history summary
+                     (status=degraded while any objective burns or any
+                     worker is unreachable/fenced)
+- /timeseries/<m>    one metric's windowed (ts, value) points from the
+                     delta-encoded history ring, for plotting
+- /events            journal tail; ?kind= / ?severity= / ?like= filters
 
 Read-only by design: mutations go through SQL/DAL, never HTTP.
 """
@@ -47,6 +54,14 @@ class WebConsole:
 
     def resource(self, path: str):
         inst = self.instance
+        # query-string support (only /events and /timeseries use it today):
+        # resource() is also called directly by tests with bare paths
+        query = {}
+        if "?" in path:
+            from urllib.parse import parse_qs
+            path, _, qs = path.partition("?")
+            path = path.rstrip("/") or path
+            query = {k: v[-1] for k, v in parse_qs(qs).items()}
         if path == "/status":
             return {"node_id": inst.node_id,
                     "uptime_s": round(time.time() - self.started_at, 1),
@@ -137,6 +152,63 @@ class WebConsole:
             if p is None or not p.spans:
                 return None  # untraced query: no tree to export
             return chrome_trace(p.trace_id, p.spans)
+        if path == "/health":
+            # machine-readable liveness/readiness + SLO burn state + per-
+            # worker telemetry; `status` is degraded while any objective
+            # burns or any worker is unreachable/fenced (load balancers
+            # key off this — it must render even when a worker is wedged,
+            # so worker state comes from piggybacked telemetry, no pull)
+            mh = inst.metric_history
+            burning = inst.slo.burning_names()
+            workers = []
+            degraded = bool(burning)
+            for (h, p), client in sorted(inst.workers.items()):
+                bk = client.breaker_snapshot() \
+                    if hasattr(client, "breaker_snapshot") else {"state": "closed"}
+                fenced = bool(inst.ha.worker_fenced((h, p)))
+                state = ("FENCED" if fenced else
+                         "UNREACHABLE" if bk["state"] == "open" else "OK")
+                degraded = degraded or state != "OK"
+                workers.append({"host": h, "port": p, "state": state,
+                                "breaker": bk["state"], "fenced": fenced,
+                                "queue_depth": getattr(client, "load_q", 0),
+                                "mem_tier": getattr(client, "load_tier", 0)})
+            return {"status": "degraded" if degraded else "ok",
+                    "live": True,
+                    "ready": not degraded,
+                    "node_id": inst.node_id,
+                    "leader": bool(inst.ha.is_leader()),
+                    "uptime_s": round(time.time() - inst.started_at, 1),
+                    "burning_slos": burning,
+                    "slo": [{"name": r[0], "state": r[8],
+                             "fast_burn": r[6], "slow_burn": r[7]}
+                            for r in inst.slo.rows()],
+                    "history": mh.summary(),
+                    "qps": round(mh.rate("queries_total"), 3),
+                    "error_rate": round(mh.rate("query_errors"), 6),
+                    "mem_tier": int(inst.admission.governor.tier()),
+                    "workers": workers}
+        if path.startswith("/timeseries/"):
+            # one metric's replayed (ts, value) points for plotting
+            name = path[len("/timeseries/"):]
+            mh = inst.metric_history
+            pts = mh.series(name)
+            if not pts:
+                return None  # unknown metric (or history disarmed): 404
+            return {"metric": name,
+                    "points": [[round(t, 3), v] for t, v in pts],
+                    "rate_per_s": round(mh.rate(name), 6)}
+        if path == "/events":
+            # journal tail with ?kind= / ?severity= / ?like= triage filters
+            from galaxysql_tpu.utils.events import EVENTS
+            evs = EVENTS.entries(kind=query.get("kind"),
+                                 severity=query.get("severity"),
+                                 kind_like=query.get("like"))
+            return {"events": [{"seq": e.seq, "at": round(e.at, 3),
+                                "kind": e.kind, "severity": e.severity,
+                                "node": e.node, "detail": e.detail,
+                                "attrs": e.attrs}
+                               for e in reversed(evs)]}
         return None
 
     def metrics_text(self) -> str:

@@ -567,6 +567,25 @@ class DropCclRule(Statement):
 
 
 @dataclasses.dataclass
+class CreateSlo(Statement):
+    """CREATE SLO name WITH TARGET_P99_MS = n | ERROR_RATIO = r
+    [, SCHEMA = 's'] [, CLASS = 'TP'|'AP'] — declarative service
+    objectives judged by the burn-rate engine (server/slo.py)."""
+    name: str
+    p99_ms: Optional[float] = None
+    error_ratio: Optional[float] = None
+    schema: Optional[str] = None
+    workload: Optional[str] = None
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass
+class DropSlo(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
 class KillStmt(Statement):
     conn_id: int
     query_only: bool = False

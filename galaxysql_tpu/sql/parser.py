@@ -945,6 +945,8 @@ class Parser:
             return ast.CreateDatabase(self.expect_ident(), ine)
         if self.accept_kw("CCL_RULE"):
             return self._create_ccl_rule()
+        if self.accept_kw("SLO"):
+            return self._create_slo()
         if self.accept_kw("USER"):
             ine = self._if_not_exists()
             user = self._user_name()
@@ -1437,8 +1439,49 @@ class Parser:
             raise self.error("CCL_RULE requires MAX_CONCURRENCY")
         return stmt
 
+    def _create_slo(self) -> ast.CreateSlo:
+        """CREATE SLO [IF NOT EXISTS] name WITH opt = val [, ...] — the
+        SQL surface over server/slo.py (SHOW SLO reads it back).  Exactly
+        one of TARGET_P99_MS / ERROR_RATIO is required (picks the kind);
+        SCHEMA and CLASS scope the objective to a tenant / digest class."""
+        ine = self._if_not_exists()
+        name = self.expect_ident()
+        stmt = ast.CreateSlo(name, if_not_exists=ine)
+        self.expect_kw("WITH")
+        while True:
+            opt = self.expect_ident().upper()
+            self.expect_op("=")
+            t = self.next()
+            if opt in ("TARGET_P99_MS", "ERROR_RATIO"):
+                try:
+                    val = float(t.text)
+                except ValueError:
+                    raise self.error(f"SLO {opt} expects a number")
+                if opt == "TARGET_P99_MS":
+                    stmt.p99_ms = val
+                else:
+                    stmt.error_ratio = val
+            elif opt == "SCHEMA":
+                stmt.schema = t.text
+            elif opt in ("CLASS", "WORKLOAD"):
+                stmt.workload = t.text
+            else:
+                raise self.error(f"unknown SLO option {opt}")
+            if not self.accept_op(","):
+                break
+        if (stmt.p99_ms is None) == (stmt.error_ratio is None):
+            raise self.error(
+                "SLO requires exactly one of TARGET_P99_MS or ERROR_RATIO")
+        return stmt
+
     def _drop(self) -> ast.Statement:
         self.expect_kw("DROP")
+        if self.accept_kw("SLO"):
+            ie = False
+            if self.accept_kw("IF"):
+                self.expect_kw("EXISTS")
+                ie = True
+            return ast.DropSlo(self.expect_ident(), ie)
         if self.accept_kw("CCL_RULE"):
             ie = False
             if self.accept_kw("IF"):
@@ -1555,6 +1598,14 @@ class Parser:
             stmt.kind = "statement_summary"
             if self.accept_kw("HISTORY"):
                 stmt.target = "history"
+        elif kind == "METRIC":
+            # SHOW METRIC HISTORY [LIKE pattern] (utils/metric_history.py)
+            self.expect_kw("HISTORY")
+            stmt.kind = "metric_history"
+        elif kind == "CLUSTER":
+            # SHOW CLUSTER HEALTH (coordinator + per-worker snapshots)
+            self.expect_kw("HEALTH")
+            stmt.kind = "cluster_health"
         elif kind in ("VARIABLES", "STATUS", "WARNINGS", "PROCESSLIST", "COLLATION",
                       "ENGINES", "CHARSET", "TRACE", "INDEX", "INDEXES", "KEYS"):
             if kind in ("INDEX", "INDEXES", "KEYS"):

@@ -38,12 +38,17 @@ KINDS = (
     # memory-pressure tier transitions, exhausted retry budgets
     "admission_reject", "ccl_reject", "mem_pressure",
     "retry_budget_exhausted",
+    # SLO plane (server/slo.py): burn-rate transitions over the metric
+    # history + robust-EWMA counter-rate anomalies (retrace storms,
+    # breaker flaps, shed spikes) — detection only, never fails a query
+    "slo_burn", "slo_recovered", "metric_anomaly",
 )
 
 _WARN_KINDS = frozenset({
     "breaker_open", "worker_failover", "sync_failure", "batch_fallback",
     "plan_regression", "plan_rollback", "plan_heal_failed",
     "admission_reject", "ccl_reject", "retry_budget_exhausted",
+    "slo_burn", "metric_anomaly",
 })
 
 
@@ -94,11 +99,22 @@ class EventJournal:
             self._ring.append(ev)
         return ev
 
-    def entries(self, kind: Optional[str] = None) -> List[InstanceEvent]:
+    def entries(self, kind: Optional[str] = None,
+                severity: Optional[str] = None,
+                kind_like: Optional[str] = None) -> List[InstanceEvent]:
+        """Recent tail, optionally filtered: exact `kind`, exact
+        `severity` (info|warn|critical), and/or `kind_like` — a SQL LIKE
+        pattern over the kind (SHOW EVENTS ... LIKE 'slo%' triage)."""
         with self._lock:
             evs = list(self._ring)
         if kind:
             evs = [e for e in evs if e.kind == kind]
+        if severity:
+            evs = [e for e in evs if e.severity == severity.lower()]
+        if kind_like:
+            import fnmatch
+            pat = kind_like.lower().replace("%", "*").replace("_", "?")
+            evs = [e for e in evs if fnmatch.fnmatchcase(e.kind, pat)]
         return evs
 
     def counts(self) -> Dict[str, int]:

@@ -83,6 +83,14 @@ FP_REBALANCE_AFTER_SWAP = "FP_REBALANCE_AFTER_SWAP"
 # real engine code path (storage/table_store.py `_lockdep_probe`)
 FP_LOCK_INVERT = "FP_LOCK_INVERT"
 
+# SLO-plane burn-rate determinism (server/session.py _finish_query): pad the
+# OBSERVED elapsed time of matching finished queries without sleeping — arm
+# with an int (pad every query by N ms) or a dict
+# {"ms": N, "workload": "TP", "schema": "s"} to scope the inflation to one
+# digest class / tenant; feeds the latency histogram, statement summary and
+# the SLO engine's recent-p99 windows deterministically
+FP_SLO_LATENCY_MS = "FP_SLO_LATENCY_MS"
+
 
 class FailPointError(RuntimeError):
     """Raised by an armed fail point (simulated crash)."""

@@ -223,7 +223,8 @@ class TestTypedErrorRules:
             "        g()\n"
             "    except Exception as e:\n"
             "        out['err'] = e\n",  # records the exception: handled
-            "galaxysql_tpu/net/x.py")
+            "galaxysql_tpu/net/x.py",
+            test_text="boom")  # the published kind is test-covered here
         assert rules_of(fs) == []
 
     def test_untyped_raise_flagged_on_ramp_only(self):
@@ -416,7 +417,8 @@ class TestTreeClean:
         rules = {r for ck in ALL_CHECKERS for r in ck.rules}
         assert rules == {"lock-order", "lock-blocking", "jit-raw",
                          "pallas-raw", "jit-device-sync", "swallow",
-                         "untyped-raise", "dead-failpoint", "metric-orphan"}
+                         "untyped-raise", "dead-failpoint", "metric-orphan",
+                         "event-untested", "histogram-unsampled"}
 
     def test_cli_exits_zero(self, capsys):
         assert L.main([]) == 0

@@ -1,0 +1,565 @@
+"""SLO plane: windowed metric history, burn-rate alerting, cluster health.
+
+Covers the round-16 plane end to end and deterministically:
+
+- burn/recover e2e: `FP_SLO_LATENCY_MS`-injected latency trips the fast
+  window, `slo_burn` fires (critical at >= 2x the fast threshold), SHOW SLO
+  shows BURNING and web `/health` goes degraded; disarm + a flush of good
+  queries re-arms the objective and `slo_recovered` lands
+- robust-EWMA anomaly detector: an injected compile-retrace storm fires
+  `metric_anomaly` naming `compile_retraces`
+- hatch equivalence + hot-path guards: history on vs off is bit-identical
+  with identical dispatch counts, and a sample() itself costs zero device
+  dispatches and zero host<->device transfers
+- CREATE/DROP SLO SQL (IF NOT EXISTS / IF EXISTS, typed duplicate/unknown
+  errors, kv persistence across a coordinator restart)
+- SHOW METRIC HISTORY [LIKE] / SHOW CLUSTER HEALTH / SHOW EVENTS severity +
+  kind-LIKE filtering, the three information_schema tables, web
+  `/timeseries/<metric>` + `/events`
+- delta-encoded ring eviction: trimming folds into the base so replay stays
+  exact at the retention bound
+- the worker-side `health` sync action and the cluster view's UNREACHABLE /
+  piggyback rendering
+- journal round-trip naming every published event kind, and the dynamic
+  histogram coverage check: every registry histogram's `<name>_p99`
+  expansion must land in a history sample (`segment_wall_ms`, `rpc_rtt_ms`,
+  `batch_group_size`, `batch_wait_ms`, `dml_group_size`, `dml_wait_ms`,
+  `query_latency_ms`)
+
+The `slo`-marked tests are the fast smoke target (`make slo-smoke`).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from galaxysql_tpu.exec import operators as ops
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.server.web import WebConsole
+from galaxysql_tpu.utils import errors, events
+from galaxysql_tpu.utils.events import EVENTS
+from galaxysql_tpu.utils.failpoint import FAIL_POINTS, FP_SLO_LATENCY_MS
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    FAIL_POINTS.clear()
+    yield
+    FAIL_POINTS.clear()
+
+
+def _mk(schema="slo", rows=200, data_dir=None):
+    inst = Instance(data_dir=data_dir)
+    s = Session(inst)
+    s.execute(f"CREATE DATABASE IF NOT EXISTS {schema}")
+    s.execute(f"USE {schema}")
+    if rows:
+        s.execute("CREATE TABLE t (a BIGINT PRIMARY KEY, b BIGINT)")
+        inst.store(schema, "t").insert_arrays(
+            {"a": np.arange(rows), "b": np.arange(rows) % 17},
+            inst.tso.next_timestamp())
+        s.execute("ANALYZE TABLE t")
+    return inst, s
+
+
+class _Ticker:
+    """Synthetic 5s-spaced sample ticks: real back-to-back wall-clock ticks
+    would make every counter rate astronomical, so tests stamp time."""
+
+    def __init__(self, inst):
+        self.inst = inst
+        self.t0 = time.time()
+        self.n = 0
+
+    def __call__(self, k=1):
+        for _ in range(k):
+            self.n += 1
+            assert self.inst.slo_tick(now=self.t0 + 5.0 * self.n, force=True)
+
+
+def _state(inst, name):
+    return {r[0]: r[8] for r in inst.slo.rows()}[name]
+
+
+# -- metric history: sampling, replay, eviction -------------------------------
+
+
+@pytest.mark.slo
+class TestMetricHistory:
+    def test_sample_replay_rate(self):
+        inst, s = _mk("mh1")
+        T = _Ticker(inst)
+        c = inst.metrics.counter("mh_probe", "test probe")
+        for i in range(5):
+            c.inc(10)
+            T()
+        mh = inst.metric_history
+        pts = mh.series("mh_probe")
+        assert [v for _t, v in pts] == [10.0, 20.0, 30.0, 40.0, 50.0]
+        # 10 per 5s tick -> 2/s average, exact under synthetic stamps
+        assert mh.rate("mh_probe") == pytest.approx(2.0)
+        assert mh.latest("mh_probe") == 50.0
+        assert [round(dv, 6) for _t, dv in mh.derivative("mh_probe")] \
+            == [2.0, 2.0, 2.0, 2.0]
+        assert "mh_probe" in mh.counter_names()
+        s.close()
+
+    def test_eviction_folds_into_base_replay_exact(self):
+        """Trimming past METRIC_HISTORY_SAMPLES folds the evicted delta into
+        the base snapshot — replay over the retained window stays exact."""
+        inst, s = _mk("mh2", rows=0)
+        inst.config.set_instance("METRIC_HISTORY_SAMPLES", 4)
+        T = _Ticker(inst)
+        c = inst.metrics.counter("evict_probe", "test probe")
+        for i in range(10):
+            c.inc()
+            T()
+        mh = inst.metric_history
+        assert mh.samples_count == 4
+        pts = mh.series("evict_probe")
+        assert [v for _t, v in pts] == [7.0, 8.0, 9.0, 10.0]
+        assert mh.latest("evict_probe") == 10.0
+        assert mh.mean("evict_probe") == pytest.approx(8.5)
+        s.close()
+
+    def test_hatch_off_no_samples(self):
+        inst, s = _mk("mh3", rows=0)
+        inst.config.set_instance("ENABLE_METRIC_HISTORY", 0)
+        assert inst.metric_history.sample() is None
+        assert not inst.slo_tick(force=True)
+        assert inst.metric_history.samples_count == 0
+        s.close()
+
+    def test_every_registry_histogram_lands_in_a_sample(self):
+        """Dynamic leg of the galaxylint histogram-unsampled rule: every
+        histogram the registry knows (process-shared adopted ones —
+        segment_wall_ms, rpc_rtt_ms, batch_group_size, batch_wait_ms,
+        dml_group_size, dml_wait_ms — and registry-created ones like
+        query_latency_ms) must expand into the history sample."""
+        inst, s = _mk("mh4")
+        s.execute("SELECT b FROM t WHERE a = 7")  # populate latency histo
+        vals = inst.metric_history.sample()
+        histos = sorted({n for n, k, _v, _h in inst.metrics.rows()
+                         if k == "histogram" and n.endswith("_p99")})
+        assert histos, "registry exposes no histograms?"
+        for n in histos:
+            assert n in vals, f"histogram expansion {n} missing from sample"
+        assert "query_latency_ms_p99" in vals
+        s.close()
+
+
+# -- hot-path guards: zero device work, on/off equivalence --------------------
+
+
+@pytest.mark.slo
+class TestHotPathGuards:
+    def test_sample_costs_zero_dispatches_zero_transfers(self):
+        from galaxysql_tpu.exec.device_cache import TRANSFER_STATS
+        inst, s = _mk("hp1")
+        s.execute("SELECT b FROM t WHERE a < 50")  # warm + populate metrics
+        ops.reset_dispatch_stats()
+        x0 = TRANSFER_STATS["transfers"]
+        for _ in range(5):
+            assert inst.metric_history.sample() is not None
+            inst.slo.evaluate()
+        assert ops.DISPATCH_STATS["dispatches"] == 0
+        assert TRANSFER_STATS["transfers"] == x0
+        s.close()
+
+    def test_history_on_off_bit_identical_same_dispatches(self):
+        from galaxysql_tpu.exec.device_cache import TRANSFER_STATS
+        inst, s = _mk("hp2", rows=3000)
+        q = "SELECT a, b * 3 FROM t WHERE a < 1500"
+        s.execute(q)  # warmup: compile
+        ops.reset_dispatch_stats()
+        x0 = TRANSFER_STATS["transfers"]
+        on = s.execute(q)  # history ON (default), sampler constructed
+        inst.slo_tick(force=True)
+        d_on = ops.DISPATCH_STATS["dispatches"]
+        x_on = TRANSFER_STATS["transfers"] - x0
+        inst.config.set_instance("ENABLE_METRIC_HISTORY", 0)
+        ops.reset_dispatch_stats()
+        x0 = TRANSFER_STATS["transfers"]
+        off = s.execute(q)
+        inst.slo_tick(force=True)  # no-op while the hatch is off
+        assert ops.DISPATCH_STATS["dispatches"] == d_on
+        assert TRANSFER_STATS["transfers"] - x0 == x_on
+        assert on.rows == off.rows
+        s.close()
+
+
+# -- the burn/recover e2e (the acceptance scenario) ---------------------------
+
+
+@pytest.mark.slo
+class TestBurnRecover:
+    def test_injected_latency_trips_fast_window_then_recovers(self):
+        EVENTS.clear()
+        inst, s = _mk("burn")
+        inst.config.set_instance("SLO_FAST_WINDOW_SAMPLES", 2)
+        inst.config.set_instance("SLO_SLOW_WINDOW_SAMPLES", 4)
+        T = _Ticker(inst)
+
+        def run(n):
+            for i in range(n):
+                s.execute(f"SELECT b FROM t WHERE a = {i % 200}")
+
+        # steady state: enough samples to judge, nothing burns
+        run(10)
+        T(4)
+        assert _state(inst, "tp_latency_p99") == "OK"
+        assert inst.slo.burning_names() == []
+
+        # inject a 10s pad on every TP query: recent_p99 blows 40x past the
+        # 250ms default target — fast AND slow windows burn
+        FAIL_POINTS.arm(FP_SLO_LATENCY_MS, {"ms": 10000, "workload": "TP"})
+        run(20)
+        T(3)
+        assert _state(inst, "tp_latency_p99") == "BURNING"
+        assert "tp_latency_p99" in inst.slo.burning_names()
+        burn = EVENTS.entries(kind="slo_burn")
+        assert burn and burn[-1].severity == "critical"  # >= 2x fast thresh
+        assert burn[-1].attrs["slo"] == "tp_latency_p99"
+        assert float(burn[-1].attrs["fast_burn"]) >= 2.0
+        # the gauge tracks the burn set
+        reg = {n: v for n, _k, v, _h in inst.metrics.rows()}
+        assert reg["slo_burn_active"] >= 1
+
+        # web /health degrades while burning (readiness for load balancers)
+        h = WebConsole(inst).resource("/health")
+        assert h["status"] == "degraded" and not h["ready"]
+        assert "tp_latency_p99" in h["burning_slos"]
+
+        # recovery: disarm, flush the 128-deep class ring with good queries
+        FAIL_POINTS.disarm(FP_SLO_LATENCY_MS)
+        run(140)
+        T(3)
+        assert _state(inst, "tp_latency_p99") == "OK"
+        rec = EVENTS.entries(kind="slo_recovered")
+        assert rec and rec[-1].severity == "info"
+        assert rec[-1].attrs["slo"] == "tp_latency_p99"
+        h = WebConsole(inst).resource("/health")
+        assert h["status"] == "ok" and h["ready"]
+        s.close()
+
+    def test_scoped_slo_burns_only_its_tenant(self):
+        """A CREATE SLO scoped to one schema judges that tenant's digest
+        class only: padding a different schema leaves it OK."""
+        EVENTS.clear()
+        inst, s = _mk("ten_a")
+        s2 = Session(inst)
+        s2.execute("CREATE DATABASE ten_b")
+        s2.execute("USE ten_b")
+        s2.execute("CREATE TABLE t (a BIGINT PRIMARY KEY, b BIGINT)")
+        inst.store("ten_b", "t").insert_arrays(
+            {"a": np.arange(50), "b": np.arange(50)},
+            inst.tso.next_timestamp())
+        inst.config.set_instance("SLO_FAST_WINDOW_SAMPLES", 2)
+        inst.config.set_instance("SLO_SLOW_WINDOW_SAMPLES", 4)
+        s.execute("CREATE SLO tenant_a_p99 WITH TARGET_P99_MS = 250, "
+                  "SCHEMA = 'ten_a', CLASS = 'TP'")
+        T = _Ticker(inst)
+        for i in range(10):
+            s.execute(f"SELECT b FROM t WHERE a = {i}")
+            s2.execute(f"SELECT b FROM t WHERE a = {i}")
+        T(4)
+        assert _state(inst, "tenant_a_p99") == "OK"
+        # pad ONLY schema ten_b: the ten_a-scoped objective must stay OK
+        FAIL_POINTS.arm(FP_SLO_LATENCY_MS,
+                        {"ms": 10000, "workload": "TP", "schema": "ten_b"})
+        for i in range(20):
+            s2.execute(f"SELECT b FROM t WHERE a = {i % 50}")
+        T(3)
+        assert _state(inst, "tenant_a_p99") == "OK"
+        # now pad ten_a too: the scoped objective trips
+        FAIL_POINTS.arm(FP_SLO_LATENCY_MS,
+                        {"ms": 10000, "workload": "TP", "schema": "ten_a"})
+        for i in range(20):
+            s.execute(f"SELECT b FROM t WHERE a = {i % 200}")
+        T(3)
+        assert _state(inst, "tenant_a_p99") == "BURNING"
+        s2.close()
+        s.close()
+
+
+# -- the anomaly detector -----------------------------------------------------
+
+
+@pytest.mark.slo
+class TestAnomalyDetector:
+    def test_retrace_storm_fires_metric_anomaly(self):
+        EVENTS.clear()
+        inst, s = _mk("anom")
+        T = _Ticker(inst)
+        before = ops.COMPILE_STATS["retraces"]
+        try:
+            # warm-up: stable rates establish the EWMA baseline
+            for i in range(6):
+                s.execute(f"SELECT b FROM t WHERE a = {i}")
+                T()
+            assert not EVENTS.entries(kind="metric_anomaly")
+            # storm: a retrace burst far past mean + sigma * dev
+            ops.COMPILE_STATS["retraces"] += 5000
+            T()
+            anom = EVENTS.entries(kind="metric_anomaly")
+            assert any(e.attrs.get("metric") == "compile_retraces"
+                       for e in anom)
+            hit = [e for e in anom
+                   if e.attrs.get("metric") == "compile_retraces"][-1]
+            assert hit.severity == "warn"
+            assert float(hit.attrs["rate"]) > float(hit.attrs["baseline"])
+            # transition-edged: a second storm tick while still firing does
+            # not re-publish for the same metric
+            n0 = len(EVENTS.entries(kind="metric_anomaly"))
+            ops.COMPILE_STATS["retraces"] += 5000
+            T()
+            again = [e for e in EVENTS.entries(kind="metric_anomaly")[n0:]
+                     if e.attrs.get("metric") == "compile_retraces"]
+            assert not again
+        finally:
+            ops.COMPILE_STATS["retraces"] = before
+        s.close()
+
+
+# -- CREATE / DROP SLO SQL ----------------------------------------------------
+
+
+@pytest.mark.slo
+class TestSloSql:
+    def test_create_show_drop_round_trip(self):
+        inst, s = _mk("sql1", rows=0)
+        s.execute("CREATE SLO gold_tp WITH TARGET_P99_MS = 100, "
+                  "SCHEMA = 'sql1', CLASS = 'TP'")
+        rows = {r[0]: r for r in s.execute("SHOW SLO").rows}
+        assert "gold_tp" in rows
+        assert rows["gold_tp"][1] == "latency_p99"
+        assert rows["gold_tp"][2] == "sql1" and rows["gold_tp"][3] == "TP"
+        assert rows["gold_tp"][4] == 100.0
+        assert rows["gold_tp"][10] == "sql"
+        # built-ins present with live config-backed targets
+        assert rows["tp_latency_p99"][10] == "default"
+        assert rows["typed_error_ratio"][1] == "error_ratio"
+        # typed errors: duplicate create, unknown drop
+        with pytest.raises(errors.TddlError):
+            s.execute("CREATE SLO gold_tp WITH TARGET_P99_MS = 50")
+        s.execute("CREATE SLO IF NOT EXISTS gold_tp WITH TARGET_P99_MS = 50")
+        assert {r[0]: r for r in s.execute("SHOW SLO").rows}[
+            "gold_tp"][4] == 100.0  # unchanged
+        with pytest.raises(errors.TddlError):
+            s.execute("CREATE SLO bad WITH TARGET_P99_MS = 1, "
+                      "ERROR_RATIO = 0.1")  # exactly-one-of
+        with pytest.raises(errors.TddlError):
+            s.execute("CREATE SLO bad WITH ERROR_RATIO = -1")
+        s.execute("DROP SLO gold_tp")
+        assert "gold_tp" not in {r[0] for r in s.execute("SHOW SLO").rows}
+        with pytest.raises(errors.TddlError):
+            s.execute("DROP SLO gold_tp")
+        s.execute("DROP SLO IF EXISTS gold_tp")
+        s.close()
+
+    def test_persists_across_coordinator_restart(self, tmp_path):
+        d = str(tmp_path / "slokv")
+        inst, s = _mk("sql2", rows=0, data_dir=d)
+        s.execute("CREATE SLO durable_err WITH ERROR_RATIO = 0.05, "
+                  "SCHEMA = 'sql2'")
+        s.close()
+        inst2 = Instance(data_dir=d)
+        names = {d_.name: d_ for d_ in inst2.slo.defs()}
+        assert "durable_err" in names
+        assert names["durable_err"].kind == "error_ratio"
+        assert names["durable_err"].target == 0.05
+        assert names["durable_err"].schema == "sql2"
+        # DROP unpersists: gone after another restart
+        Session(inst2).execute("DROP SLO durable_err")
+        inst3 = Instance(data_dir=d)
+        assert "durable_err" not in {d_.name for d_ in inst3.slo.defs()}
+
+
+# -- surfaces: SHOW / information_schema / web --------------------------------
+
+
+@pytest.mark.slo
+class TestSurfaces:
+    def test_show_metric_history_like(self):
+        inst, s = _mk("surf1")
+        s.execute("SELECT b FROM t WHERE a = 1")
+        _Ticker(inst)(2)
+        rows = s.execute("SHOW METRIC HISTORY LIKE 'queries%'").rows
+        assert rows and all(r[0].startswith("queries") for r in rows)
+        by_name = {r[0]: r for r in rows}
+        assert by_name["queries_total"][2] >= 1  # latest
+        assert by_name["queries_total"][1] == 2  # points
+        all_rows = s.execute("SHOW METRIC HISTORY").rows
+        assert len(all_rows) > len(rows)
+        assert any(r[0] == "stmt_class_tp_recent_p99_ms" for r in all_rows)
+        assert any(r[0] == "admission_tp_limit" for r in all_rows)
+        s.close()
+
+    def test_show_cluster_health_and_unreachable_worker(self):
+        inst, s = _mk("surf2")
+        s.execute("SELECT b FROM t WHERE a = 1")
+        _Ticker(inst)(2)
+        rows = s.execute("SHOW CLUSTER HEALTH").rows
+        assert len(rows) == 1
+        node, role, addr, state, leader = rows[0][:5]
+        assert role == "coordinator" and state == "OK" and leader == 1
+        assert rows[0][11] >= 2  # samples
+
+        # a dead worker renders an UNREACHABLE row, never an exception
+        class _DeadClient:
+            def sync_action(self, *a, **kw):
+                raise ConnectionError("down")
+        inst.workers[("127.0.0.1", 1)] = _DeadClient()
+        rows = s.execute("SHOW CLUSTER HEALTH").rows
+        assert [r[3] for r in rows if r[1] == "worker"] == ["UNREACHABLE"]
+
+        # piggyback rendering (pull=False: info_schema path) uses the
+        # telemetry fields the reply legs maintain — no sync round-trip
+        class _IdleClient:
+            load_q, load_tier, load_up, load_samples = 3, 1, 42.0, 7
+        inst.workers[("127.0.0.1", 1)] = _IdleClient()
+        wrow = [r for r in inst.cluster_health(pull=False)
+                if r[1] == "worker"][0]
+        assert wrow[3] == "OK" and wrow[5] == 42.0 and wrow[6] == 3.0
+        assert wrow[9] == 1 and wrow[11] == 7
+        s.close()
+
+    def test_information_schema_tables(self):
+        inst, s = _mk("surf3")
+        s.execute("SELECT b FROM t WHERE a = 1")
+        _Ticker(inst)(2)
+        slo = s.execute("SELECT slo_name, state FROM "
+                        "information_schema.slo_status").rows
+        assert ("tp_latency_p99", "OK") in slo
+        mh = s.execute("SELECT metric_name, points FROM "
+                       "information_schema.metric_history "
+                       "WHERE metric_name = 'queries_total'").rows
+        assert mh == [("queries_total", 2)]
+        ch = s.execute("SELECT role, state FROM "
+                       "information_schema.cluster_health").rows
+        assert ("coordinator", "OK") in ch
+        s.close()
+
+    def test_web_timeseries_and_events(self):
+        inst, s = _mk("surf4")
+        s.execute("SELECT b FROM t WHERE a = 1")
+        _Ticker(inst)(3)
+        web = WebConsole(inst)
+        ts = web.resource("/timeseries/queries_total")
+        assert ts["metric"] == "queries_total" and len(ts["points"]) == 3
+        assert web.resource("/timeseries/no_such_metric") is None  # 404
+        EVENTS.clear()
+        EVENTS.publish("slo_burn", detail="drill", severity="critical")
+        EVENTS.publish("ddl", detail="drill")
+        evs = web.resource("/events?kind=slo_burn")
+        assert [e["kind"] for e in evs["events"]] == ["slo_burn"]
+        evs = web.resource("/events?severity=critical")
+        assert evs["events"] and all(e["severity"] == "critical"
+                                     for e in evs["events"])
+        evs = web.resource("/events?like=slo%")
+        assert [e["kind"] for e in evs["events"]] == ["slo_burn"]
+        s.close()
+
+    def test_show_events_severity_and_like(self):
+        inst, s = _mk("surf5", rows=0)
+        EVENTS.clear()
+        EVENTS.publish("slo_burn", detail="d1", severity="critical")
+        EVENTS.publish("slo_recovered", detail="d2")
+        EVENTS.publish("breaker_open", detail="d3")
+        rows = s.execute("SHOW EVENTS").rows
+        assert len(rows) >= 3
+        rows = s.execute("SHOW EVENTS CRITICAL").rows
+        assert {r[2] for r in rows} == {"slo_burn"}
+        rows = s.execute("SHOW EVENTS LIKE 'slo%'").rows
+        assert {r[2] for r in rows} == {"slo_burn", "slo_recovered"}
+        rows = s.execute("SHOW EVENTS INFO LIKE 'slo%'").rows
+        assert {r[2] for r in rows} == {"slo_recovered"}
+        with pytest.raises(errors.NotSupportedError):
+            s.execute("SHOW EVENTS LOUD")
+        s.close()
+
+
+# -- worker-side sampler + health sync action ---------------------------------
+
+
+@pytest.mark.slo
+class TestWorkerHealth:
+    def test_health_sync_action(self, tmp_path):
+        from galaxysql_tpu.net.worker import Worker
+        w = Worker(data_dir=str(tmp_path / "whealth"))
+        resp, arrays = w._sync({"action": "health"})
+        assert resp["ok"] and resp["action"] == "health"
+        assert resp["node"] == w.instance.node_id
+        assert resp["samples"] >= 1  # the pull itself sampled
+        assert resp["burning"] == [] and resp["mem_tier"] == 0
+        assert resp["uptime_s"] >= 0.0 and arrays == {}
+
+
+# -- journal round-trip: every published kind, filtered retrieval -------------
+
+
+# Every event kind the package publishes (galaxylint's event-untested rule
+# keeps this honest: a kind published anywhere must be named by a test).
+ALL_EVENT_KINDS = (
+    # core + distributed plane
+    "ddl", "breaker_open", "breaker_close", "worker_failover",
+    "sync_failure", "sync_heal", "worker_telemetry_failed",
+    "session_close_failed", "replica_cleanup_failed", "async_apply_failed",
+    # execution tiers
+    "skew_activate", "skew_deactivate", "batch_fallback",
+    # self-heal loop
+    "plan_regression", "plan_rollback", "stats_repair", "plan_promoted",
+    "plan_heal_failed",
+    # resource governance
+    "admission_reject", "ccl_reject", "mem_pressure",
+    "retry_budget_exhausted",
+    # SLO plane
+    "slo_burn", "slo_recovered", "metric_anomaly",
+)
+
+
+@pytest.mark.slo
+class TestJournalRoundTrip:
+    def test_all_kinds_publish_default_severity_and_filter(self):
+        assert set(ALL_EVENT_KINDS) >= set(events.KINDS)
+        EVENTS.clear()
+        for k in ALL_EVENT_KINDS:
+            EVENTS.publish(k, detail=f"drill {k}")
+        got = EVENTS.entries()
+        assert {e.kind for e in got} >= set(ALL_EVENT_KINDS)
+        # failure-shaped kinds default to warn severity, the rest to info
+        by_kind = {e.kind: e for e in got}
+        assert by_kind["slo_burn"].severity == "warn"
+        assert by_kind["metric_anomaly"].severity == "warn"
+        assert by_kind["slo_recovered"].severity == "info"
+        assert by_kind["breaker_open"].severity == "warn"
+        assert by_kind["sync_heal"].severity == "info"
+        # filtered retrieval composes: severity AND kind_like
+        warn_slo = EVENTS.entries(severity="warn", kind_like="slo%")
+        assert {e.kind for e in warn_slo} == {"slo_burn"}
+
+
+# -- parser coverage ----------------------------------------------------------
+
+
+@pytest.mark.slo
+class TestParser:
+    def test_create_drop_slo_and_show_forms(self):
+        from galaxysql_tpu.sql import ast as A
+        from galaxysql_tpu.sql.parser import parse
+        st = parse("CREATE SLO IF NOT EXISTS x WITH TARGET_P99_MS = 10.5, "
+                   "SCHEMA = 'd', CLASS = 'AP'")
+        assert isinstance(st, A.CreateSlo)
+        assert st.if_not_exists and st.name == "x"
+        assert st.p99_ms == 10.5 and st.error_ratio is None
+        assert st.schema == "d" and st.workload == "AP"
+        st = parse("CREATE SLO y WITH ERROR_RATIO = 0.01")
+        assert st.error_ratio == 0.01 and st.p99_ms is None
+        st = parse("DROP SLO IF EXISTS y")
+        assert isinstance(st, A.DropSlo) and st.if_exists
+        assert parse("SHOW SLO").kind == "slo"
+        assert parse("SHOW METRIC HISTORY LIKE 'q%'").kind == "metric_history"
+        assert parse("SHOW CLUSTER HEALTH").kind == "cluster_health"
+        assert parse("SHOW EVENTS WARN").target.upper() == "WARN"
