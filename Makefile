@@ -196,8 +196,23 @@ slo-smoke:
 bench-slo:
 	JAX_PLATFORMS=cpu $(PY) bench.py --slo-only
 
+# serving-tier smoke: the router marker suite — consistent-hash affinity,
+# session pinning + typed-once failover, cluster-wide admission gossip,
+# placement-driven locality, SHOW COORDINATORS / SHOW CLUSTER surfaces,
+# the hatch trio, and the coordinator-kill chaos test over real
+# subprocesses.  Lockdep-armed: router/gossip paths hold instance locks.
+scaleout-smoke:
+	JAX_PLATFORMS=cpu GALAXYSQL_LOCKDEP=1 $(PY) -m pytest tests/ -q \
+		-m router -p no:cacheprovider
+
+# serving-tier curve: 1/2/4 coordinator subprocesses over one shared
+# metadb, closed-loop point workload through the front router — aggregate
+# QPS, p99, affinity hit rate, gossip staleness into BENCH_r12.json
+bench-scaleout:
+	JAX_PLATFORMS=cpu $(PY) bench.py --scaleout-only
+
 .PHONY: tier1 fusion-smoke obs-smoke rf-smoke cache-smoke trace-smoke bench \
 	batch-smoke chaos-smoke skew-smoke bench-skew summary-smoke heal-smoke \
 	overload-smoke bench-overload dml-smoke bench-dml lint lint-smoke \
 	rebalance-smoke chaos-rebalance bench-rebalance kernel-smoke \
-	bench-kernels slo-smoke bench-slo
+	bench-kernels slo-smoke bench-slo scaleout-smoke bench-scaleout

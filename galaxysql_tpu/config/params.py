@@ -360,6 +360,35 @@ PLAN_HEAL_COOLDOWN_S = _p(
     "flap damping: minimum seconds between heal episodes of one digest; "
     "regressions inside the window stay detect-only")
 
+# --- serving tier (server/router.py, multi-coordinator scale-out) ------------
+ENABLE_ROUTER = _p(
+    "ENABLE_ROUTER", True,
+    "front-router statement dispatch across peer coordinators (session + "
+    "digest affinity); OFF routes everything to the local instance — the "
+    "single-coordinator path never touches the router either way")
+ROUTER_VNODES = _p(
+    "ROUTER_VNODES", 64,
+    "virtual nodes per peer on the consistent-hash ring (digest affinity); "
+    "more vnodes = smoother spread, slower ring rebuilds")
+ROUTER_GOSSIP_INTERVAL_S = _p(
+    "ROUTER_GOSSIP_INTERVAL_S", 1.0,
+    "seconds between router gossip rounds (health + admission snapshots "
+    "pulled from every peer; interval-gated on the serving path)")
+GOSSIP_FRESH_S = _p(
+    "GOSSIP_FRESH_S", 5.0,
+    "peer gossip snapshots older than this are ignored: stale admission "
+    "limits must not throttle a healthy peer forever")
+ENABLE_CLUSTER_ADMISSION = _p(
+    "ENABLE_CLUSTER_ADMISSION", True,
+    "clamp local per-class admission limits to the min of fresh peer "
+    "limits (gossiped over the health sync action): a flood shed on peer "
+    "A is not re-admitted by peer B")
+COORDINATOR_GROUPS = _p(
+    "COORDINATOR_GROUPS", "",
+    "csv of placement-group labels this coordinator serves locally; the "
+    "router prefers the peer co-located with a statement's dominant "
+    "partition group (server/placement.py)")
+
 # --- misc ---------------------------------------------------------------------
 SQL_SELECT_LIMIT = _p("SQL_SELECT_LIMIT", -1, "-1 = unlimited")
 SLOW_SQL_MS = _p("SLOW_SQL_MS", 1000, "slow query log threshold")

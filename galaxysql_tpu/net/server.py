@@ -422,15 +422,139 @@ class MySQLServer:
         await self._server.serve_forever()
 
 
+class CoordinatorSyncListener:
+    """dn-wire sync endpoint on a COORDINATOR process.
+
+    The serving tier's gossip plane: a front router dials this port with
+    the same `WorkerClient` it uses for workers, so `ping`/`sync` ops —
+    and FP_RPC_* failpoints, the circuit breaker, retry budgets — work
+    against peer coordinators unchanged.  `sync` dispatches into
+    `Instance.apply_sync_action` (the `health` action carries admission
+    gossip both ways); every reply piggybacks the same `wl` load block
+    workers ship, so the router weighs peers by queue depth and memory
+    tier without a dedicated probe RPC.
+    """
+
+    def __init__(self, instance: Instance):
+        self.instance = instance
+        self.port = 0
+        self._srv = None
+        self._thread = None
+
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        import socket
+        import threading
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(16)
+        self.port = srv.getsockname()[1]
+        self._srv = srv
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        args=(srv,), daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+            self._srv = None
+
+    def _accept_loop(self, srv):
+        import threading
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, header: dict) -> dict:
+        import time as _t
+        inst = self.instance
+        op = header.get("op")
+        if op == "ping":
+            resp = {"ok": True, "node": inst.node_id}
+        elif op == "sync":
+            try:
+                resp = inst.apply_sync_action(header.get("action"),
+                                              header.get("payload") or {})
+            except Exception as e:
+                resp = {"error": f"{type(e).__name__}: {e}",
+                        "errno": int(getattr(e, "errno", 1105) or 1105)}
+        else:
+            resp = {"error": f"unknown op {op!r} (coordinator sync plane "
+                             f"serves ping/sync only)"}
+        if isinstance(resp, dict) and "wl" not in resp:
+            try:
+                adm = inst.admission
+                snap = adm.cluster_snapshot()
+                q = int(snap["tp"]["inflight"] + snap["ap"]["inflight"])
+                resp["wl"] = {"q": q, "mt": adm.governor.tier(),
+                              "up": round(_t.time() - inst.started_at, 1),
+                              "ns": inst.metric_history.samples_count}
+            except Exception:  # galaxylint: disable=swallow -- load telemetry must never fail a gossip reply; workers do the same
+                pass
+        return resp
+
+    def _serve_conn(self, conn):
+        import socket
+        from galaxysql_tpu.net.dn import recv_msg, send_msg
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                header, _arrays = recv_msg(conn)
+                send_msg(conn, self._handle(header), {})
+        except (ConnectionError, OSError, errors.ProtocolError):
+            pass  # peer hung up / corrupt frame: drop the connection
+        finally:
+            conn.close()
+
+
 def main():  # pragma: no cover - manual entry point
     import argparse
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, default=3406)
     ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--sync-port", type=int, default=-1,
+                    help="coordinator sync-plane port (0 = auto, -1 = off)")
+    ap.add_argument("--data-dir", default=None,
+                    help="shared metadb/data directory (serving tier peers "
+                         "point at the same one)")
+    ap.add_argument("--init-sql", default=None,
+                    help="semicolon-separated bootstrap statements")
+    ap.add_argument("--platform", default=None,
+                    help="force the jax platform (e.g. cpu) in-process")
+    ap.add_argument("--announce", action="store_true",
+                    help="print 'SERVER_READY <mysql_port> <sync_port>' "
+                         "once listening (bench/chaos harness handshake)")
     args = ap.parse_args()
-    inst = Instance()
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    inst = Instance(data_dir=args.data_dir) if args.data_dir else Instance()
+    if args.init_sql:
+        sess = Session(inst)
+        sess.execute_all(args.init_sql)
+        sess.close()
+    sync = None
+    if args.sync_port >= 0:
+        sync = CoordinatorSyncListener(inst)
+        sync.start(args.host, args.sync_port)
     server = MySQLServer(inst, args.host, args.port)
-    asyncio.run(server.serve_forever())
+
+    async def _serve():
+        await server.start()
+        if args.announce:
+            print(f"SERVER_READY {server.port} "
+                  f"{sync.port if sync else -1}", flush=True)
+        await server._server.serve_forever()
+
+    asyncio.run(_serve())
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -236,6 +236,13 @@ class AdmissionController:
         self.shed_deadline = 0
         self.shed_memory = 0
         self._stats_lock = threading.Lock()
+        # cluster gossip (serving tier): node -> (snapshot, received_at) fed
+        # by the health sync action / router gossip_tick.  The hot path only
+        # reads `_cluster_min` (one dict get) — recomputed lazily when the
+        # freshness window rolls, never per-admit.
+        self._peer_snaps: Dict[str, Tuple[dict, float]] = {}
+        self._cluster_min: Dict[str, float] = {}
+        self._cluster_expire = 0.0
 
     # -- config ---------------------------------------------------------------
 
@@ -278,6 +285,99 @@ class AdmissionController:
                 "ADMISSION_TARGET_TP_MS" if cls == "TP"
                 else "ADMISSION_TARGET_AP_MS"),
             100 if cls == "TP" else 5000))
+
+    # -- cluster gossip (serving tier) ----------------------------------------
+
+    def cluster_snapshot(self) -> dict:
+        """This node's admission state as gossiped to peers (rides the
+        `health` sync action reply): per-class AIMD limit + in-flight, plus
+        total sheds.  Small and JSON-plain — it travels the dn wire."""
+        snap = {"node": self.instance.node_id}
+        for cls in ("TP", "AP"):
+            snap[cls.lower()] = {
+                "limit": round(self.limit(cls), 2),
+                "inflight": len(self._tokens[cls]),
+                "ewma_ms": round(self._ewma[cls], 2),
+            }
+        snap["shed"] = (self.shed_queue_full + self.shed_timeout +
+                        self.shed_deadline + self.shed_memory)
+        return snap
+
+    def note_peer(self, node: str, snap: Optional[dict],
+                  at: Optional[float] = None):
+        """Record a peer coordinator's gossiped admission snapshot.  Feeds
+        effective_limit(): the cluster-wide clamp is min(local AIMD limit,
+        fresh peer limits) — a flood that collapsed peer A's AP limit drags
+        every peer's effective AP limit down with it until A recovers."""
+        if not node or node == self.instance.node_id \
+                or not isinstance(snap, dict):
+            return
+        with self._stats_lock:
+            self._peer_snaps[node] = (snap, at if at is not None
+                                      else time.time())
+            self._cluster_expire = 0.0  # force a lazy recompute
+
+    def forget_peer(self, node: str):
+        with self._stats_lock:
+            self._peer_snaps.pop(node, None)
+            self._cluster_expire = 0.0
+
+    def _fresh_s(self) -> float:
+        v = self.instance.config.get("GOSSIP_FRESH_S")
+        return float(v) if v is not None else 5.0
+
+    def _recompute_cluster(self, now: float):
+        """Rebuild the per-class min over FRESH peer limits.  `_cluster_expire`
+        is set to the earliest moment the picture can change (a snapshot
+        aging out or the freshness window), so the admit fast path pays one
+        float compare between recomputes."""
+        fresh = self._fresh_s()
+        with self._stats_lock:
+            mins: Dict[str, float] = {}
+            next_expire = now + fresh
+            for node, (snap, at) in list(self._peer_snaps.items()):
+                age = now - at
+                if age > max(fresh * 4, 30.0):
+                    del self._peer_snaps[node]  # long-dead peer: drop it
+                    continue
+                if age > fresh:
+                    continue  # stale: ignored but retained for SHOW rows
+                next_expire = min(next_expire, at + fresh)
+                for cls in ("TP", "AP"):
+                    ent = snap.get(cls.lower())
+                    if isinstance(ent, dict) and "limit" in ent:
+                        lim = float(ent["limit"])
+                        mins[cls] = min(mins.get(cls, lim), lim)
+            self._cluster_min = mins
+            self._cluster_expire = next_expire
+
+    def effective_limit(self, cls: str) -> float:
+        """The limit admit() enforces: the local AIMD limit clamped to the
+        min of fresh peer limits when cluster admission is on.  Floors at
+        ADMISSION_MIN_LIMIT — a peer's collapse throttles, never starves.
+        Single-coordinator cost: one empty-dict check."""
+        lim = self.limit(cls)
+        if not self._peer_snaps:
+            return lim
+        if not self.instance.config.get("ENABLE_CLUSTER_ADMISSION"):
+            return lim
+        now = time.time()
+        if now > self._cluster_expire:
+            self._recompute_cluster(now)
+        m = self._cluster_min.get(cls)
+        if m is None or m >= lim:
+            return lim
+        floor = float(self._cfg_int(
+            self.instance.config.get("ADMISSION_MIN_LIMIT"), 1))
+        return max(floor, m)
+
+    def peer_gossip_rows(self):
+        """(node, snapshot, age_s) for SHOW COORDINATORS — stale peers
+        included (the age column IS the staleness report)."""
+        now = time.time()
+        with self._stats_lock:
+            return [(node, dict(snap), now - at)
+                    for node, (snap, at) in sorted(self._peer_snaps.items())]
 
     # -- classification -------------------------------------------------------
 
@@ -327,7 +427,7 @@ class AdmissionController:
                            retry_after_ms=500)
         tokens = self._tokens[cls]
         tokens.append(None)  # optimistic claim (GIL-atomic)
-        if len(tokens) <= self.limit(cls):
+        if len(tokens) <= self.effective_limit(cls):
             # idle/uncontended fast path: no lock was taken
             self.admitted[cls] += 1  # benign GIL race; aggregate insight
             return _Ticket(self, cls, digest)
@@ -360,7 +460,7 @@ class AdmissionController:
             try:
                 while True:
                     tokens = self._tokens[cls]
-                    if len(tokens) < self.limit(cls):
+                    if len(tokens) < self.effective_limit(cls):
                         tokens.append(None)
                         self.admitted[cls] += 1
                         return _Ticket(self, cls, digest)
@@ -486,6 +586,8 @@ class AdmissionController:
         for cls in ("TP", "AP"):
             rows += [
                 (f"{cls.lower()}_limit", float(self.limit(cls))),
+                (f"{cls.lower()}_effective_limit",
+                 float(self.effective_limit(cls))),
                 (f"{cls.lower()}_inflight", float(len(self._tokens[cls]))),
                 (f"{cls.lower()}_queue_depth", float(self._nwait[cls])),
                 (f"{cls.lower()}_admitted", float(self.admitted[cls])),

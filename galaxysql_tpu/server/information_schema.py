@@ -132,6 +132,11 @@ _DEFS: Dict[str, List] = {
         ("leader", _I), ("uptime_s", _D), ("sessions", _D), ("qps", _D),
         ("error_rate", _D), ("mem_tier", _I), ("burning_slos", _V),
         ("samples", _I)],
+    "coordinators": [
+        ("node_id", _V), ("role", _V), ("state", _V), ("epoch", _I),
+        ("tp_limit", _D), ("ap_limit", _D), ("tp_inflight", _D),
+        ("ap_inflight", _D), ("routed", _I), ("affinity_ratio", _D),
+        ("gossip_age_s", _D)],
 }
 
 
@@ -278,3 +283,7 @@ def refresh(instance, session=None):
     # only — a wedged worker must not stall an unrelated catalog query
     fill("cluster_health",
          (list(r) for r in instance.cluster_health(pull=False)))
+    # pull=False: serving-tier rows render from gossip snapshots only —
+    # the same no-stall rule as cluster_health
+    fill("coordinators",
+         (list(r) for r in instance.coordinator_rows(pull=False)))

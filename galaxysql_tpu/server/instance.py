@@ -170,6 +170,16 @@ class Instance:
         self.rebalance_shadows: Dict[str, object] = {}
         from galaxysql_tpu.server.balancer import Balancer
         self.balancer = Balancer(self)
+        # physical placement bindings (server/placement.py): group label ->
+        # worker endpoint / coordinator / device, persisted in the shared
+        # metadb so MOVE PARTITION changes real locality cluster-wide
+        from galaxysql_tpu.server.placement import PlacementBinding
+        self.placement = PlacementBinding(self)
+        # serving tier peer registry: node_id -> sync endpoint (sync_peer()
+        # object or a dn-wire client to a remote coordinator's sync listener).
+        # Maintained by attach_coordinator/detach_coordinator; the front
+        # router (server/router.py) and the SHOW CLUSTER merges read it.
+        self.coordinators: Dict[str, object] = {}
         # named for the lockdep witness (unranked class "instance"); a plain
         # RLock when lockdep is disarmed — the default
         from galaxysql_tpu.utils.lockdep import named_lock
@@ -711,9 +721,22 @@ class Instance:
         # still serve).  Stale load reports (>5s) decay to neutral.
         import time as _t
         now = _t.time()
+        # physical-placement locality: the endpoint bound to this table's
+        # dominant group (server/placement.py) gets a 4x boost — MOVE
+        # PARTITION into a bound group shifts real read traffic, but a
+        # mis-bound group can never black-hole reads (boost, not filter)
+        preferred = None
+        placement = getattr(self, "placement", None)
+        if placement is not None and len(live) > 1:
+            try:
+                preferred = placement.preferred_endpoint(tm)
+            except Exception:  # galaxylint: disable=swallow -- locality is advisory: a placement fault must never fail a read
+                preferred = None
 
         def _load_weight(a, w):
             c = self.workers.get(a)
+            if a == preferred:
+                w = w * 4.0
             if c is None or now - getattr(c, "load_at", 0.0) > 5.0:
                 return float(w)
             penalty = 1.0 + getattr(c, "load_q", 0) \
@@ -746,18 +769,131 @@ class Instance:
             self.privileges.invalidate_cache()
             return {"ok": True, "action": action, "node": self.node_id}
         if action == "health":
-            # peer coordinators answer the same health pull workers do
+            # peer coordinators answer the same health pull workers do.
+            # The serving tier rides extra freight on this one action:
+            # - inbound `peer_admission` {node: snapshot} gossip is ingested
+            #   (the router acts as gossip hub, relaying every peer's
+            #   admission state to every other peer), and
+            # - the reply carries this node's own admission snapshot, sync
+            #   epoch, served placement groups, steady-state retrace count,
+            #   and — on request via `want` — bounded statement-summary /
+            #   metrics rollups for the SHOW CLUSTER merges.
             mh = self.metric_history
             mh.maybe_sample()
-            return {"ok": True, "action": action, "node": self.node_id,
-                    "uptime_s": round(_time.time() - self.started_at, 3),
-                    "active": float(len(self.sessions)),
-                    "qps": round(mh.rate("queries_total"), 3),
-                    "error_rate": round(mh.rate("query_errors"), 6),
-                    "mem_tier": int(self.admission.governor.tier()),
-                    "samples": int(mh.summary()["samples"]),
-                    "burning": self.slo.burning_names()}
+            for node, snap in (payload.get("peer_admission") or {}).items():
+                self.admission.note_peer(node, snap)
+            reply = {"ok": True, "action": action, "node": self.node_id,
+                     "uptime_s": round(_time.time() - self.started_at, 3),
+                     "active": float(len(self.sessions)),
+                     "qps": round(mh.rate("queries_total"), 3),
+                     "error_rate": round(mh.rate("query_errors"), 6),
+                     "mem_tier": int(self.admission.governor.tier()),
+                     "samples": int(mh.summary()["samples"]),
+                     "burning": self.slo.burning_names(),
+                     "epoch": int(self.sync_bus.epoch),
+                     "admission": self.admission.cluster_snapshot(),
+                     "groups": [g.strip().lower() for g in
+                                str(self.config.get("COORDINATOR_GROUPS")
+                                    or "").split(",") if g.strip()],
+                     "retraces": self._retrace_count()}
+            want = payload.get("want") or []
+            if "statement_summary" in want:
+                reply["statement_summary"] = \
+                    [list(r) for r in self.stmt_summary.rows()[:256]]
+            if "metrics" in want:
+                reply["metrics"] = [[n, k, float(v), h] for n, k, v, h
+                                    in self.metrics.rows()[:512]]
+            return reply
         return {"ok": False, "error": f"unknown sync action {action!r}"}
+
+    @staticmethod
+    def _retrace_count() -> int:
+        """Process-lifetime XLA retrace count (exec compile stats) — the
+        scale-out bench asserts this stays flat per peer at steady state."""
+        try:
+            from galaxysql_tpu.exec.operators import COMPILE_STATS
+            return int(COMPILE_STATS.get("retraces", 0))
+        except Exception:  # galaxylint: disable=swallow -- a health reply must not fail because compile stats moved; 0 reads as "unknown"
+            return 0
+
+    # -- serving tier (peer coordinators) --------------------------------------
+
+    def attach_coordinator(self, node_id: str, peer) -> None:
+        """Register a peer coordinator: `peer` is any sync endpoint
+        (`sync_peer()` object in-process, or a dn-wire client pointed at the
+        peer's sync listener).  The peer joins this instance's SyncBus so
+        cache-invalidation broadcasts reach it, and the admission/gossip and
+        SHOW CLUSTER planes start seeing it."""
+        from galaxysql_tpu.utils import events
+        self.coordinators[node_id] = peer
+        self.sync_bus.attach(peer)
+        events.publish("coordinator_joined",
+                       f"peer coordinator {node_id} joined the serving tier",
+                       node=self.node_id, peer=node_id)
+
+    def detach_coordinator(self, node_id: str, reason: str = "detach") -> None:
+        peer = self.coordinators.pop(node_id, None)
+        if peer is None:
+            return
+        with self.sync_bus._lock:
+            if peer in self.sync_bus.workers:
+                self.sync_bus.workers.remove(peer)
+        self.admission.forget_peer(node_id)
+        from galaxysql_tpu.utils import events
+        events.publish("coordinator_left",
+                       f"peer coordinator {node_id} left the serving tier "
+                       f"({reason})", node=self.node_id, peer=node_id,
+                       reason=reason)
+
+    def coordinator_rows(self, pull: bool = True):
+        """SHOW COORDINATORS / information_schema.coordinators row source:
+        this node first, then every registered peer.  `pull=True` issues a
+        fresh health sync per peer (UNREACHABLE rows, never errors);
+        `pull=False` renders from the last gossip snapshots only."""
+        router = getattr(self, "router", None)
+        adm = self.admission
+        gossip_age = {n: age for n, _s, age in adm.peer_gossip_rows()}
+
+        def _aff(node):
+            if router is None:
+                return 0, 0, 0.0
+            return router.affinity_of(node)
+
+        routed, hits, ratio = _aff(self.node_id)
+        rows = [(self.node_id, "local", "OK", int(self.sync_bus.epoch),
+                 round(adm.effective_limit("TP"), 1),
+                 round(adm.effective_limit("AP"), 1),
+                 float(len(adm._tokens["TP"])), float(len(adm._tokens["AP"])),
+                 routed, round(ratio, 4), -1.0)]
+        for node_id, peer in sorted(self.coordinators.items()):
+            routed, hits, ratio = _aff(node_id)
+            age = round(gossip_age.get(node_id, -1.0), 3)
+            resp = None
+            if pull:
+                try:
+                    resp = peer.sync_action("health", {})
+                except Exception:  # galaxylint: disable=swallow -- the UNREACHABLE row below IS the failure report
+                    resp = None
+            else:
+                snap = next((s for n, s, _a in adm.peer_gossip_rows()
+                             if n == node_id), None)
+                if snap is not None:
+                    resp = {"ok": True, "admission": snap, "epoch": -1}
+            if not (isinstance(resp, dict) and resp.get("ok")):
+                rows.append((node_id, "peer", "UNREACHABLE", -1,
+                             0.0, 0.0, 0.0, 0.0, routed, round(ratio, 4),
+                             age))
+                continue
+            snap = resp.get("admission") or {}
+            tp, ap = snap.get("tp") or {}, snap.get("ap") or {}
+            rows.append((resp.get("node", node_id), "peer", "OK",
+                         int(resp.get("epoch", -1)),
+                         float(tp.get("limit", 0.0)),
+                         float(ap.get("limit", 0.0)),
+                         float(tp.get("inflight", 0)),
+                         float(ap.get("inflight", 0)),
+                         routed, round(ratio, 4), age))
+        return rows
 
     def sync_peer(self):
         """In-process SyncBus endpoint for this instance: attach the returned

@@ -21,6 +21,30 @@ def _like_filter(names: List[str], pattern) -> List[str]:
     return [n for n in names if fnmatch.fnmatch(n.lower(), translated.lower())]
 
 
+def _peer_pull(inst, want: List[str]):
+    """(node_id, reply-or-None) per serving-tier peer: a `health` pull with
+    `want` sections (statement_summary / metrics rollups).  Transport
+    failures yield None — CLUSTER surfaces render them as rows, never
+    errors."""
+    out = []
+    for node_id, peer in sorted(getattr(inst, "coordinators", {}).items()):
+        try:
+            out.append((node_id, peer.sync_action("health", {"want": want})))
+        except Exception:
+            # unreachable peer: record None -- CLUSTER surfaces render it as
+            # an UNREACHABLE row, never an error
+            out.append((node_id, None))
+    return out
+
+
+def _unreachable_row(node: str, types) -> Tuple:
+    """A typed placeholder row for a peer that did not answer the pull."""
+    row = [node, "UNREACHABLE"]
+    for t in types[2:]:
+        row.append("" if t is dt.VARCHAR else 0)
+    return tuple(row)
+
+
 def _max_shard_rows(p) -> int:
     """Largest per-shard live-row count across the profile's MPP stages —
     slow-query triage sees shard skew straight from SHOW PROFILES, without
@@ -202,6 +226,29 @@ def handle(session, stmt: ast.Show):
         # (meta/statement_summary.py) — per digest x plan aggregates, or the
         # time-bucketed window history (information_schema twins)
         ss = inst.stmt_summary
+        if getattr(stmt, "cluster", False):
+            # SHOW CLUSTER STATEMENT SUMMARY: peer rollups merged under a
+            # leading Node column; an unreachable peer renders as a row,
+            # never an error (triage must work mid-outage)
+            names = ["Node", "Digest", "Schema", "Plan", "Engines", "Execs",
+                     "Errors", "Avg_ms", "P95_ms", "P99_ms", "Rows_returned",
+                     "Rows_examined", "Retraces", "Frag_hits",
+                     "Rf_rows_pruned", "Skew_activations", "Rpc_retries",
+                     "Spill_bytes", "Peak_rss_kb", "Regressed", "Join_order",
+                     "SQL"]
+            types = [dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR,
+                     dt.VARCHAR, dt.BIGINT, dt.BIGINT, dt.DOUBLE, dt.DOUBLE,
+                     dt.DOUBLE, dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.BIGINT,
+                     dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.BIGINT,
+                     dt.BIGINT, dt.VARCHAR, dt.VARCHAR]
+            rows = [(inst.node_id,) + tuple(r) for r in ss.rows()]
+            for node, resp in _peer_pull(inst, ["statement_summary"]):
+                if resp is None:
+                    rows.append(_unreachable_row(node, types))
+                    continue
+                for r in resp.get("statement_summary") or []:
+                    rows.append((node,) + tuple(r))
+            return ResultSet(names, types, rows)
         if (stmt.target or "").lower() == "history":
             return ResultSet(
                 ["Digest", "Schema", "Plan", "Window_start", "Execs",
@@ -256,6 +303,20 @@ def handle(session, stmt: ast.Show):
             [dt.BIGINT, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR,
              dt.VARCHAR, dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.DOUBLE,
              dt.VARCHAR, dt.BIGINT], progress_rows(inst))
+    if kind == "coordinators":
+        # SHOW COORDINATORS: the serving tier (server/router.py) — every
+        # peer coordinator with epoch, per-class admission limits, routed
+        # statement counts, affinity hit ratio, last gossip age.  Dead
+        # peers show as UNREACHABLE rows (the observability surface must
+        # outlive the peers it describes).
+        return ResultSet(
+            ["Node", "Role", "State", "Epoch", "Tp_limit", "Ap_limit",
+             "Tp_inflight", "Ap_inflight", "Routed", "Affinity_ratio",
+             "Gossip_age_s"],
+            [dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.BIGINT, dt.DOUBLE,
+             dt.DOUBLE, dt.DOUBLE, dt.DOUBLE, dt.BIGINT, dt.DOUBLE,
+             dt.DOUBLE],
+            inst.coordinator_rows(pull=True))
     if kind == "workers":
         # SHOW WORKERS: attached worker endpoints with fence + circuit-breaker
         # state and lifetime retry/failure counters (the fault-tolerance
@@ -277,6 +338,22 @@ def handle(session, stmt: ast.Show):
                          [(n, float(v)) for n, v in rows])
     if kind == "metrics":
         # the typed counter/gauge registry (information_schema.metrics twin)
+        if getattr(stmt, "cluster", False):
+            # SHOW CLUSTER METRICS: every peer's registry under a leading
+            # Node column (unreachable peers as rows, never errors)
+            types = [dt.VARCHAR, dt.VARCHAR, dt.VARCHAR, dt.DOUBLE,
+                     dt.VARCHAR]
+            rows = [(inst.node_id, n, k, float(v), h)
+                    for n, k, v, h in inst.metrics.rows()]
+            for node, resp in _peer_pull(inst, ["metrics"]):
+                if resp is None:
+                    rows.append(_unreachable_row(node, types))
+                    continue
+                for r in resp.get("metrics") or []:
+                    n, k, v, h = r
+                    rows.append((node, n, k, float(v), h))
+            return ResultSet(["Node", "Name", "Kind", "Value", "Help"],
+                             types, rows)
         rows = [(n, k, float(v), h) for n, k, v, h in inst.metrics.rows()]
         return ResultSet(["Name", "Kind", "Value", "Help"],
                          [dt.VARCHAR, dt.VARCHAR, dt.DOUBLE, dt.VARCHAR],

@@ -426,6 +426,9 @@ class Show(Statement):
     like: Optional[str] = None
     where: Optional[ExprNode] = None
     full: bool = False
+    # SHOW CLUSTER <X>: merge per-peer rollups via the health sync action
+    # (statement_summary / metrics handlers; cluster_health is always cluster)
+    cluster: bool = False
 
 
 @dataclasses.dataclass

@@ -1603,9 +1603,20 @@ class Parser:
             self.expect_kw("HISTORY")
             stmt.kind = "metric_history"
         elif kind == "CLUSTER":
-            # SHOW CLUSTER HEALTH (coordinator + per-worker snapshots)
-            self.expect_kw("HEALTH")
-            stmt.kind = "cluster_health"
+            # SHOW CLUSTER HEALTH (coordinator + per-worker snapshots) |
+            # SHOW CLUSTER STATEMENT SUMMARY | SHOW CLUSTER METRICS —
+            # the latter two merge peer-coordinator rollups via the health
+            # pull (unreachable peers render as rows, never errors)
+            if self.accept_kw("STATEMENT"):
+                self.expect_kw("SUMMARY")
+                stmt.kind = "statement_summary"
+                stmt.cluster = True
+            elif self.accept_kw("METRICS"):
+                stmt.kind = "metrics"
+                stmt.cluster = True
+            else:
+                self.expect_kw("HEALTH")
+                stmt.kind = "cluster_health"
         elif kind in ("VARIABLES", "STATUS", "WARNINGS", "PROCESSLIST", "COLLATION",
                       "ENGINES", "CHARSET", "TRACE", "INDEX", "INDEXES", "KEYS"):
             if kind in ("INDEX", "INDEXES", "KEYS"):

@@ -143,6 +143,19 @@ class WorkerUnavailableError(TddlError):
         self.sent = sent
 
 
+class CoordinatorUnavailableError(TddlError):
+    """A peer coordinator in the serving tier is unreachable (router
+    transport failure, fence, or a dead process found mid-statement).
+
+    Sticky (session-pinned) statements surface this typed EXACTLY ONCE —
+    the pinned peer's session state (txn, temp tables, session vars) died
+    with it and cannot be transparently replayed; the session then unpins
+    and the next statement re-routes.  Stateless statements never see it:
+    the router fails over within the statement."""
+    errno = 9004
+    sqlstate = "HY000"
+
+
 class ProtocolError(TddlError):
     """Corrupt/overlong RPC frame on the CN<->worker wire (ER_NET_READ_ERROR).
 

@@ -42,13 +42,17 @@ KINDS = (
     # history + robust-EWMA counter-rate anomalies (retrace storms,
     # breaker flaps, shed spikes) — detection only, never fails a query
     "slo_burn", "slo_recovered", "metric_anomaly",
+    # serving tier (server/router.py): peer coordinators joining/leaving the
+    # front router's ring — a leave also fires when failover evicts a dead
+    # peer mid-statement
+    "coordinator_joined", "coordinator_left",
 )
 
 _WARN_KINDS = frozenset({
     "breaker_open", "worker_failover", "sync_failure", "batch_fallback",
     "plan_regression", "plan_rollback", "plan_heal_failed",
     "admission_reject", "ccl_reject", "retry_budget_exhausted",
-    "slo_burn", "metric_anomaly",
+    "slo_burn", "metric_anomaly", "coordinator_left",
 })
 
 

@@ -1,0 +1,462 @@
+"""Serving-tier tests: the front router (server/router.py), cluster-wide
+admission gossip, physical placement bindings, SHOW COORDINATORS / SHOW
+CLUSTER surfaces, the hatch trio, and coordinator-kill chaos.
+
+Covered event kinds: coordinator_joined / coordinator_left (journal
+round-trips below keep galaxylint's event-untested rule green).
+Covered metrics: router_routed_queries, affinity_hits, affinity_misses,
+router_failovers, gossip_staleness_ms.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.router import (FrontRouter, InprocPeer,
+                                         RouterSession)
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.utils import errors
+from galaxysql_tpu.utils.events import EVENTS
+
+pytestmark = pytest.mark.router
+
+
+def _seed(inst, tables=("t",)):
+    s = Session(inst)
+    s.execute("CREATE DATABASE d")
+    s.execute("USE d")
+    for t in tables:
+        s.execute(f"CREATE TABLE {t} (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.execute(f"INSERT INTO {t} VALUES (1, 10), (2, 20), (3, 30)")
+    return s
+
+
+@pytest.fixture()
+def tier():
+    """A 3-peer in-process serving tier: local + two inproc peers."""
+    a = Instance()
+    sa = _seed(a)
+    router = FrontRouter(a)
+    peers = []
+    for _ in range(2):
+        b = Instance()
+        _seed(b).close()
+        p = InprocPeer(b)
+        router.add_peer(p)
+        peers.append(p)
+    yield a, router, peers
+    router.close()
+    sa.close()
+
+
+class TestRing:
+    def test_digest_routing_is_stable_and_spreads(self, tier):
+        a, router, _ = tier
+        owners = {}
+        for i in range(64):
+            d = f"digest-{i}"
+            owners[d] = router.ring_owner(d)
+            # stability: the same digest always lands on the same peer
+            assert router.ring_owner(d) == owners[d]
+        # spread: 64 digests over 3 peers must touch every peer
+        assert len(set(owners.values())) == 3
+
+    def test_routed_statements_follow_the_ring(self, tier):
+        a, router, _ = tier
+        s = RouterSession(router, schema="d")
+        for q in ["select 1", "select 2", "select 1 + 1", "select 9"]:
+            s.execute(q)
+        assert router.m_routed.value == 4
+        # undisturbed tier: every statement lands on its affine peer
+        assert router.m_hits.value == 4
+        assert router.m_misses.value == 0
+        total = sum(router.affinity_of(n)[0] for n in router.peers)
+        assert total == 4
+        s.close()
+
+    def test_down_peer_is_skipped_and_counted_as_miss(self, tier):
+        a, router, peers = tier
+        s = RouterSession(router, schema="d")
+        # suppress inline gossip: the STATEMENT must discover the death
+        # (with gossip on, the map heals before any statement pays it)
+        router._gossip_at = float("inf")
+        peers[0].down = True
+        h0, m0, f0 = (router.m_hits.value, router.m_misses.value,
+                      router.m_failovers.value)
+        for i in range(24):
+            # distinct aliases -> distinct digests (literals parameterize
+            # away, so a bare `select N` is ONE statement shape)
+            assert s.execute(f"select {i} * 3 as c{i}").rows  # all succeed
+        # at least one statement was owned by the dead peer and re-routed
+        # WITHIN the statement (failover counter), surfacing as a miss
+        assert router.m_failovers.value > f0
+        assert router.m_misses.value > m0
+        s.close()
+
+
+class TestSessionAffinity:
+    def test_begin_pins_and_commit_keeps_pin(self, tier):
+        a, router, _ = tier
+        s = RouterSession(router, schema="d")
+        s.execute("begin")
+        assert s.pinned is not None
+        pinned = s.pinned
+        s.execute("select k from t where k = 1")
+        s.execute("commit")
+        assert s.pinned == pinned  # temp/session state may outlive the txn
+        s.close()
+
+    def test_set_session_pins_but_set_global_does_not(self, tier):
+        a, router, _ = tier
+        s = RouterSession(router, schema="d")
+        s.execute("select 1")
+        assert s.pinned is None
+        s2 = RouterSession(router, schema="d")
+        s2.execute("SET GLOBAL SLOW_SQL_MS = 1234")  # metadb-persisted
+        assert s2.pinned is None
+        s2.execute("SET autocommit = 1")  # peer-resident session state
+        assert s2.pinned is not None
+        s.close()
+        s2.close()
+
+    def test_pinned_peer_death_fails_typed_exactly_once(self, tier):
+        a, router, peers = tier
+        s = RouterSession(router, schema="d")
+        s.execute("begin")
+        peer = router.peers[s.pinned]
+        if isinstance(peer, InprocPeer):
+            peer.down = True
+        with pytest.raises(errors.CoordinatorUnavailableError) as ei:
+            s.execute("select k from t where k = 1")
+        assert ei.value.errno == 9004
+        assert s.pinned is None  # unpinned: the next statement re-routes
+        assert s.execute("select k from t where k = 2").rows == [(2,)]
+        s.close()
+
+
+class TestClusterAdmission:
+    def test_gossip_exchanges_admission_snapshots(self, tier):
+        a, router, peers = tier
+        router.gossip_tick()
+        nodes = {n for n, _snap, _age in a.admission.peer_gossip_rows()}
+        assert {p.node_id for p in peers} <= nodes
+
+    def test_peer_clamp_governs_local_admission(self, tier):
+        a, router, peers = tier
+        # peer B reports a flood-shed clamp: B's AIMD limit collapsed to 4
+        snap = peers[0].instance.admission.cluster_snapshot()
+        snap["tp"]["limit"] = 4.0
+        a.admission.note_peer(peers[0].node_id, snap)
+        assert a.admission.effective_limit("TP") == 4.0
+        # local AIMD limit itself is untouched (recovery stays local)
+        assert a.admission.limit("TP") > 4.0
+        # the clamp expires with gossip freshness: a stale snapshot must
+        # not throttle the tier forever
+        old = (snap, time.time() - 3600.0)
+        a.admission._peer_snaps[peers[0].node_id] = old
+        a.admission._cluster_expire = 0.0
+        assert a.admission.effective_limit("TP") == a.admission.limit("TP")
+
+    def test_detach_forgets_peer_state(self, tier):
+        a, router, peers = tier
+        router.gossip_tick()
+        node = peers[1].node_id
+        assert any(n == node for n, _s, _a in a.admission.peer_gossip_rows())
+        router.remove_peer(node)
+        assert not any(n == node
+                       for n, _s, _a in a.admission.peer_gossip_rows())
+        assert node not in router.peers
+
+    def test_effective_limit_hatch(self, tier):
+        a, router, peers = tier
+        snap = peers[0].instance.admission.cluster_snapshot()
+        snap["tp"]["limit"] = 2.0
+        a.admission.note_peer(peers[0].node_id, snap)
+        a.config.set_instance("ENABLE_CLUSTER_ADMISSION", 0)
+        try:
+            assert a.admission.effective_limit("TP") == \
+                a.admission.limit("TP")
+        finally:
+            a.config.set_instance("ENABLE_CLUSTER_ADMISSION", 1)
+        assert a.admission.effective_limit("TP") == 2.0
+
+
+class TestPlacement:
+    def test_bind_persists_and_merges(self, tier):
+        a, router, peers = tier
+        a.placement.bind("g0", endpoint="127.0.0.1:9999")
+        a.placement.bind("g0", coordinator=peers[0].node_id)
+        ent = a.placement.binding("g0")
+        assert ent["endpoint"] == "127.0.0.1:9999"  # merge kept it
+        assert ent["coordinator"] == peers[0].node_id
+        rows = a.placement.rows()
+        assert ("g0", "127.0.0.1:9999", peers[0].node_id, "") in rows
+        a.placement.unbind("g0")
+        assert a.placement.binding("g0") is None
+
+    def test_bound_coordinator_jumps_the_ring(self, tier):
+        a, router, peers = tier
+        sql = "select v from t where k = 1"
+        a.placement.bind("g0", coordinator=peers[1].node_id)
+        a.placement._cache_at = 0.0
+        target = router.targets_for("any-digest", sql, "d")[0]
+        assert target is peers[1]
+        # routed there = an affinity HIT (placement is the preference)
+        s = RouterSession(router, schema="d")
+        h0 = router.m_hits.value
+        s.execute(sql)
+        assert router.m_hits.value == h0 + 1
+        a.placement.unbind("g0")
+        s.close()
+
+    def test_preferred_endpoint_parses_addr(self, tier):
+        a, _router, _peers = tier
+        a.placement.bind("g0", endpoint="10.0.0.7:4406")
+        tm = a.catalog.table("d", "t")
+        assert a.placement.preferred_endpoint(tm) == ("10.0.0.7", 4406)
+        a.placement.bind("g0", endpoint="bogus")
+        a.placement._cache_at = 0.0
+        assert a.placement.preferred_endpoint(tm) is None
+        a.placement.unbind("g0")
+
+
+class TestShowSurfaces:
+    def test_show_coordinators(self, tier):
+        a, router, peers = tier
+        s = Session(a, schema="d")
+        rs = s.execute("SHOW COORDINATORS")
+        assert rs.names[0] == "Node"
+        by_node = {r[0]: r for r in rs.rows}
+        assert by_node[a.node_id][1] == "local"
+        for p in peers:
+            assert by_node[p.node_id][1] == "peer"
+            assert by_node[p.node_id][2] == "OK"
+        peers[0].down = True
+        rs = s.execute("SHOW COORDINATORS")
+        by_node = {r[0]: r for r in rs.rows}
+        assert by_node[peers[0].node_id][2] == "UNREACHABLE"
+        peers[0].down = False
+        s.close()
+
+    def test_show_cluster_statement_summary_merges_peers(self, tier):
+        a, router, peers = tier
+        rsess = RouterSession(router, schema="d")
+        for q in ["select k from t where k = 1", "select v from t",
+                  "select 41 + 1"]:
+            rsess.execute(q)
+        s = Session(a, schema="d")
+        rs = s.execute("SHOW CLUSTER STATEMENT SUMMARY")
+        assert rs.names[0] == "Node"
+        nodes = {r[0] for r in rs.rows}
+        assert len(nodes) >= 2  # local + at least one peer answered
+        rsess.close()
+        s.close()
+
+    def test_show_cluster_metrics_and_unreachable_rows(self, tier):
+        a, router, peers = tier
+        s = Session(a, schema="d")
+        rs = s.execute("SHOW CLUSTER METRICS")
+        names = {(r[0], r[1]) for r in rs.rows}
+        assert (a.node_id, "router_routed_queries") in names
+        assert (a.node_id, "affinity_hits") in names
+        assert (a.node_id, "affinity_misses") in names
+        assert (a.node_id, "gossip_staleness_ms") in names
+        assert (a.node_id, "router_failovers") in names
+        peers[0].down = True
+        rs = s.execute("SHOW CLUSTER METRICS")
+        dead = [r for r in rs.rows if r[0] == peers[0].node_id]
+        assert dead and dead[0][1] == "UNREACHABLE"  # a row, not an error
+        rs = s.execute("SHOW CLUSTER STATEMENT SUMMARY")
+        dead = [r for r in rs.rows if r[0] == peers[0].node_id]
+        assert dead and dead[0][1] == "UNREACHABLE"
+        peers[0].down = False
+        s.close()
+
+    def test_information_schema_coordinators(self, tier):
+        a, router, peers = tier
+        router.gossip_tick()
+        s = Session(a, schema="d")
+        rs = s.execute("SELECT node_id, role, state FROM "
+                       "information_schema.coordinators ORDER BY role")
+        nodes = {r[0] for r in rs.rows}
+        assert a.node_id in nodes
+        for p in peers:
+            assert p.node_id in nodes
+        s.close()
+
+    def test_join_and_leave_events_journal(self, tier):
+        a, router, peers = tier
+        kinds = [e.kind for e in EVENTS.entries()]
+        assert "coordinator_joined" in kinds
+        router.remove_peer(peers[1].node_id, reason="test detach")
+        kinds = [e.kind for e in EVENTS.entries()]
+        assert "coordinator_left" in kinds
+
+
+class TestHatchTrio:
+    def test_param_hatch_is_structurally_off_path(self, tier):
+        """ENABLE_ROUTER=0: bit-identical local execution with ZERO routed
+        statements (the dispatch-count guard)."""
+        a, router, _ = tier
+        a.config.set_instance("ENABLE_ROUTER", 0)
+        try:
+            routed0 = router.m_routed.value
+            s = RouterSession(router, schema="d")
+            plain = Session(a, schema="d")
+            for q in ["select k, v from t order by k",
+                      "select v from t where k = 2"]:
+                assert s.execute(q).rows == plain.execute(q).rows
+            assert router.m_routed.value == routed0  # structurally off-path
+            s.close()
+            plain.close()
+        finally:
+            a.config.set_instance("ENABLE_ROUTER", 1)
+
+    def test_env_hatch(self, tier, monkeypatch):
+        from galaxysql_tpu.server import router as router_mod
+        a, router, _ = tier
+        monkeypatch.setattr(router_mod, "ENABLED", False)  # GALAXYSQL_ROUTER=0
+        routed0 = router.m_routed.value
+        s = RouterSession(router, schema="d")
+        assert s.execute("select k from t where k = 3").rows == [(3,)]
+        assert router.m_routed.value == routed0
+        s.close()
+
+    def test_env_hatch_reads_environment(self):
+        """The module-level hatch mirrors GALAXYSQL_ROUTER=0 at import."""
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "from galaxysql_tpu.server import router; print(router.ENABLED)"],
+            env=dict(os.environ, GALAXYSQL_ROUTER="0", JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=120)
+        assert out.stdout.strip() == "False"
+
+
+class TestGossipTransport:
+    def test_rpc_failpoint_marks_peer_down_and_gossip_revives(self, tier):
+        """FP_RPC_* rides the coordinator gossip plane: a dropped sync
+        reply marks the peer down; the next clean tick revives it."""
+        a, router, peers = tier
+
+        orig = peers[0].sync_action
+
+        class _Flaky:
+            fail = True
+
+            def sync_action(self, action, payload):
+                if self.fail:
+                    raise ConnectionError("injected drop")
+                return orig(action, payload)
+
+        flaky = _Flaky()
+        peers[0].sync_action = flaky.sync_action
+        try:
+            router.gossip_tick()
+            assert peers[0].down_until > time.time()
+            flaky.fail = False
+            router.gossip_tick()
+            assert peers[0].down_until == 0.0  # revived
+        finally:
+            peers[0].sync_action = orig
+
+
+@pytest.mark.slow
+class TestCoordinatorKillChaos:
+    """The failover chaos proof over REAL subprocess coordinators: kill one
+    mid-load — sticky sessions fail typed exactly once, stateless
+    statements re-route within the statement, the affinity map heals, and
+    every acked write on the shared store survives."""
+
+    def _spawn(self, data_dir):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "galaxysql_tpu.net.server", "--port",
+             "0", "--sync-port", "0", "--data-dir", data_dir,
+             "--platform", "cpu", "--announce"],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+            text=True)
+        line = p.stdout.readline()
+        assert line.startswith("SERVER_READY"), line
+        _, mysql_port, sync_port = line.split()
+        return p, int(mysql_port), int(sync_port)
+
+    def test_kill_coordinator_mid_load(self, tmp_path):
+        data_dir = str(tmp_path / "shared")
+        seed = Instance(data_dir=data_dir)
+        s = _seed(seed)
+        s.execute("CREATE TABLE acked (k BIGINT PRIMARY KEY, v BIGINT)")
+        seed.save()
+        s.close()
+
+        procs = [self._spawn(data_dir) for _ in range(2)]
+        hub = Instance(boot=False)
+        router = FrontRouter(hub)
+        router.local.down_until = float("inf")  # hub routes, never serves
+        try:
+            remotes = [router.add_remote("127.0.0.1", mp, sp)
+                       for _p, mp, sp in procs]
+            rsess = RouterSession(router, schema="d")
+            # the acked inserts share ONE digest (literals strip), so one
+            # ring owner serves them all; the doomed peer is the OTHER one
+            # -- acked writes must outlive the kill on the surviving owner
+            from galaxysql_tpu.sql.parameterize import parameterize
+            from galaxysql_tpu.meta.statement_summary import digest_key
+            ins_digest = digest_key(
+                "d", parameterize("insert into acked values (1, 1)").cache_key)
+            owner_node = router.targets_for(
+                ins_digest, "insert into acked values (1, 1)", "d")[0].node_id
+            victim_idx = next(i for i, r in enumerate(remotes)
+                              if r.node_id != owner_node)
+            victim_node = remotes[victim_idx].node_id
+            # sticky session pinned to the doomed peer: pin statements carry
+            # distinct digests (var names survive parameterize), so one of
+            # them lands on the victim through the REAL pin path
+            sticky = None
+            for i in range(16):
+                cand = RouterSession(router, schema="d")
+                cand.execute("begin" if i == 0 else f"set @pin{i} = 1")
+                if cand.pinned == victim_node:
+                    sticky = cand
+                    break
+                cand.close()
+            assert sticky is not None, "no pin statement landed on the victim"
+            # acked writes BEFORE the kill, through the router, onto the
+            # surviving digest owner
+            for k in range(1, 6):
+                rsess.execute(f"insert into acked values ({k}, {k})")
+            procs[victim_idx][0].kill()
+            procs[victim_idx][0].wait()
+            # sticky statement: typed failure EXACTLY ONCE...
+            with pytest.raises(errors.CoordinatorUnavailableError):
+                sticky.execute("select k from t where k = 1")
+            # ...then the session unpins and serves again
+            assert sticky.execute("select k from t where k = 1").rows
+            # stateless statements re-route WITHIN the statement: no
+            # client-visible error even when the ring prefers the corpse
+            for i in range(12):
+                assert rsess.execute(f"select v from t where k = "
+                                     f"{1 + i % 3}").rows
+            # affinity map healed: the dead peer serves nothing now
+            routed_dead = router.affinity_of(victim_node)[0]
+            for i in range(6):
+                rsess.execute(f"select k + {i} from t where k = 1")
+            assert router.affinity_of(victim_node)[0] == routed_dead
+            # zero lost acked writes: every acked row is still readable
+            survivor = remotes[1 - victim_idx]
+            sess = survivor.open_session("d")
+            rs = survivor.execute(sess, "select count(*) from acked")
+            assert [tuple(map(int, r)) for r in rs.rows] == [(5,)]
+            survivor.close_session(sess)
+            sticky.close()
+            rsess.close()
+        finally:
+            router.close()
+            for p, _mp, _sp in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()

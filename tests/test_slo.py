@@ -517,6 +517,8 @@ ALL_EVENT_KINDS = (
     "retry_budget_exhausted",
     # SLO plane
     "slo_burn", "slo_recovered", "metric_anomaly",
+    # serving tier
+    "coordinator_joined", "coordinator_left",
 )
 
 
