@@ -211,8 +211,23 @@ scaleout-smoke:
 bench-scaleout:
 	JAX_PLATFORMS=cpu $(PY) bench.py --scaleout-only
 
+# columnar HTAP replica: CDC-tailed delta+base tier bit-identical to the
+# row store at arbitrary watermarks, crash-resume, compaction vs racing
+# writes, DDL-mid-tail reseed, routing gates + hatch trio, SHOW/info-schema
+# surfaces.  Lockdep-armed: the tailer holds the columnar lock over
+# partition snapshots and metadb persistence.
+columnar-smoke:
+	JAX_PLATFORMS=cpu GALAXYSQL_LOCKDEP=1 $(PY) -m pytest tests/ -q \
+		-m columnar -p no:cacheprovider
+
+# HTAP curve: columnar replica vs row store rows/s on AP scans at SF0.2
+# under sustained DML, plus freshness-lag series — into BENCH_r13.json
+bench-htap:
+	JAX_PLATFORMS=cpu $(PY) bench.py --htap-only
+
 .PHONY: tier1 fusion-smoke obs-smoke rf-smoke cache-smoke trace-smoke bench \
 	batch-smoke chaos-smoke skew-smoke bench-skew summary-smoke heal-smoke \
 	overload-smoke bench-overload dml-smoke bench-dml lint lint-smoke \
 	rebalance-smoke chaos-rebalance bench-rebalance kernel-smoke \
-	bench-kernels slo-smoke bench-slo scaleout-smoke bench-scaleout
+	bench-kernels slo-smoke bench-slo scaleout-smoke bench-scaleout \
+	columnar-smoke bench-htap

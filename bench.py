@@ -1485,6 +1485,188 @@ def slo_only_main():
         print(json.dumps(out))
 
 
+def htap_bench(platform):
+    """`bench.py --htap-only` (make bench-htap): the columnar HTAP replica
+    (PR 18) measured as its actual claim — scan-heavy AP queries on the
+    CDC-fed columnar tier vs the SAME queries on the row store, BOTH under
+    one sustained DML stream mutating lineitem (the row store re-derives
+    visibility + lane concat per version bump; the replica serves immutable
+    pre-encoded stripes at its watermark).  Then the stream stops, the
+    tailer drains, and a quiesced phase asserts bit-identical results at
+    the drained watermark plus zero steady-state retraces.  The freshness
+    lag of every replica is sampled throughout — the SLA the router
+    enforces must stay bounded while the writer hammers."""
+    import threading
+
+    from galaxysql_tpu.exec import operators as _ops
+
+    sf = float(os.environ.get("BENCH_HTAP_SF",
+                              os.environ.get("BENCH_SF", "0.2")))
+    runs = int(os.environ.get("BENCH_RUNS", "3"))
+    inst, s, data = load(sf)
+    n_rows = len(data["lineitem"]["l_orderkey"])
+    inst.config.set_instance("ENABLE_COLUMNAR_REPLICA", 1)
+    inst.config.set_instance("COLUMNAR_POLL_MS", 20)
+    inst.config.set_instance("COLUMNAR_WATERMARK_LAG_MS", 20)
+    # cluster the fact table on ship date: Q6/Q3's date sargs then prune
+    # whole stripes via the zone maps instead of filtering every row
+    inst.config.set_instance("COLUMNAR_CLUSTER_BY", "lineitem:l_shipdate")
+    mgr = inst.columnar
+    seed_t0 = time.perf_counter()
+    for t in tpch.TABLE_ORDER:
+        mgr.ensure_ready("tpch", t, timeout_s=300.0)
+    seed_wall = time.perf_counter() - seed_t0
+
+    qids = [int(x) for x in
+            os.environ.get("BENCH_HTAP_QUERIES", "1,6,3,5").split(",") if x]
+    on_q = {q: "/*+TDDL:COLUMNAR(ON)*/ " + QUERIES[q] for q in qids}
+    off_q = {q: "/*+TDDL:COLUMNAR(OFF)*/ " + QUERIES[q] for q in qids}
+    # dedicated reader session: it never writes, so the read-your-writes
+    # fence stays open and routing is decided purely by the watermark
+    sr = Session(inst, schema="tpch")
+    for q in qids:  # compile warmup for both paths, outside any timing
+        sr.execute(off_q[q])
+        routed0 = mgr.routed.value
+        sr.execute(on_q[q])
+        if mgr.routed.value == routed0:
+            raise RuntimeError(f"COLUMNAR(ON) Q{q} did not route to the "
+                               "replica — bench preconditions broken")
+
+    # -- sustained DML stream + freshness-lag sampler -------------------------
+    okeys = data["orders"]["o_orderkey"]
+    wkeys = [int(k) for k in okeys[:: max(1, len(okeys) // 2048)]]
+    upd = ("UPDATE lineitem SET l_suppkey = l_suppkey + 1 "
+           "WHERE l_orderkey = %d")
+    # prime the delete path: the first delete event the tailer sees builds
+    # the pk map (one-time, proportional to table size); pay it here so the
+    # measured lag window reflects steady-state tailing, not the build
+    sp = Session(inst, schema="tpch")
+    sp.execute(upd % wkeys[0])
+    sp.close()
+    ts_p = inst.tso.next_timestamp()
+    deadline = time.time() + 120.0
+    while any(rep.watermark < ts_p for rep in mgr.replicas.values()):
+        mgr.tail_once()
+        if time.time() > deadline:
+            raise RuntimeError("pk-prime drain did not complete")
+        time.sleep(0.02)
+    stop = threading.Event()
+    dml_n = [0]
+    lags: list = []
+
+    def writer():
+        sw = Session(inst, schema="tpch")
+        i = 0
+        while not stop.is_set():
+            sw.execute(upd % wkeys[i % len(wkeys)])
+            dml_n[0] += 1
+            i += 1
+        sw.close()
+
+    def sampler():
+        while not stop.is_set():
+            cur = max((rep.lag_ms() for rep in mgr.replicas.values()),
+                      default=0.0)
+            if cur >= 0:
+                lags.append(cur)
+            time.sleep(0.05)
+
+    threads = [threading.Thread(target=writer, daemon=True),
+               threading.Thread(target=sampler, daemon=True)]
+    dml_t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(1.0)  # stream + tailer established before the timed passes
+
+    results = []
+    timings = {}
+    for q in qids:
+        off_best = min(_timed_exec(sr, off_q[q]) for _ in range(runs))
+        routed0 = mgr.routed.value
+        on_best = min(_timed_exec(sr, on_q[q]) for _ in range(runs))
+        timings[q] = (on_best, off_best, mgr.routed.value - routed0)
+
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    dml_wall = time.perf_counter() - dml_t0
+
+    # -- quiesce: drain the tailer past the last write, then assert identity --
+    ts_q = inst.tso.next_timestamp()
+    deadline = time.time() + 120.0
+    while any(rep.watermark < ts_q for rep in mgr.replicas.values()):
+        mgr.tail_once()
+        if time.time() > deadline:
+            raise RuntimeError("tailer failed to drain past the DML stream")
+        time.sleep(0.02)
+    equal = {}
+    for q in qids:
+        on_rows = sr.execute(on_q[q]).rows
+        off_rows = sr.execute(off_q[q]).rows
+        equal[q] = on_rows == off_rows
+        if not equal[q]:
+            raise RuntimeError(f"quiesced Q{q}: columnar result diverged "
+                               "from the row store")
+    for q in qids:  # steady-state warmup at the drained watermark
+        sr.execute(on_q[q])
+    _ops.reset_compile_stats()
+    for q in qids:
+        sr.execute(on_q[q])
+    retraces = _ops.COMPILE_STATS["retraces"]
+
+    lags.sort()
+    for q in qids:
+        on_best, off_best, routed = timings[q]
+        results.append({
+            "metric": f"htap_q{q}_sf{sf:g}_columnar_rows_per_sec_per_chip",
+            "value": round(n_rows / on_best, 1), "unit": "rows/s",
+            "vs_baseline": round(off_best / on_best, 3),
+            "row_store_rows_per_sec": round(n_rows / off_best, 1),
+            "routed_executions": routed,
+            "quiesced_equal": equal[q],
+            "platform": platform,
+        })
+    results.append({
+        "metric": f"htap_freshness_lag_sf{sf:g}",
+        "value": round(lags[len(lags) // 2], 1) if lags else -1.0,
+        "unit": "ms",
+        "vs_baseline": round(
+            (lags[-1] if lags else 0.0) /
+            float(inst.config.get("COLUMNAR_MAX_LAG_MS") or 10_000), 3),
+        "lag_p95_ms": round(lags[int(len(lags) * 0.95)], 1) if lags else -1.0,
+        "lag_max_ms": round(lags[-1], 1) if lags else -1.0,
+        "lag_samples": len(lags),
+        "dml_statements": dml_n[0],
+        "dml_statements_per_sec": round(dml_n[0] / dml_wall, 1),
+        "seed_wall_s": round(seed_wall, 2),
+        "retraces_steady": retraces,
+        "platform": platform,
+    })
+    sr.close()
+    return results
+
+
+def _timed_exec(s, q):
+    t0 = time.perf_counter()
+    s.execute(q)
+    return time.perf_counter() - t0
+
+
+def htap_only_main():
+    """`bench.py --htap-only` (make bench-htap): run the columnar-vs-row
+    HTAP bench and commit it to BENCH_r13.json."""
+    results = htap_bench(jax.devices()[0].platform)
+    for out in results:
+        print(json.dumps(out), flush=True)
+    envelope = {"n": 13, "cmd": "python bench.py --htap-only", "rc": 0,
+                "tail": json.dumps(results[-1]), "parsed": results}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_r13.json")
+    with open(path, "w") as f:
+        json.dump(envelope, f, indent=1)
+        f.write("\n")
+
+
 def _spawn_coordinator(data_dir):
     """One coordinator subprocess over the shared metadb; returns
     (popen, mysql_port, sync_port) after the SERVER_READY handshake."""
@@ -1688,5 +1870,7 @@ if __name__ == "__main__":
         slo_only_main()
     elif "--scaleout-only" in sys.argv:
         scaleout_only_main()
+    elif "--htap-only" in sys.argv:
+        htap_only_main()
     else:
         main()

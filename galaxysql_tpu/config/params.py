@@ -389,6 +389,38 @@ COORDINATOR_GROUPS = _p(
     "router prefers the peer co-located with a statement's dominant "
     "partition group (server/placement.py)")
 
+# --- columnar HTAP replica (storage/columnar.py) -------------------------------
+ENABLE_COLUMNAR_REPLICA = _p(
+    "ENABLE_COLUMNAR_REPLICA", False,
+    "route large scans to the CDC-fed columnar replica tier; override via "
+    "COLUMNAR(OFF|ON) hint; GALAXYSQL_COLUMNAR=0 env kills the plane")
+COLUMNAR_MIN_SCAN_ROWS = _p(
+    "COLUMNAR_MIN_SCAN_ROWS", 50_000,
+    "scans below this estimated/observed row count stay on the row store "
+    "(TP point reads must never pay replica freshness checks)")
+COLUMNAR_MAX_LAG_MS = _p(
+    "COLUMNAR_MAX_LAG_MS", 10_000,
+    "freshness SLA: a replica whose watermark lags further than this serves "
+    "nothing — the query falls back to the row store")
+COLUMNAR_COMPACT_ROWS = _p(
+    "COLUMNAR_COMPACT_ROWS", 65_536,
+    "delta rows that trigger compaction into an encoded base stripe")
+COLUMNAR_WATERMARK_LAG_MS = _p(
+    "COLUMNAR_WATERMARK_LAG_MS", 100,
+    "watermark trails the TSO head by this margin: binlog writes follow "
+    "commit stamping, and the margin absorbs that window (the "
+    "REBALANCE_VERIFY_LAG_MS assumption)")
+COLUMNAR_POLL_MS = _p(
+    "COLUMNAR_POLL_MS", 50,
+    "tailer poll interval; <=0 disables the background thread (tests drive "
+    "tail_once() synchronously)")
+COLUMNAR_CLUSTER_BY = _p(
+    "COLUMNAR_CLUSTER_BY", "",
+    "'table:column[,table:column]' — seed each listed table's replica "
+    "globally sorted on the column so consecutive base stripes cover "
+    "disjoint key ranges and zone maps prune range scans whole-stripe; "
+    "empty = preserve row-store partition order")
+
 # --- misc ---------------------------------------------------------------------
 SQL_SELECT_LIMIT = _p("SQL_SELECT_LIMIT", -1, "-1 = unlimited")
 SLOW_SQL_MS = _p("SLOW_SQL_MS", 1000, "slow query log threshold")

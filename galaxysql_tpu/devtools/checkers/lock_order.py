@@ -7,9 +7,12 @@ same-module function that itself acquires M, contributes the edge L -> M),
 then checks:
 
 - **lock-order**: edges that invert the canonical rank order
-  `append_lock (0) -> partition (1) -> store/metadb (2)`, or nest two locks
-  of the same unordered class (two partition locks held together have no
-  declared intra-class order).
+  `append_lock/columnar (0) -> partition (1) -> store/metadb (2)`, or nest
+  two locks of the same unordered class (two partition locks held together
+  have no declared intra-class order).  The columnar tailer lock
+  (ColumnarReplicaManager._lock) ranks with append_lock: seeding snapshots
+  partitions and persistence writes metadb while holding it, never the
+  reverse — the query path reads tier snapshots lock-free.
 - **lock-blocking**: blocking operations — worker RPC (`.request`), metadb
   IO, `time.sleep`, device syncs (`.block_until_ready()`, `.item()`) —
   executed while a HOT lock (append_lock, partition) is held.  Hot locks sit
@@ -34,7 +37,8 @@ SCOPE_PREFIXES = ("galaxysql_tpu/storage/", "galaxysql_tpu/server/",
                   "galaxysql_tpu/txn/", "galaxysql_tpu/exec/",
                   "galaxysql_tpu/meta/")
 
-RANKS = {"append_lock": 0, "partition": 1, "store": 2, "metadb": 2}
+RANKS = {"append_lock": 0, "columnar": 0, "partition": 1, "store": 2,
+         "metadb": 2}
 HOT = ("append_lock", "partition")
 
 _PARTITION_RECVS = {"p", "part", "partition", "pt"}
@@ -83,6 +87,8 @@ def lock_name(expr: ast.AST, class_name: str) -> Optional[str]:
     if attr in ("lock", "_lock"):
         if base in _METADB_RECVS or (base == "self" and class_name == "MetaDb"):
             return "metadb"
+        if base == "self" and class_name == "ColumnarReplicaManager":
+            return "columnar"
     owner = base if base not in ("self", "") else (class_name or "module")
     return f"{owner}.{attr}"
 

@@ -167,7 +167,10 @@ def fingerprint(node, ctx) -> Optional[FragKey]:
             raise _Uncacheable
         # self-heal pin: executions under a live quarantine episode get their
         # own keyspace — rolled-back (probation) artifacts and regressed-plan
-        # artifacts must never cross, and probation timings stay honest
+        # artifacts must never cross, and probation timings stay honest.
+        # (Columnar-routed executions need no statement-wide salt: each
+        # replica scan fingerprints as ("cscan", seed_ts, events) below, so
+        # subtrees over unchanged tables stay warm while the watermark moves.)
         pin = getattr(ctx, "plan_pin", "")
         fk = FragKey(("frag", pin, key) if pin else ("frag", key),
                      frozenset(tables))
@@ -261,6 +264,20 @@ def _fp_scan(node, ctx, frag, tables, targets) -> Tuple:
     am = getattr(ctx, "archive", None)
     if am is not None and am.files_for(tkey, getattr(ctx, "snapshot_ts", None)):
         raise _Uncacheable  # cold archive rows: not covered by the version
+    cviews = getattr(ctx, "columnar", None)
+    if cviews:
+        view = cviews.get(tkey)
+        if view is not None:
+            # replica-fed scan: content-addressed by the replica generation
+            # (seed_ts, applied-event count) instead of the watermark — the
+            # visible set is identical for every watermark at or above the
+            # tier's highest applied commit_ts, so idle watermark advances
+            # (and DML against OTHER tables) keep this subtree warm
+            if (getattr(ctx, "snapshot_ts", 0) or 0) < view.max_applied_ts:
+                raise _Uncacheable  # watermark still below an applied stamp
+            tables.add(tkey)
+            return ("cscan", tkey, view.seed_ts, view.events,
+                    cols, parts, sargs, point)
     if getattr(ctx, "txn_id", 0) and \
             store.uid in (getattr(ctx, "txn_write_uids", None) or ()):
         raise _Uncacheable  # own uncommitted writes are visible to us only

@@ -702,6 +702,19 @@ class StatementSummaryStore:
 
     # -- surfaces ------------------------------------------------------------
 
+    def digest_signal(self, schema: str, ptext: str) -> Tuple[int, float]:
+        """(executions, avg rows_examined) of a digest across its plans —
+        the columnar router's observed-size signal (storage/columnar.py):
+        a digest that historically examined many rows routes to the replica
+        even when the planner's estimate is cold or wrong."""
+        with self._lock:
+            e = self._entries.get((schema.lower(), ptext))
+            if e is None:
+                return 0, 0.0
+            execs = sum(a.execs for a in e.plans.values())
+            rx = sum(a.rows_examined for a in e.plans.values())
+            return execs, rx / max(execs, 1)
+
     def rows(self) -> List[tuple]:
         """SHOW STATEMENT SUMMARY / information_schema.statement_summary: one
         row per digest x plan, hottest (total time) first."""

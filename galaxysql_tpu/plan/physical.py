@@ -89,6 +89,12 @@ class ExecContext:
         # digest has a live quarantine episode; salts fragment-cache
         # fingerprints so probation and regressed artifacts never cross
         self.plan_pin = ""
+        # columnar HTAP routing (storage/columnar.py): table key ->
+        # ReplicaView snapshot taken at routing; scans of those tables read
+        # the replica at the routed watermark instead of the row store.
+        # The fragment cache fingerprints them as ("cscan", seed_ts, events)
+        # so replica-fed and row-fed artifacts never cross.
+        self.columnar: Dict[str, object] = {}
 
     def check_deadline(self):
         """Raise a typed QueryTimeoutError once the deadline passes.  Called
@@ -163,6 +169,16 @@ class ScanSource(ops.Operator):
             f"scan {t.name} partitions={self.node.partitions or 'all'}" +
             (f" as_of={as_of}" if as_of is not None else ""))
         yield from self._archive_batches(t, storage_cols, rename, snap)
+        # columnar-replica route: the session snapshotted a ReplicaView at
+        # the routed watermark (== ctx.snapshot_ts).  The archive batches
+        # above still run — TTL-archived rows never reached the replica's
+        # seed scan.  Flashback reads (as_of) always stay on the row store.
+        if self.ctx.columnar and as_of is None:
+            view = self.ctx.columnar.get(f"{t.schema.lower()}.{t.name.lower()}")
+            if view is not None:
+                yield from self._columnar_batches(t, view, storage_cols,
+                                                  rename)
+                return
         from galaxysql_tpu.exec.operators import bucket_capacity
         if self.node.point_eq is not None:
             yield from self._point_batches(t, store, snap, txn_id)
@@ -415,6 +431,27 @@ class ScanSource(ops.Operator):
             return [], []
         sargs, inlists = rf.scan_pushdown(self.node)
         return [[c, op, v] for c, op, v in sargs], inlists
+
+    def _columnar_batches(self, t, view, storage_cols, rename):
+        """Vectorized columnar-replica scan: pre-padded immutable stripes +
+        one concatenated delta batch, zone-map-pruned by the same SARGs the
+        parquet archive refutes with, MVCC-visible at the routed watermark."""
+        from galaxysql_tpu.storage import columnar as _col
+        mgr = getattr(self.ctx.archive_instance, "columnar", None)
+        sargs = [tuple(s) for s in (getattr(self.node, "sargs", None) or [])]
+        rf_sargs, _ = self._rf_pushdown()
+        sargs += [tuple(s) for s in rf_sargs]
+        pruned0 = view.replica.pruned_stripes
+        self.ctx.trace.append(
+            f"scan-columnar {t.name} watermark={view.watermark} "
+            f"stripes={len(view.stripes)} delta={len(view.delta)}")
+        for b in _col.scan_view(view, t, storage_cols, sargs, mgr):
+            self.ctx.check_deadline()  # per-stripe drain boundary
+            yield b.rename(rename)
+        pruned = view.replica.pruned_stripes - pruned0
+        if pruned:
+            self.ctx.trace.append(
+                f"scan-columnar {t.name} pruned_stripes={pruned}")
 
     def _archive_batches(self, t, storage_cols, rename, snap=None):
         """Cold rows from parquet archives (OSSTableScanExec analog)."""

@@ -137,6 +137,13 @@ _DEFS: Dict[str, List] = {
         ("tp_limit", _D), ("ap_limit", _D), ("tp_inflight", _D),
         ("ap_inflight", _D), ("routed", _I), ("affinity_ratio", _D),
         ("gossip_age_s", _D)],
+    # columnar HTAP replica tier (storage/columnar.py; SHOW COLUMNAR
+    # REPLICA twin): per-table tailer state + watermark freshness
+    "columnar_replica": [
+        ("table_name", _V), ("state", _V), ("watermark", _I),
+        ("lag_ms", _D), ("delta_rows", _I), ("base_stripes", _I),
+        ("compactions", _I), ("reseeds", _I), ("pruned_stripes", _I),
+        ("applied_events", _I), ("applied_rows", _I)],
 }
 
 
@@ -287,3 +294,5 @@ def refresh(instance, session=None):
     # the same no-stall rule as cluster_health
     fill("coordinators",
          (list(r) for r in instance.coordinator_rows(pull=False)))
+    col = getattr(instance, "columnar", None)
+    fill("columnar_replica", (list(r) for r in (col.rows() if col else [])))

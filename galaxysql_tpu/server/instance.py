@@ -148,6 +148,11 @@ class Instance:
         # read-your-writes watermark fencing (txn/async_apply.py)
         from galaxysql_tpu.txn.async_apply import AsyncApplier
         self.applier = AsyncApplier(self)
+        # columnar HTAP replica (storage/columnar.py): CDC-fed delta+base
+        # tier serving large AP scans at a TSO watermark while TP stays on
+        # the row store; sessions route through it in _run_query_admitted
+        from galaxysql_tpu.storage.columnar import ColumnarReplicaManager
+        self.columnar = ColumnarReplicaManager(self)
         # overload plane (server/admission.py): workload-class admission gate
         # (AIMD limits, deadline-aware shedding) + the memory-pressure
         # governor (tiered fragment-cache/spill/AP-refusal responses)
@@ -261,6 +266,10 @@ class Instance:
             except Exception:
                 pass  # a corrupt counter record must not poison boot
         self.archive.attach(self.metadb)
+        # columnar replicas restore AFTER stores/dictionaries load (persisted
+        # stripe lanes hold dictionary codes) and resume tailing from the
+        # checkpointed binlog seq
+        self.columnar.load()
         # resolve provisional ±txn_id MVCC stamps left by a crash against the
         # durable tx log BEFORE anything reads the loaded partitions
         from galaxysql_tpu.txn.xa import recover_persisted
@@ -312,6 +321,10 @@ class Instance:
             store.save(os.path.join(self.data_dir, key.replace(".", os.sep)))
             self.metadb.save_table(store.table)
         self.metadb.kv_put("last_checkpoint_at", repr(t0))
+        # columnar replica checkpoint rides the same save: stripe lanes hold
+        # dictionary codes, so persisting them beside the stores' own
+        # dictionaries.json keeps the code spaces consistent on reload
+        self.columnar.save()
         # catalog counters ride the checkpoint so a restarted coordinator
         # keeps its persisted SPM baselines + heal state valid (see boot())
         self.metadb.kv_put("catalog.versions", json.dumps(

@@ -1060,6 +1060,10 @@ class Session:
         from galaxysql_tpu.plan import logical as L
         mdl_keys = {f"{n.table.schema.lower()}.{n.table.name.lower()}"
                     for n in L.walk(plan.rel) if isinstance(n, L.Scan)}
+        # columnar HTAP routing (storage/columnar.py): large AP scans flip to
+        # the CDC-fed replica at a TSO watermark; TP point reads and
+        # fresh-read sessions stay on the row store via the fence below
+        self._maybe_route_columnar(plan, ctx, sql, schema)
         if governed:
             # created immediately before the try that closes it: an
             # exception between creation and teardown would leak the child
@@ -1084,6 +1088,103 @@ class Session:
             # left reserved and unlinks from the global hierarchy
             if ctx.mem_pool is not None:
                 ctx.mem_pool.close()
+
+    # -- columnar HTAP routing (storage/columnar.py) ---------------------------
+
+    def _maybe_route_columnar(self, plan, ctx, sql, schema):
+        """Route this query's scans onto the columnar replica when every gate
+        opens: hatch trio (COLUMNAR hint > ENABLE_COLUMNAR_REPLICA >
+        GALAXYSQL_COLUMNAR env), autocommit read (no txn), no flashback, no
+        remote tables, the observed/estimated scan size clears
+        COLUMNAR_MIN_SCAN_ROWS, every scanned table has a READY replica whose
+        schema matches, the read-your-writes fence passes, and the routed
+        watermark is inside the COLUMNAR_MAX_LAG_MS freshness SLA.  On route:
+        snapshot_ts pins to the watermark and scans read ReplicaView
+        snapshots (the fragment cache keys them by replica generation —
+        see _fp_scan's "cscan" branch)."""
+        from galaxysql_tpu.storage import columnar as _col
+        if not _col.ENABLED:
+            return
+        hint = (ctx.hints or {}).get("columnar")
+        if hint == "off":
+            return
+        mgr = getattr(self.instance, "columnar", None)
+        if mgr is None or (hint != "on" and not mgr.enabled(self)):
+            return
+        if self.txn is not None or ctx.txn_id:
+            return  # txn reads must see their own provisional rows
+        from galaxysql_tpu.plan import logical as L
+        scans = [n for n in L.walk(plan.rel) if isinstance(n, L.Scan)]
+        if not scans:
+            return
+        for n in scans:
+            if n.as_of is not None or \
+                    getattr(n.table, "remote", None) is not None:
+                return  # flashback / plan-shipped scans stay where they are
+            if n.point_eq is not None and hint != "on":
+                return  # TP index path: the row store's key-Get wins
+        if hint != "on" and not self._columnar_signal(sql, schema, scans):
+            return
+        views = {}
+        for n in scans:
+            key = f"{n.table.schema.lower()}.{n.table.name.lower()}"
+            if key in views:
+                continue
+            rep = mgr.replica(n.table.schema, n.table.name)
+            if hint == "on" and (rep is None or rep.state != _col.READY):
+                rep = mgr.ensure_ready(n.table.schema, n.table.name)
+            elif rep is None:
+                # observed-size signal fired: enroll asynchronously; this
+                # query (and every one until READY) stays on the row store
+                mgr.request(n.table.schema, n.table.name)
+                return
+            if rep.sig != tuple(n.table.column_names()):
+                return  # DDL outran the tailer; reseed pending
+            view = rep.view()
+            if view is None:
+                return
+            views[key] = view
+        # one snapshot timestamp for the whole query: the minimum watermark.
+        # Every view serves any ts in [seed_ts, its watermark], so min(W) is
+        # exact everywhere — unless a fresh seed starts above it.
+        w = min(v.watermark for v in views.values())
+        if w <= 0 or w < max(v.seed_ts for v in views.values()):
+            return
+        if getattr(self, "_last_commit_ts", 0) > w:
+            return  # read-your-writes fence: this session wrote past W
+        if hint != "on":
+            from galaxysql_tpu.meta.tso import LOGICAL_BITS
+            max_lag = float(self.instance.config.get(
+                "COLUMNAR_MAX_LAG_MS", self.vars) or 10_000)
+            if time.time() * 1000.0 - (w >> LOGICAL_BITS) > max_lag:
+                return  # freshness SLA blown: fall back to the row store
+        ctx.snapshot_ts = w
+        ctx.columnar = views
+        mgr.routed.inc()
+
+    def _columnar_signal(self, sql, schema, scans) -> bool:
+        """Is this statement big enough for the replica?  Primary signal:
+        the statement summary's observed per-digest rows-examined (PR 10's
+        runtime truth); cold digests fall back to the planner's estimate."""
+        min_rows = int(self.instance.config.get(
+            "COLUMNAR_MIN_SCAN_ROWS", self.vars) or 50_000)
+        if sql and not sql.startswith("<"):
+            try:
+                execs, avg_rx = self.instance.stmt_summary.digest_signal(
+                    (schema or self.schema or "").lower(),
+                    parameterize(sql).parameterized)
+            except Exception:  # galaxylint: disable=swallow -- the size signal is advisory: a summary fault must never fail a query, it only defers to the estimate below
+                execs, avg_rx = 0, 0.0
+            if execs > 0:
+                return avg_rx >= min_rows
+        from galaxysql_tpu.plan.rules import estimate_rows
+        est = 0
+        for n in scans:
+            try:
+                est += int(estimate_rows(n) or 0)
+            except Exception:  # galaxylint: disable=swallow -- estimate faults defer to "too small": mis-estimating must never fail a query
+                pass
+        return est >= min_rows
 
     # -- point-plan fast path (DirectShardingKeyTableOperation / XPlan key-Get
     # analog, Planner.java:914): archetypal `SELECT cols FROM t WHERE pk = ?`
@@ -1476,7 +1577,12 @@ class Session:
         a real TSO value for autocommit single-statement writes."""
         if self.txn is not None:
             return -self.txn.txn_id, self.txn
-        return self.instance.tso.next_timestamp(), None
+        ts = self.instance.tso.next_timestamp()
+        # read-your-writes fence for the columnar router: a later scan must
+        # not route to a replica watermark below this write (txn commits
+        # stamp the same field in _commit)
+        self._last_commit_ts = ts
+        return ts, None
 
     def _note_write(self, tm):
         """Post-DML fragment-cache hygiene: the version bump already makes
@@ -2151,6 +2257,7 @@ class Session:
             return ResultSet(["plan"], [dt.VARCHAR], [("not a plannable statement",)])
         plan = self.instance.planner.bind_statement(inner, schema, params or [])
         lines = plan.explain().split("\n")
+        col_views = None
         if stmt.analyze:
             from galaxysql_tpu.utils.tracing import (QueryProfile,
                                                      SEGMENT_TRACER)
@@ -2172,6 +2279,10 @@ class Session:
             from galaxysql_tpu.exec import skew as _skew
             ctx.skew_modes = _skew.exec_modes(ctx.hints, self.instance,
                                               self.vars)
+            # same columnar-replica routing as the real path: ANALYZE numbers
+            # must describe the tier the query actually reads
+            self._maybe_route_columnar(plan, ctx, None, schema)
+            col_views = ctx.columnar
             prof = QueryProfile(trace_id=self.instance.trace_ids.next(),
                                 sql="<explain analyze>", schema=schema,
                                 conn_id=self.conn_id, started_at=time.time())
@@ -2234,6 +2345,27 @@ class Session:
                              f"compiled={sp.compiled} wall={sp.wall_ms}ms")
             self._finish_query(prof.sql, elapsed, prof, plan.workload,
                                "local", rows, ctx, plan=plan)
+        if col_views is None:
+            # plain EXPLAIN: dry-run the routing decision against a throwaway
+            # probe so freshness shows up without executing anything
+            class _Probe:
+                pass
+            probe = _Probe()
+            probe.hints = getattr(plan, "hints", None) or {}
+            probe.txn_id = 0
+            probe.snapshot_ts = None
+            probe.columnar = {}
+            self._maybe_route_columnar(plan, probe, None, schema)
+            col_views = probe.columnar
+        if col_views:
+            from galaxysql_tpu.meta.tso import LOGICAL_BITS as _LB
+            for key in sorted(col_views):
+                v = col_views[key]
+                lag = max(time.time() * 1000.0 - (v.watermark >> _LB), 0.0)
+                lines.append(f"-- columnar: {key} watermark={v.watermark} "
+                             f"freshness_lag_ms={lag:.1f} "
+                             f"stripes={len(v.stripes)} "
+                             f"delta_chunks={len(v.delta)}")
         lines.append(f"-- workload: {plan.workload}")
         return ResultSet(["plan"], [dt.VARCHAR], [(l,) for l in lines])
 

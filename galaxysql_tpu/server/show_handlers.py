@@ -455,6 +455,17 @@ def handle(session, stmt: ast.Show):
             [dt.VARCHAR, dt.BIGINT, dt.DOUBLE, dt.DOUBLE, dt.DOUBLE,
              dt.DOUBLE],
             session.instance.metric_history.rows(stmt.like))
+    if kind == "columnar_replica":
+        # SHOW COLUMNAR REPLICA: per-table tailer state, watermark freshness,
+        # and tier shape (storage/columnar.py)
+        return ResultSet(
+            ["Table", "State", "Watermark", "Lag_ms", "Delta_rows",
+             "Base_stripes", "Compactions", "Reseeds", "Pruned_stripes",
+             "Applied_events", "Applied_rows"],
+            [dt.VARCHAR, dt.VARCHAR, dt.BIGINT, dt.DOUBLE, dt.BIGINT,
+             dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.BIGINT, dt.BIGINT,
+             dt.BIGINT],
+            session.instance.columnar.rows())
     if kind == "cluster_health":
         # SHOW CLUSTER HEALTH: this coordinator + a fresh `health` pull
         # from every attached worker (UNREACHABLE rows, never errors)

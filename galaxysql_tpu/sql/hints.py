@@ -41,6 +41,10 @@ behind them:
   (kernels/relational.py): OFF pins the reference join/agg formulations,
   PALLAS forces the Pallas kernels below the auto row floor, ON restores
   auto selection under a disabling ENABLE_PALLAS_KERNELS.  `=` accepted.
+- COLUMNAR(OFF|ON)         per-statement control of columnar-replica routing
+  (storage/columnar.py): OFF pins the statement to the row store, ON forces
+  the replica (enrolling + seeding the scanned tables synchronously) even
+  under a disabling ENABLE_COLUMNAR_REPLICA.  `=` accepted.
 - BASELINE_OFF             bypass SPM for the statement (plan as costed)
 
 Unknown directives are ignored (hints must never break a query), matching the
@@ -113,6 +117,12 @@ def parse_hints(comment: Optional[str]) -> Dict[str, object]:
             mode = arglist[0].lower()
             if mode in ("off", "pallas", "on"):
                 out["kernel"] = mode
+        elif name == "COLUMNAR" and arglist:
+            # columnar-replica routing (storage/columnar.py): OFF pins the
+            # row store, ON forces the replica (synchronous enroll+seed)
+            mode = arglist[0].lower()
+            if mode in ("off", "on"):
+                out["columnar"] = mode
         elif name == "MAX_EXECUTION_TIME" and arglist:
             try:
                 ms = int(arglist[0])

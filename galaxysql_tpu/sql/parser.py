@@ -1602,6 +1602,11 @@ class Parser:
             # SHOW METRIC HISTORY [LIKE pattern] (utils/metric_history.py)
             self.expect_kw("HISTORY")
             stmt.kind = "metric_history"
+        elif kind == "COLUMNAR":
+            # SHOW COLUMNAR REPLICA — per-table tailer state, watermark
+            # freshness, and tier shape (storage/columnar.py)
+            self.expect_kw("REPLICA")
+            stmt.kind = "columnar_replica"
         elif kind == "CLUSTER":
             # SHOW CLUSTER HEALTH (coordinator + per-worker snapshots) |
             # SHOW CLUSTER STATEMENT SUMMARY | SHOW CLUSTER METRICS —

@@ -19,6 +19,7 @@ from typing import Dict, Iterator, List, Optional
 import numpy as np
 
 from galaxysql_tpu.chunk.batch import Column, ColumnBatch
+from galaxysql_tpu.storage.zonemap import sargs_refuted
 from galaxysql_tpu.types import datatype as dt
 from galaxysql_tpu.utils import errors
 
@@ -235,7 +236,9 @@ class ArchiveManager:
         """True when parquet column min-max stats prove NO row can satisfy
         the conjunctive sargs [(column, op, lane_value)] — the SARG/min-max
         file skip of the reference's columnar scans (OSSTableScanExec.java:
-        45-61).  Missing stats never prune (advisory only)."""
+        45-61).  Evaluation itself lives in `storage/zonemap.sargs_refuted`,
+        shared with the HTAP replica's stripe zone maps; this method only
+        builds + caches the per-file stats from parquet metadata."""
         if not sargs:
             return False
         with self._lock:
@@ -264,18 +267,7 @@ class ArchiveManager:
                 stats = {}
             with self._lock:
                 self._file_stats[path] = stats
-        for cname, op, v in sargs:
-            mm = stats.get(cname)
-            if mm is None:
-                continue
-            lo, hi = mm
-            if (op == "eq" and (v < lo or v > hi)) or \
-                    (op in ("lt",) and lo >= v) or \
-                    (op in ("le",) and lo > v) or \
-                    (op in ("gt",) and hi <= v) or \
-                    (op in ("ge",) and hi < v):
-                return True
-        return False
+        return sargs_refuted(stats, sargs)
 
     def scan_archive(self, instance, schema: str, table: str,
                      columns: List[str],

@@ -1,0 +1,541 @@
+"""Columnar HTAP replica: CDC-fed delta+base tier, stats-driven routing.
+
+The contract under test (storage/columnar.py): a replica read routed at
+watermark W is BIT-identical to a row-store read at W — through sustained
+DML, compaction racing in-flight views, DDL mid-tail (reseed), and
+crash/restart resume from the persisted watermark.  Plus the routing gates
+(size signal, read-your-writes fence, freshness SLA, txn/point bypass), the
+hatch trio, and the SHOW / information_schema / EXPLAIN surfaces.
+
+Tests run the tailer synchronously (COLUMNAR_POLL_MS=0 disables the thread;
+`tail_once()` is driven explicitly) with a 1ms watermark margin, so every
+`sleep(MARGIN); tail_once()` deterministically advances the watermark past
+all prior commits.
+"""
+
+import time
+
+import pytest
+
+from galaxysql_tpu.server.instance import Instance
+from galaxysql_tpu.server.session import Session
+from galaxysql_tpu.storage import columnar as col
+
+# sleep long enough that now - margin exceeds every prior commit's TSO
+MARGIN_S = 0.005
+
+DDL = ("CREATE TABLE t (id BIGINT PRIMARY KEY, grp BIGINT, val VARCHAR(16)) "
+       "PARTITION BY HASH(id) PARTITIONS 4")
+Q_AGG = "SELECT grp, count(*), sum(id) FROM t GROUP BY grp ORDER BY grp"
+Q_ALL = "SELECT id, grp, val FROM t ORDER BY id"
+HINT = "/*+TDDL:COLUMNAR(ON)*/ "
+
+
+def make_instance(data_dir=None, **params):
+    inst = Instance(data_dir=data_dir)
+    inst.config.set_instance("COLUMNAR_POLL_MS", 0)  # synchronous tailer
+    inst.config.set_instance("COLUMNAR_WATERMARK_LAG_MS", 1)
+    for k, v in params.items():
+        inst.config.set_instance(k, v)
+    return inst
+
+
+def advance(inst):
+    """Let the margin elapse, then run one tail cycle: afterwards the
+    watermark covers every commit made before this call."""
+    time.sleep(MARGIN_S)
+    return inst.columnar.tail_once()
+
+
+@pytest.fixture()
+def session():
+    inst = make_instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE c; USE c")
+    s.execute(DDL)
+    s.execute("INSERT INTO t VALUES " +
+              ",".join(f"({i},{i % 7},'v{i % 5}')" for i in range(200)))
+    yield s
+    s.close()
+
+
+def both(s, q):
+    """(columnar rows, row-store rows, routed?) for one query."""
+    r0 = s.instance.columnar.routed.value
+    on = s.execute(HINT + q).rows
+    off = s.execute("/*+TDDL:COLUMNAR(OFF)*/ " + q).rows
+    return on, off, s.instance.columnar.routed.value > r0
+
+
+@pytest.mark.columnar
+class TestBitIdentity:
+    def test_seeded_scan_identical_and_routed(self, session):
+        s = session
+        time.sleep(MARGIN_S)
+        rep = s.instance.columnar.ensure_ready("c", "t")
+        assert rep.state == col.READY and rep.watermark > 0
+        for q in (Q_ALL, Q_AGG, "SELECT count(*) FROM t WHERE grp = 3"):
+            on, off, routed = both(s, q)
+            assert routed
+            assert on == off
+
+    def test_identity_through_dml_stream(self, session):
+        s = session
+        time.sleep(MARGIN_S)
+        rep = s.instance.columnar.ensure_ready("c", "t")
+        for rnd in range(3):
+            base = 1000 * (rnd + 1)
+            s.execute("INSERT INTO t VALUES " + ",".join(
+                f"({base + i},{i % 7},'n{rnd}')" for i in range(40)))
+            s.execute(f"DELETE FROM t WHERE id < {20 * (rnd + 1)}")
+            s.execute(f"UPDATE t SET grp = grp + 1 WHERE id >= {base + 30}")
+            advance(s.instance)
+            assert rep.state == col.READY  # no reseed: deltas applied cleanly
+            on, off, routed = both(s, Q_ALL)
+            assert routed and on == off
+            on, off, _ = both(s, Q_AGG)
+            assert on == off
+        assert rep.applied_events > 0 and rep.applied_rows > 0
+
+    def test_old_view_matches_flashback_at_its_watermark(self, session):
+        """A view snapshot taken before later DML + compaction still reads
+        exactly the rows the row store shows AS OF that watermark."""
+        s = session
+        time.sleep(MARGIN_S)
+        rep = s.instance.columnar.ensure_ready("c", "t")
+        v1 = rep.view()
+        s.execute("DELETE FROM t WHERE id < 100")
+        s.execute("INSERT INTO t VALUES (5000, 1, 'late')")
+        advance(s.instance)
+        tm = s.instance.catalog.table("c", "t")
+        live = sum(int(b.num_live()) for b in col.scan_view(v1, tm, ["id"]))
+        flashback = s.execute(
+            f"SELECT count(*) FROM t AS OF TSO {v1.watermark}").rows
+        assert [(live,)] == flashback
+
+    @pytest.mark.parametrize("qid", [1, 3, 5])
+    def test_tpch_on_vs_off(self, tpch_columnar, qid):
+        from galaxysql_tpu.storage.tpch_queries import QUERIES
+        s = tpch_columnar
+        r0 = s.instance.columnar.routed.value
+        on = s.execute(HINT + QUERIES[qid]).rows
+        assert s.instance.columnar.routed.value > r0
+        off = s.execute("/*+TDDL:COLUMNAR(OFF)*/ " + QUERIES[qid]).rows
+        assert on == off
+
+
+@pytest.fixture(scope="module")
+def tpch_columnar():
+    from galaxysql_tpu.storage import tpch
+    data = tpch.generate(0.01)
+    inst = make_instance()
+    s = Session(inst)
+    s.execute("CREATE DATABASE tpch; USE tpch")
+    for t in tpch.TABLE_ORDER:
+        s.execute(tpch.TPCH_DDL[t])
+        inst.store("tpch", t).insert_arrays(data[t],
+                                            inst.tso.next_timestamp())
+    s.execute("ANALYZE TABLE " + ", ".join(tpch.TABLE_ORDER))
+    time.sleep(MARGIN_S)
+    for t in tpch.TABLE_ORDER:
+        inst.columnar.ensure_ready("tpch", t)
+    yield s
+    s.close()
+
+
+@pytest.mark.columnar
+class TestTailer:
+    def test_crash_restart_resumes_from_persisted_watermark(self, tmp_path):
+        d = str(tmp_path / "data")
+        inst = make_instance(data_dir=d)
+        s = Session(inst)
+        s.execute("CREATE DATABASE c; USE c")
+        s.execute(DDL)
+        s.execute("INSERT INTO t VALUES " +
+                  ",".join(f"({i},{i % 3},'a')" for i in range(100)))
+        time.sleep(MARGIN_S)
+        rep = inst.columnar.ensure_ready("c", "t")
+        s.execute("DELETE FROM t WHERE id < 10")
+        advance(inst)
+        saved_seq, saved_wm = rep.seq, rep.watermark
+        inst.save()
+        s.close()
+
+        inst2 = make_instance(data_dir=d)
+        s2 = Session(inst2, "c")
+        rep2 = inst2.columnar.replica("c", "t")
+        assert rep2 is not None and rep2.state == col.READY
+        assert rep2.seq == saved_seq and rep2.watermark == saved_wm
+        assert rep2.reseeds == 0  # resumed, not rebuilt
+        s2.execute("INSERT INTO t VALUES (900, 1, 'post'), (901, 2, 'post')")
+        advance(inst2)
+        on, off, routed = both(s2, Q_ALL)
+        assert routed and on == off
+        s2.close()
+
+    def test_compaction_races_writes_and_inflight_views(self, session):
+        s = session
+        s.instance.config.set_instance("COLUMNAR_COMPACT_ROWS", 32)
+        time.sleep(MARGIN_S)
+        rep = s.instance.columnar.ensure_ready("c", "t")
+        views = []
+        for rnd in range(4):
+            base = 2000 + 100 * rnd
+            s.execute("INSERT INTO t VALUES " + ",".join(
+                f"({base + i},{i % 5},'c{rnd}')" for i in range(40)))
+            s.execute(f"DELETE FROM t WHERE id >= {base} "
+                      f"AND id < {base + 10}")
+            advance(s.instance)
+            views.append(rep.view())
+            on, off, _ = both(s, Q_AGG)
+            assert on == off
+        assert rep.compactions >= 1
+        # every in-flight view still reads its own watermark exactly —
+        # compaction swapped the tier wholesale and only dropped rows dead
+        # below the minimum watermark
+        tm = s.instance.catalog.table("c", "t")
+        for v in views:
+            live = sum(int(b.num_live())
+                       for b in col.scan_view(v, tm, ["id"]))
+            assert [(live,)] == s.execute(
+                f"SELECT count(*) FROM t AS OF TSO {v.watermark}").rows
+
+    @pytest.mark.parametrize("ddl", ["ALTER TABLE t ADD COLUMN extra BIGINT",
+                                     "ALTER TABLE t DROP COLUMN val"])
+    def test_ddl_mid_tail_reseeds(self, session, ddl):
+        s = session
+        time.sleep(MARGIN_S)
+        rep = s.instance.columnar.ensure_ready("c", "t")
+        s.execute("INSERT INTO t VALUES (3000, 1, 'pre')")
+        s.execute(ddl)
+        s.execute("DELETE FROM t WHERE id = 3000")
+        advance(s.instance)   # detects the signature change -> RESEED
+        advance(s.instance)   # reseeds against the new schema
+        assert rep.state == col.READY
+        assert rep.reseeds >= 1
+        assert rep.sig == tuple(
+            s.instance.catalog.table("c", "t").column_names())
+        q = "SELECT * FROM t ORDER BY id"
+        on, off, routed = both(s, q)
+        assert routed and on == off
+
+    def test_unmatched_delete_image_self_heals(self, session):
+        s = session
+        time.sleep(MARGIN_S)
+        rep = s.instance.columnar.ensure_ready("c", "t")
+        rep.tier = ((), ())  # simulate divergence: the replica lost its rows
+        rep.pk = None
+        s.execute("DELETE FROM t WHERE id = 7")
+        advance(s.instance)
+        assert rep.state == col.RESEED  # delete image had no live match
+        advance(s.instance)
+        assert rep.state == col.READY and rep.reseeds >= 1
+        on, off, _ = both(s, Q_ALL)
+        assert on == off
+
+    def test_tailer_failure_publishes_event(self, session):
+        from galaxysql_tpu.utils import events
+        inst = session.instance
+        inst.config.set_instance("COLUMNAR_POLL_MS", 5)
+        mgr = inst.columnar
+        orig = mgr.tail_once
+        mgr.tail_once = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+        try:
+            mgr._start_thread()
+            deadline = time.time() + 5
+            while time.time() < deadline and not events.EVENTS.entries(
+                    kind="columnar_tail_failed"):
+                time.sleep(0.01)
+            assert events.EVENTS.entries(kind="columnar_tail_failed")
+        finally:
+            mgr.tail_once = orig
+            mgr.shutdown()
+            inst.config.set_instance("COLUMNAR_POLL_MS", 0)
+
+
+@pytest.mark.columnar
+class TestRouting:
+    def test_hatch_trio_structurally_off_path(self, session, monkeypatch):
+        s = session
+        mgr = s.instance.columnar
+        time.sleep(MARGIN_S)
+        mgr.ensure_ready("c", "t")
+        # leg 1: hint OFF wins over the session/global param
+        s.instance.config.set_instance("ENABLE_COLUMNAR_REPLICA", True)
+        s.instance.config.set_instance("COLUMNAR_MIN_SCAN_ROWS", 1)
+        r0 = mgr.routed.value
+        off = s.execute("/*+TDDL:COLUMNAR(OFF)*/ " + Q_AGG).rows
+        assert mgr.routed.value == r0
+        # param on + signal above threshold: routes without any hint
+        s.execute(Q_AGG)  # warms the digest's rows-examined signal
+        assert s.execute(Q_AGG).rows == off
+        assert mgr.routed.value > r0
+        # leg 2: param off (the default) never routes without the hint
+        s.instance.config.set_instance("ENABLE_COLUMNAR_REPLICA", False)
+        r1 = mgr.routed.value
+        s.execute(Q_AGG)
+        assert mgr.routed.value == r1
+        # leg 3: env kill switch beats even COLUMNAR(ON)
+        monkeypatch.setattr(col, "ENABLED", False)
+        r2 = mgr.routed.value
+        assert s.execute(HINT + Q_AGG).rows == off
+        assert mgr.routed.value == r2
+        assert mgr.tail_once() == 0  # the tailer is dead too
+
+    def test_size_signal_enrolls_async_then_routes(self, session):
+        s = session
+        mgr = s.instance.columnar
+        s.instance.config.set_instance("ENABLE_COLUMNAR_REPLICA", True)
+        s.instance.config.set_instance("COLUMNAR_MIN_SCAN_ROWS", 1)
+        s.execute("ANALYZE TABLE t")
+        assert mgr.replica("c", "t") is None
+        r0 = mgr.routed.value
+        rows = s.execute(Q_AGG).rows  # signal fires: enroll, stay on row store
+        assert mgr.routed.value == r0
+        rep = mgr.replica("c", "t")
+        assert rep is not None and rep.state == col.SEEDING
+        time.sleep(MARGIN_S)
+        advance(s.instance)
+        assert rep.state == col.READY
+        assert s.execute(Q_AGG).rows == rows
+        assert mgr.routed.value > r0
+
+    def test_point_and_txn_reads_stay_on_row_store(self, session):
+        s = session
+        mgr = s.instance.columnar
+        time.sleep(MARGIN_S)
+        mgr.ensure_ready("c", "t")
+        s.instance.config.set_instance("ENABLE_COLUMNAR_REPLICA", True)
+        s.instance.config.set_instance("COLUMNAR_MIN_SCAN_ROWS", 1)
+        r0 = mgr.routed.value
+        s.execute("SELECT val FROM t WHERE id = 7")  # TP key-Get path
+        assert mgr.routed.value == r0
+        s.execute("BEGIN")
+        s.execute(HINT + Q_AGG)  # txn reads see provisional rows: no route
+        s.execute("ROLLBACK")
+        assert mgr.routed.value == r0
+
+    def test_read_your_writes_fence(self, session):
+        s = session
+        mgr = s.instance.columnar
+        time.sleep(MARGIN_S)
+        mgr.ensure_ready("c", "t")
+        s.execute("INSERT INTO t VALUES (4000, 1, 'mine')")
+        # no tail cycle ran: the watermark predates this session's write
+        r0 = mgr.routed.value
+        rows = s.execute(HINT + Q_ALL).rows
+        assert mgr.routed.value == r0  # fence held: row store served it
+        assert (4000, 1, "mine") in rows
+        advance(s.instance)  # watermark passes the write: fence opens
+        assert s.execute(HINT + Q_ALL).rows == rows
+        assert mgr.routed.value > r0
+
+    def test_freshness_slo_blocks_stale_replica(self, session):
+        s = session
+        mgr = s.instance.columnar
+        time.sleep(MARGIN_S)
+        mgr.ensure_ready("c", "t")
+        advance(s.instance)
+        s.instance.config.set_instance("ENABLE_COLUMNAR_REPLICA", True)
+        s.instance.config.set_instance("COLUMNAR_MIN_SCAN_ROWS", 1)
+        s.execute(Q_AGG)  # warm the digest signal
+        s.instance.config.set_instance("COLUMNAR_MAX_LAG_MS", 1)
+        time.sleep(0.05)  # let the replica go stale past the 1ms SLA
+        r0 = mgr.routed.value
+        s.execute(Q_AGG)
+        assert mgr.routed.value == r0  # SLA blown: row store
+        assert s.execute(HINT + Q_AGG)  # explicit hint overrides the SLA
+        assert mgr.routed.value > r0
+
+    def test_zone_maps_prune_stripes(self, session):
+        s = session
+        s.instance.config.set_instance("COLUMNAR_COMPACT_ROWS", 10)
+        s.execute("CREATE TABLE zp (id BIGINT PRIMARY KEY, v BIGINT)")
+        s.execute("INSERT INTO zp VALUES " +
+                  ",".join(f"({i},{i})" for i in range(64)))
+        time.sleep(MARGIN_S)
+        rep = s.instance.columnar.ensure_ready("c", "zp")
+        s.execute("INSERT INTO zp VALUES " +
+                  ",".join(f"({i},{i})" for i in range(100000, 100064)))
+        advance(s.instance)  # compacts the high-id delta into its own stripe
+        assert len(rep.tier[0]) >= 2
+        p0 = rep.pruned_stripes
+        q = "SELECT count(*), sum(v) FROM zp WHERE id < 50"
+        on, off, routed = both(s, q)
+        assert routed and on == off
+        assert rep.pruned_stripes > p0  # the 100000+ stripe never scanned
+
+
+@pytest.mark.columnar
+class TestSurfaces:
+    def test_show_and_information_schema_parity(self, session):
+        s = session
+        time.sleep(MARGIN_S)
+        s.instance.columnar.ensure_ready("c", "t")
+        show = s.execute("SHOW COLUMNAR REPLICA").rows
+        assert len(show) == 1 and show[0][0] == "c.t"
+        assert show[0][1] == "READY" and show[0][5] > 0  # base stripes
+        info = s.execute(
+            "SELECT table_name, state, base_stripes "
+            "FROM information_schema.columnar_replica").rows
+        assert info == [(r[0], r[1], r[5]) for r in show]
+        metrics = s.execute(
+            "SELECT metric_name FROM information_schema.metrics "
+            "WHERE metric_name LIKE 'columnar%'").rows
+        assert {"columnar_events_applied", "columnar_routed_queries",
+                "columnar_lag_ms"} <= {r[0] for r in metrics}
+
+    def test_explain_shows_freshness_and_route(self, session):
+        s = session
+        time.sleep(MARGIN_S)
+        s.instance.columnar.ensure_ready("c", "t")
+        plain = [r[0] for r in s.execute(
+            "EXPLAIN " + HINT + Q_AGG).rows]
+        line = [l for l in plain if l.startswith("-- columnar: c.t")]
+        assert line and "freshness_lag_ms=" in line[0] \
+            and "watermark=" in line[0]
+        analyzed = [r[0] for r in s.execute(
+            "EXPLAIN ANALYZE " + HINT + Q_AGG).rows]
+        assert any("scan-columnar t" in l for l in analyzed)
+        # OFF leaves no columnar trace at all
+        off = [r[0] for r in s.execute(
+            "EXPLAIN ANALYZE /*+TDDL:COLUMNAR(OFF)*/ " + Q_AGG).rows]
+        assert not any("columnar" in l for l in off)
+
+
+@pytest.mark.columnar
+class TestGuards:
+    def test_steady_state_retraces_zero(self, session):
+        from galaxysql_tpu.exec.operators import (COMPILE_STATS,
+                                                  reset_compile_stats)
+        s = session
+        time.sleep(MARGIN_S)
+        s.instance.columnar.ensure_ready("c", "t")
+        for _ in range(2):  # warm every kernel shape on the replica path
+            s.execute(HINT + Q_AGG)
+        reset_compile_stats()
+        for _ in range(3):
+            s.execute(HINT + Q_AGG)
+        assert COMPILE_STATS["retraces"] == 0
+
+    def test_default_instance_has_no_columnar_footprint(self):
+        """ENABLE_COLUMNAR_REPLICA defaults off: a plain instance never
+        enrolls, routes, or tails — the row-store path is unperturbed."""
+        inst = make_instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE c; USE c")
+        s.execute(DDL)
+        s.execute("INSERT INTO t VALUES (1, 1, 'a')")
+        s.execute(Q_AGG)
+        assert inst.columnar.replicas == {}
+        assert inst.columnar.routed.value == 0
+        assert inst.columnar._thread is None
+        s.close()
+
+
+@pytest.mark.columnar
+class TestClusteringAndCacheKeys:
+    def test_clustered_seed_prunes_and_stays_identical(self):
+        """COLUMNAR_CLUSTER_BY re-sorts the seed on the cluster column and
+        slices it into threshold stripes with disjoint zone-map ranges: a
+        range SARG then prunes whole stripes, and every result still matches
+        the row store (decimal/int aggregation is order-independent)."""
+        inst = make_instance(COLUMNAR_CLUSTER_BY="t:grp",
+                             COLUMNAR_COMPACT_ROWS=64)
+        s = Session(inst)
+        s.execute("CREATE DATABASE c; USE c")
+        s.execute(DDL)
+        s.execute("INSERT INTO t VALUES " +
+                  ",".join(f"({i},{i % 7},'v{i % 5}')" for i in range(200)))
+        time.sleep(MARGIN_S)
+        rep = inst.columnar.ensure_ready("c", "t")
+        stripes = rep.tier[0]
+        assert len(stripes) == 4  # 200 rows / 64-row threshold
+        ranges = [st.zmap["grp"] for st in stripes]
+        assert ranges == sorted(ranges)
+        # consecutive stripes overlap at most at the slice boundary value
+        for (_, hi), (lo, _) in zip(ranges, ranges[1:]):
+            assert lo >= hi - 1
+        p0 = inst.columnar.pruned.value
+        on, off, routed = both(s, "SELECT count(*), sum(id) FROM t "
+                                  "WHERE grp >= 5")
+        assert routed and on == off
+        assert inst.columnar.pruned.value > p0
+        # full-range queries cannot prune but still agree bit-for-bit
+        for q in (Q_ALL, Q_AGG):
+            on, off, _ = both(s, q)
+            assert on == off
+        s.close()
+
+    def test_cluster_spec_unknown_column_is_ignored(self):
+        inst = make_instance(COLUMNAR_CLUSTER_BY="t:nope,other:grp")
+        s = Session(inst)
+        s.execute("CREATE DATABASE c; USE c")
+        s.execute(DDL)
+        s.execute("INSERT INTO t VALUES (1, 1, 'a'), (2, 2, 'b')")
+        time.sleep(MARGIN_S)
+        rep = inst.columnar.ensure_ready("c", "t")
+        on, off, _ = both(s, Q_ALL)
+        assert rep.state == col.READY and on == off
+        s.close()
+
+    def test_generation_key_caches_idle_and_recomputes_on_dml(self):
+        """Replica scans fingerprint by (seed_ts, applied_events), not the
+        watermark: idle watermark advances keep fragments warm; applied DML
+        moves the generation so results are recomputed, and the
+        max_applied_ts guard blocks caching while the routed watermark is
+        still below the newest applied stamp."""
+        inst = make_instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE c; USE c")
+        s.execute(DDL)
+        s.execute("INSERT INTO t VALUES " +
+                  ",".join(f"({i},{i % 7},'v{i % 5}')" for i in range(200)))
+        time.sleep(MARGIN_S)
+        rep = inst.columnar.ensure_ready("c", "t")
+        sr = Session(inst, schema="c")
+        r1 = sr.execute(HINT + Q_AGG).rows
+        w1 = rep.watermark
+        advance(inst)  # idle cycle: watermark moves, generation does not
+        assert rep.watermark > w1
+        h0, m0 = inst.frag_cache.hits, inst.frag_cache.misses
+        assert sr.execute(HINT + Q_AGG).rows == r1
+        assert inst.frag_cache.hits > h0
+        assert inst.frag_cache.misses == m0
+        ev = rep.applied_events
+        s.execute("UPDATE t SET grp = 99 WHERE id < 10")
+        advance(inst)
+        assert rep.applied_events > ev  # generation moved with the DML
+        assert rep.max_applied_ts > w1
+        r2 = sr.execute(HINT + Q_AGG).rows
+        off = sr.execute("/*+TDDL:COLUMNAR(OFF)*/ " + Q_AGG).rows
+        assert r2 == off and r2 != r1
+        sr.close()
+        s.close()
+
+    def test_view_snapshot_is_consistent_tuple(self):
+        """view() must come from one published tuple: the watermark a view
+        carries never outruns the tier it pairs with (publish() swaps them
+        together), and compaction republishes without moving the
+        generation."""
+        inst = make_instance()
+        s = Session(inst)
+        s.execute("CREATE DATABASE c; USE c")
+        s.execute(DDL)
+        s.execute("INSERT INTO t VALUES " +
+                  ",".join(f"({i},{i % 7},'v{i % 5}')" for i in range(100)))
+        time.sleep(MARGIN_S)
+        rep = inst.columnar.ensure_ready("c", "t")
+        v = rep.view()
+        assert (v.stripes, v.delta) == rep.tier
+        assert v.events == rep.applied_events
+        assert v.max_applied_ts == rep.max_applied_ts
+        inst.config.set_instance("COLUMNAR_COMPACT_ROWS", 1)
+        ev = rep.applied_events
+        s.execute("INSERT INTO t VALUES (1000, 1, 'x')")
+        advance(inst)
+        assert rep.compactions >= 1
+        v2 = rep.view()
+        assert v2.events == rep.applied_events > ev
+        assert v2.delta == ()  # compacted tier republished
+        s.close()
