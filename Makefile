@@ -196,6 +196,22 @@ slo-smoke:
 bench-slo:
 	JAX_PLATFORMS=cpu $(PY) bench.py --slo-only
 
+# incident flight-recorder smoke: the incident marker suite — tail-sampled
+# trace retention (slow/shed/error tails kept at sample_rate=0, phase
+# breakdown on every root span), the injected-burn end-to-end (one bundle,
+# implicated digest, retained trace + metric window + admission state),
+# cooldown dedupe, SHOW INCIDENTS / info-schema / web surfaces, the
+# router-hop trace graft over a real subprocess peer, and the hot-path
+# guard (unchanged dispatch counts, zero steady retraces, sampling on)
+incident-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m incident -p no:cacheprovider
+
+# tracing-overhead bench: 32-session batched-serving closed loop with
+# always-on tail-sampled tracing vs GALAXYSQL_TRACING=0 — overhead target
+# <= 3%, dispatch counts unchanged, steady retraces 0 (BENCH_r14.json)
+bench-tracing:
+	JAX_PLATFORMS=cpu $(PY) bench.py --tracing-only
+
 # serving-tier smoke: the router marker suite — consistent-hash affinity,
 # session pinning + typed-once failover, cluster-wide admission gossip,
 # placement-driven locality, SHOW COORDINATORS / SHOW CLUSTER surfaces,
@@ -230,4 +246,4 @@ bench-htap:
 	overload-smoke bench-overload dml-smoke bench-dml lint lint-smoke \
 	rebalance-smoke chaos-rebalance bench-rebalance kernel-smoke \
 	bench-kernels slo-smoke bench-slo scaleout-smoke bench-scaleout \
-	columnar-smoke bench-htap
+	columnar-smoke bench-htap incident-smoke bench-tracing

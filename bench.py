@@ -1485,6 +1485,92 @@ def slo_only_main():
         print(json.dumps(out))
 
 
+def tracing_bench(inst, s, data, platform):
+    """Always-on tail-sampled tracing (ISSUE 20): the honest overhead
+    claim.  Closed-loop TP point serving on the 32-session batched-serving
+    loop with always-on collection at the DEFAULT head-sample rate (every
+    query builds its span skeleton + phase ramp timestamps; the sampler's
+    per-query cost is one dict probe + one compare) vs ENABLE_QUERY_TRACING
+    off entirely.  Target <= 3%: collection is host-side perf_counter reads
+    only — no device syncs, no extra dispatches (asserted here, not
+    assumed), steady-state retraces 0."""
+    from galaxysql_tpu.exec import operators as _ops
+
+    okeys = data["orders"]["o_orderkey"]
+    keys = [int(k) for k in okeys[:: max(1, len(okeys) // 2048)]]
+    tpl = "select o_totalprice from orders where o_orderkey = %d"
+    s.execute(tpl % keys[0])  # register + warm the PointPlan
+    n_s = int(os.environ.get("BENCH_TRACING_SESSIONS", "32"))
+    per = int(os.environ.get("BENCH_TRACING_PER_SESSION", "60"))
+    reps = int(os.environ.get("BENCH_TRACING_RUNS", "3"))
+    _closed_loop_point(inst, tpl, keys, n_s, 4)  # ramp both code paths
+
+    def best_pass(tracing_on):
+        inst.config.set_instance("ENABLE_QUERY_TRACING",
+                                 1 if tracing_on else 0)
+        _closed_loop_point(inst, tpl, keys, n_s, 4)  # re-warm under config
+        best_qps, best_p99 = 0.0, 0.0
+        for _ in range(reps):
+            qps, p99, errs = _closed_loop_point(inst, tpl, keys, n_s, per)
+            if errs:
+                raise errs[0]
+            if qps > best_qps:
+                best_qps, best_p99 = qps, p99
+        return best_qps, best_p99
+
+    # hot-path guard measured inline: dispatch counts per pass must be
+    # IDENTICAL on vs off, and a warm loop compiles nothing new
+    inst.config.set_instance("ENABLE_QUERY_TRACING", 1)
+    _closed_loop_point(inst, tpl, keys, n_s, 4)
+    _ops.reset_dispatch_stats()
+    r0 = _ops.COMPILE_STATS["retraces"]
+    _closed_loop_point(inst, tpl, keys, n_s, 8)
+    d_on = _ops.DISPATCH_STATS["dispatches"]
+    retraces_on = _ops.COMPILE_STATS["retraces"] - r0
+    inst.config.set_instance("ENABLE_QUERY_TRACING", 0)
+    _closed_loop_point(inst, tpl, keys, n_s, 4)
+    _ops.reset_dispatch_stats()
+    _closed_loop_point(inst, tpl, keys, n_s, 8)
+    d_off = _ops.DISPATCH_STATS["dispatches"]
+
+    qps_on, p99_on = best_pass(True)
+    qps_off, p99_off = best_pass(False)
+    inst.config.set_instance("ENABLE_QUERY_TRACING", 1)
+    overhead_pct = round((qps_off - qps_on) / qps_off * 100.0, 2) \
+        if qps_off > 0 else 0.0
+    st = inst.trace_store.stats()
+    return [{
+        "metric": "tracing_always_on_overhead", "platform": platform,
+        "sessions": n_s, "per_session": per, "runs": reps,
+        "qps_on": round(qps_on, 1), "p99_on_ms": round(p99_on, 3),
+        "qps_off": round(qps_off, 1), "p99_off_ms": round(p99_off, 3),
+        "overhead_pct": overhead_pct, "target_pct": 3.0,
+        "dispatches_on": d_on, "dispatches_off": d_off,
+        "dispatches_equal": d_on == d_off,
+        "retraces_steady": retraces_on,
+        "sample_rate": st["rate"],
+        "store_count": st["count"], "store_bytes": st["bytes"],
+        "store_budget": st["budget"],
+    }]
+
+
+def tracing_only_main():
+    """`bench.py --tracing-only` (make bench-tracing): the always-on
+    tracing overhead proof on a small TPC-H load; commits BENCH_r14.json."""
+    sf = float(os.environ.get("BENCH_SF", "0.1"))
+    inst, s, data = load(sf)
+    results = list(tracing_bench(inst, s, data, jax.devices()[0].platform))
+    for out in results:
+        print(json.dumps(out))
+    envelope = {"n": 14, "cmd": "python bench.py --tracing-only", "rc": 0,
+                "tail": json.dumps(results[-1]), "parsed": results}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_r14.json")
+    with open(path, "w") as f:
+        json.dump(envelope, f, indent=1)
+        f.write("\n")
+
+
 def htap_bench(platform):
     """`bench.py --htap-only` (make bench-htap): the columnar HTAP replica
     (PR 18) measured as its actual claim — scan-heavy AP queries on the
@@ -1868,6 +1954,8 @@ if __name__ == "__main__":
         kernels_only_main()
     elif "--slo-only" in sys.argv:
         slo_only_main()
+    elif "--tracing-only" in sys.argv:
+        tracing_only_main()
     elif "--scaleout-only" in sys.argv:
         scaleout_only_main()
     elif "--htap-only" in sys.argv:

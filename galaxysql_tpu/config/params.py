@@ -339,6 +339,31 @@ ANOMALY_MIN_RATE = _p(
     "ANOMALY_MIN_RATE", 10.0,
     "absolute floor (events/s) below which the anomaly detector never "
     "fires — quiet counters twitching from 0 to 1 are not storms")
+SLO_COLUMNAR_LAG_MS = _p(
+    "SLO_COLUMNAR_LAG_MS", 10_000.0,
+    "built-in columnar_freshness objective: replica apply lag target (ms) "
+    "over the burn window — PR 19's freshness gauge joins the burn engine")
+
+# --- incident flight recorder (server/flight_recorder.py) ----------------------
+ENABLE_FLIGHT_RECORDER = _p(
+    "ENABLE_FLIGHT_RECORDER", True,
+    "snapshot a correlated incident bundle (retained traces + summary rows "
+    "+ metric-history window + admission/memory/heal/columnar state) when a "
+    "trigger event fires (slo_burn, plan_regression, breaker_open, "
+    "admission_reject storms, columnar_tail_failed, metric_anomaly); "
+    "advisory — runs on the slo_tick maintenance path, never a query path")
+INCIDENT_COOLDOWN_S = _p(
+    "INCIDENT_COOLDOWN_S", 60.0,
+    "per-episode dedupe: minimum seconds between bundles for the same "
+    "trigger kind + correlation key (one bundle per burn, breaker-style)")
+INCIDENT_RING = _p(
+    "INCIDENT_RING", 64,
+    "incident bundles retained in memory and under data_dir/incidents/ "
+    "(oldest files reaped past the bound)")
+INCIDENT_REJECT_STORM = _p(
+    "INCIDENT_REJECT_STORM", 20,
+    "admission_reject lifetime-count delta since the last recorder tick "
+    "that qualifies as a shed storm (single rejects are routine backpressure)")
 
 # --- self-healing plan management (plan/spm.py quarantine machine) -------------
 ENABLE_PLAN_AUTOHEAL = _p(
@@ -430,11 +455,22 @@ ENABLE_QUERY_PROFILING = _p(
     "collect per-operator rows/time + segment spans into QueryProfile "
     "(forces device syncs; the default hot path pays nothing)")
 ENABLE_QUERY_TRACING = _p(
-    "ENABLE_QUERY_TRACING", False,
+    "ENABLE_QUERY_TRACING", True,
     "record a hierarchical span tree per query (operators, fused segments, "
     "MPP shards, worker fragments, compile/transfer telemetry) for "
     "SHOW TRACE / information_schema.query_spans / web /trace/<id>; "
-    "may sync devices — the default hot path pays nothing)")
+    "collection is host-side ramp timestamps only — no device syncs, no "
+    "extra dispatches; GALAXYSQL_TRACING=0 env kills it process-wide")
+TRACE_SAMPLE_RATE = _p(
+    "TRACE_SAMPLE_RATE", 0.01,
+    "head-sampling rate for HEALTHY traces into the per-node TraceStore "
+    "(per-digest 1-in-N, first occurrence always kept); slow / errored / "
+    "shed traces bypass this and are always retained (tail retention). "
+    "0 disables head sampling — tail retention still fires")
+TRACE_STORE_BUDGET_BYTES = _p(
+    "TRACE_STORE_BUDGET_BYTES", 4 << 20,
+    "byte budget of the per-node retained-trace ring (TraceStore); "
+    "oldest-first eviction once the estimated resident size exceeds it")
 FAILPOINT_ENABLE = _p("FAILPOINT_ENABLE", False, "fail-point injection master switch")
 
 

@@ -23,6 +23,12 @@ Cross-file passes (they run in `finalize`, over the whole project):
   registry must be named by a test so its expansion (`<name>_p99` etc.)
   provably appears in a metric-history sample — otherwise the SLO plane's
   windows can lose an input without any test noticing.
+- **event-uncorrelated**: publish sites for flight-recorder TRIGGER kinds
+  (slo_burn, plan_regression, breaker_open, admission_reject,
+  columnar_tail_failed, metric_anomaly) must pass a correlation key —
+  `trace_id=` or `digest=` — or carry a justified pragma: an incident
+  bundle captured off an uncorrelated trigger cannot implicate the
+  statement that caused it, so the recorder degrades to guesswork.
 """
 
 from __future__ import annotations
@@ -39,11 +45,18 @@ _METRIC_CTORS = ("Counter", "Gauge", "Histogram")
 
 class HygieneChecker(Checker):
     rules = ("dead-failpoint", "metric-orphan", "event-untested",
-             "histogram-unsampled")
+             "histogram-unsampled", "event-uncorrelated")
     description = ("FP_* keys never armed by any test; process-shared "
                    "metrics never updated or never adopted/surfaced; "
                    "journal event kinds / adopted histograms never "
-                   "exercised by any test")
+                   "exercised by any test; trigger-kind events published "
+                   "without a trace_id/digest correlation key")
+
+    # event kinds the flight recorder treats as incident triggers
+    # (server/flight_recorder.py EVENT_TRIGGERS + the reject-storm kind)
+    TRIGGER_KINDS = frozenset({
+        "slo_burn", "plan_regression", "breaker_open", "admission_reject",
+        "columnar_tail_failed", "metric_anomaly"})
 
     def finalize(self, project: Project):
         findings: List[Finding] = []
@@ -51,6 +64,7 @@ class HygieneChecker(Checker):
         findings.extend(self._metric_orphans(project))
         findings.extend(self._untested_events(project))
         findings.extend(self._unsampled_histograms(project))
+        findings.extend(self._uncorrelated_events(project))
         return findings
 
     def _dead_failpoints(self, project: Project):
@@ -145,6 +159,40 @@ class HygieneChecker(Checker):
                         f"never named by any test — an alert nobody has "
                         f"armed or asserted silently rots",
                         rule="event-untested"))
+        return findings
+
+    def _uncorrelated_events(self, project: Project):
+        """Every publish site whose string-literal kind is a flight-recorder
+        TRIGGER must pass `trace_id=` or `digest=` (the incident bundle's
+        implication keys).  Sites with genuinely no query context
+        (background loops) carry a justified pragma instead.  Unlike
+        event-untested this reports every SITE, not each kind once — each
+        uncorrelated publish degrades a different trigger path."""
+        findings = []
+        for mod in project.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                fn = node.func
+                fname = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else "")
+                if fname != "publish":
+                    continue
+                arg = node.args[0]
+                if not (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)) or \
+                        arg.value not in self.TRIGGER_KINDS:
+                    continue
+                keys = {kw.arg for kw in node.keywords if kw.arg}
+                has_splat = any(kw.arg is None for kw in node.keywords)
+                if keys & {"trace_id", "digest"} or has_splat:
+                    continue  # **kwargs splats can't be checked statically
+                findings.append(self.finding(
+                    mod, node.lineno,
+                    f"trigger-kind event '{arg.value}' is published without "
+                    f"a trace_id/digest correlation key — the flight "
+                    f"recorder cannot implicate the statement behind this "
+                    f"incident", rule="event-uncorrelated"))
         return findings
 
     def _unsampled_histograms(self, project: Project):

@@ -36,17 +36,33 @@ def reset_transfer_stats():
     TRANSFER_STATS["transfers"] = 0
 
 
+# devices that actually expose memory_stats(), resolved on first call: with
+# always-on tracing this runs per query, and on backends without the stats
+# (CPU) the jax.devices() + per-device probe loop is pure waste
+_HBM_DEVICES: "list | None" = None
+
+
 def hbm_high_water() -> Dict[str, int]:
     """Per-device peak memory (bytes) where the backend exposes it (TPU/GPU
-    runtimes do; CPU may not).  Called only from traced/profiled paths — the
-    stats query is host-side but there is no reason to poll it hot."""
-    import jax
+    runtimes do; CPU may not).  Called from traced/profiled paths — the
+    stats query is host-side, and backends without it short-circuit to an
+    empty dict after the first probe."""
+    global _HBM_DEVICES
+    if _HBM_DEVICES is None:
+        import jax
+        probed = []
+        try:
+            for d in jax.devices():
+                try:
+                    if d.memory_stats():
+                        probed.append(d)
+                except Exception:
+                    pass
+            _HBM_DEVICES = probed
+        except RuntimeError:
+            return {}  # backend not initialized yet: re-probe next call
     out: Dict[str, int] = {}
-    try:
-        devices = jax.devices()
-    except RuntimeError:
-        return out
-    for d in devices:
+    for d in _HBM_DEVICES:
         try:
             ms = d.memory_stats()
         except Exception:

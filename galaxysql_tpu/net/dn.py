@@ -367,13 +367,15 @@ class WorkerClient:
                 self._bk_opened_at = time.time()
                 self.stat_opens += 1
                 BREAKER_OPENS.inc()
-                from galaxysql_tpu.utils import events
+                from galaxysql_tpu.utils import events, tracing
+                tc = tracing.current()
                 events.publish("breaker_open",
                                f"worker {self.addr[0]}:{self.addr[1]}: "
                                f"breaker opened after {self._bk_fails} "
                                f"failures ({self.last_error})",
                                worker=f"{self.addr[0]}:{self.addr[1]}",
-                               consec_failures=self._bk_fails)
+                               consec_failures=self._bk_fails,
+                               trace_id=tc.trace_id if tc is not None else 0)
 
     def _breaker_gate(self):
         """Fast-fail while open; after the cooldown, half-open and let ONE
@@ -409,11 +411,13 @@ class WorkerClient:
                 # breaker_opens counter must show a flapping endpoint
                 self.stat_opens += 1
             BREAKER_OPENS.inc()
-            from galaxysql_tpu.utils import events
+            from galaxysql_tpu.utils import events, tracing
+            tc = tracing.current()
             events.publish("breaker_open",
                            f"worker {self.addr[0]}:{self.addr[1]}: "
                            "half-open probe failed; breaker re-opened",
-                           worker=f"{self.addr[0]}:{self.addr[1]}")
+                           worker=f"{self.addr[0]}:{self.addr[1]}",
+                           trace_id=tc.trace_id if tc is not None else 0)
             raise errors.WorkerUnavailableError(
                 f"worker {self.addr[0]}:{self.addr[1]}: half-open probe "
                 f"failed; breaker re-opened", sent=False)

@@ -100,9 +100,18 @@ _DEFS: Dict[str, List] = {
         ("max_latency_ms", _D), ("rows_returned", _I), ("rows_examined", _I),
         ("retraces", _I), ("frag_cache_hits", _I), ("rf_rows_pruned", _I),
         ("rpc_retries", _I), ("spill_bytes", _I), ("sample_sql", _V)],
-    # typed instance-event journal (utils/events.py; SHOW EVENTS twin)
+    # typed instance-event journal (utils/events.py; SHOW EVENTS twin) —
+    # trace_id/digest are the ISSUE 20 correlation keys linking an event
+    # to its retained trace / statement-summary row
     "events": [("seq", _I), ("at", _D), ("kind", _V), ("severity", _V),
-               ("node", _V), ("detail", _V), ("attrs", _V)],
+               ("node", _V), ("detail", _V), ("attrs", _V),
+               ("trace_id", _I), ("digest", _V)],
+    # flight-recorder incident bundles (server/flight_recorder.py;
+    # SHOW INCIDENTS twin) — one row per retained bundle, newest first
+    "incidents": [
+        ("incident_id", _V), ("at", _D), ("kind", _V), ("severity", _V),
+        ("episode", _V), ("node", _V), ("digests", _V), ("traces", _I),
+        ("events", _I), ("detail", _V)],
     # elastic-rebalance jobs (ddl/rebalance.py; SHOW REBALANCE twin):
     # live job phase/progress + bounded finished-job history
     "rebalance_jobs": [
@@ -277,8 +286,11 @@ def refresh(instance, session=None):
          (list(r) for r in (ss.history_rows() if ss is not None else [])))
     from galaxysql_tpu.utils.events import EVENTS
     fill("events", ([e.seq, round(e.at, 3), e.kind, e.severity, e.node,
-                     e.detail, _json.dumps(e.attrs, default=str)[:512]]
+                     e.detail, _json.dumps(e.attrs, default=str)[:512],
+                     e.trace_id, e.digest]
                     for e in EVENTS.entries()))
+    rec = getattr(instance, "recorder", None)
+    fill("incidents", (list(r) for r in (rec.rows() if rec else [])))
     fill("plan_baselines", (list(r) for r in instance.planner.spm.rows()))
     from galaxysql_tpu.ddl.rebalance import progress_rows
     fill("rebalance_jobs", (list(r) for r in progress_rows(instance)))

@@ -118,6 +118,17 @@ class Instance:
         # last-N per-query runtime profiles (information_schema.query_stats,
         # SHOW FULL STATS, web /query/<trace_id>)
         self.profiles = ProfileRing()
+        # tail-sampled trace retention (utils/tracing.TraceStore): every
+        # query's finish ramp offers its span tree — per-digest head sample
+        # for healthy traces, always-keep for slow/shed/errored — into this
+        # byte-budgeted per-node ring; the flight recorder and SHOW TRACE
+        # cluster pulls read it
+        from galaxysql_tpu.utils.tracing import TraceStore
+        self.trace_store = TraceStore(
+            budget_bytes=int(self.config.get("TRACE_STORE_BUDGET_BYTES")
+                             or (4 << 20)),
+            rate=float(self.config.get("TRACE_SAMPLE_RATE") or 0.0),
+            node=self.node_id)
         # statement-digest workload-insight store (meta/statement_summary.py):
         # per digest x plan fingerprint time-windowed aggregates + the
         # plan-regression sentinel; fed by Session._finish_query
@@ -167,6 +178,11 @@ class Instance:
         self.metric_history = MetricHistory(self)
         from galaxysql_tpu.server.slo import SloEngine
         self.slo = SloEngine(self)
+        # incident flight recorder (server/flight_recorder.py): watches the
+        # event journal for trigger kinds on every slo_tick and snapshots
+        # correlated evidence bundles into data_dir/incidents/
+        from galaxysql_tpu.server.flight_recorder import FlightRecorder
+        self.recorder = FlightRecorder(self)
         from galaxysql_tpu.server.maintain import RecycleBin
         self.recycle = RecycleBin(self)
         # elastic rebalancing (ddl/rebalance.py + server/balancer.py): the
@@ -392,6 +408,9 @@ class Instance:
             if sampled is None:
                 return False
             self.slo.evaluate(now=now)
+            rec = getattr(self, "recorder", None)
+            if rec is not None:
+                rec.tick(now=now)
             return True
         except Exception:  # galaxylint: disable=swallow -- advisory plane: a sampler fault must never affect serving (pragma: no cover)
             return False
@@ -816,6 +835,16 @@ class Instance:
             if "metrics" in want:
                 reply["metrics"] = [[n, k, float(v), h] for n, k, v, h
                                     in self.metrics.rows()[:512]]
+            if "traces" in want:
+                reply["traces"] = [rt.to_dict() for rt in
+                                   self.trace_store.entries(limit=64)]
+            # exact-id trace pull: the router grafts a routed statement's
+            # peer-side span tree back into its own context (ISSUE 20
+            # cluster propagation), same want-freight pattern as above
+            tid = payload.get("trace_id")
+            if tid is not None:
+                rt = self.trace_store.get(tid)
+                reply["trace"] = rt.to_dict() if rt is not None else None
             return reply
         return {"ok": False, "error": f"unknown sync action {action!r}"}
 

@@ -430,11 +430,19 @@ class RouterSession:
     ring with within-statement failover; state-creating statements pin
     the session to the peer that holds the state."""
 
+    _SHOW_TRACE_RE = re.compile(r"^\s*show\s+trace\s*;?\s*$", re.IGNORECASE)
+
     def __init__(self, router: FrontRouter, schema: Optional[str] = None):
         self.router = router
         self.schema = schema
         self.pinned: Optional[str] = None
         self._backends: Dict[str, object] = {}  # node_id -> peer session
+        # router-side span tree of the last routed statement (grafted with
+        # the peer's retained spans when the trace was pulled back) — SHOW
+        # TRACE renders the cluster path from here, not from whichever peer
+        # the SHOW statement itself would hash to
+        self.last_spans: List[object] = []
+        self.last_trace_id = 0
 
     # -- backend session cache ------------------------------------------------
 
@@ -465,6 +473,16 @@ class RouterSession:
             # hatch: structurally off-path — no routing, no ring, no
             # router metrics; bit-identical local execution
             return router.local.execute(self._backend(router.local), sql)
+        if self.last_spans and self._SHOW_TRACE_RE.match(sql):
+            # the last routed statement's trace lives HERE (the grafted
+            # router -> peer -> worker path); digest affinity would hash
+            # SHOW TRACE to an arbitrary peer that never saw it
+            from galaxysql_tpu.server.session import ResultSet
+            from galaxysql_tpu.types import datatype as dt
+            from galaxysql_tpu.utils import tracing
+            lines = [f"trace-id {self.last_trace_id}"]
+            lines += tracing.span_tree_lines(self.last_spans)
+            return ResultSet(["Trace"], [dt.VARCHAR], [(t,) for t in lines])
         router.maybe_gossip()
         if self.pinned is not None:
             return self._execute_pinned(sql)
@@ -483,7 +501,7 @@ class RouterSession:
                 f"pinned coordinator {node} is unavailable; session state "
                 f"lost, session unpinned")
         try:
-            rs = peer.execute(self._backend(peer), sql)
+            rs = self._peer_exec(peer, sql)
         except TRANSPORT_ERRORS as e:
             router.mark_down(peer, e)
             node = self.pinned
@@ -496,6 +514,103 @@ class RouterSession:
         router.note_routed(peer.node_id, affine=True)
         return rs
 
+    # -- cross-peer tracing (ISSUE 20 leg 2) ----------------------------------
+
+    def _peer_exec(self, peer, sql: str, digest: Optional[str] = None):
+        """Execute on a peer, carrying trace context across the hop.
+
+        Local (inproc) execution traces natively — same thread, same
+        instance, the peer Session's own TraceContext — so only remote
+        hops pay the wrap: mint a router-side trace, prefix the statement
+        with a `/*trace:id:parent:node:sampled*/` hint (the peer session
+        adopts the id and strips the hint BEFORE digesting), and when the
+        trace retains — the router's propagated head-sampling decision, a
+        slow hop, or an app-level error — pull the peer's retained tree
+        back over the sync wire and graft it under the route span, so one
+        trace id renders router -> coordinator -> worker."""
+        router = self.router
+        sess = self._backend(peer)
+        if peer is router.local:
+            self.last_spans = []  # SHOW TRACE falls through to the session
+            return peer.execute(sess, sql)
+        inst = router.instance
+        from galaxysql_tpu.utils import tracing
+        if not (tracing.ALWAYS_ON
+                and bool(inst.config.get("ENABLE_QUERY_TRACING"))):
+            return peer.execute(sess, sql)
+        store = getattr(inst, "trace_store", None)
+        if digest is None:  # pinned statements skip the routing digest
+            from galaxysql_tpu.sql.parameterize import parameterize
+            from galaxysql_tpu.meta.statement_summary import digest_key
+            digest = digest_key(self.schema or "",
+                                parameterize(sql).cache_key)
+        # the router's sampling decision rides the hint (the W3C sampled
+        # flag idea): the peer force-retains under OUR id, so the exact-id
+        # pull below cannot miss
+        sampled = store is not None and store.sampler.decide(digest)
+        tid = inst.trace_ids.next()
+        tc = tracing.TraceContext(tid, node=inst.node_id)
+        root = tc.begin("route", kind="query", peer=peer.node_id,
+                        digest=digest)
+        hint = (f"/*trace:{tid}:{root.span_id}:{inst.node_id}:"
+                f"{1 if sampled else 0}*/")
+        app_err = ""
+        answered = False
+        try:
+            rs = peer.execute(sess, hint + sql)
+            answered = True
+            return rs
+        except TRANSPORT_ERRORS:
+            raise  # peer is gone — nothing to pull, caller fails over
+        except errors.TddlError as e:
+            # app-level failure from a live peer: the peer tail-retained
+            # its trace under our id — still pullable evidence
+            answered = True
+            app_err = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            tc.end(root)
+            elapsed_ms = root.dur_us / 1000.0
+            slow_ms = inst.config.get("SLOW_SQL_MS")
+            slow = (slow_ms is not None and slow_ms >= 0
+                    and elapsed_ms >= float(slow_ms))
+            self.last_spans = list(tc.spans)
+            self.last_trace_id = tid
+            if answered and store is not None and \
+                    (sampled or slow or app_err):
+                reason = "error" if app_err else \
+                    ("slow" if slow else "sampled")
+                self._graft_peer_trace(peer, tc, root, tid, digest, sql,
+                                       elapsed_ms, reason, app_err, store)
+
+    def _graft_peer_trace(self, peer, tc, root, tid, digest, sql,
+                          elapsed_ms, reason, error, store) -> None:
+        """Pull the peer's retained trace by exact id, graft it under the
+        route span, and retain the assembled cluster path locally (so the
+        router's /trace/<id>, SHOW TRACE and flight recorder all see it)."""
+        from galaxysql_tpu.utils import tracing
+        inst = self.router.instance
+        try:
+            resp = peer.sync_action("health", {"trace_id": tid})
+        except TRANSPORT_ERRORS:
+            resp = {}  # evidence pull is best-effort; the statement result
+            #            already returned — keep the router-side spans
+        rtd = resp.get("trace") if isinstance(resp, dict) else None
+        if rtd and rtd.get("spans"):
+            tc.graft(list(rtd["spans"]), parent=root.span_id)
+            self.last_spans = list(tc.spans)
+        rt = tracing.RetainedTrace(
+            trace_id=tid, digest=digest,
+            sql=str((rtd or {}).get("sql") or sql)[:512],
+            schema=self.schema or "",
+            workload=str((rtd or {}).get("workload") or ""),
+            elapsed_ms=round(elapsed_ms, 3),
+            error=str(error or (rtd or {}).get("error") or "")[:256],
+            reason=reason, node=inst.node_id, at=time.time(),
+            phases=dict((rtd or {}).get("phases") or {}),
+            spans=[s.to_dict() for s in tc.spans])
+        store.put(rt)
+
     def _execute_routed(self, sql: str):
         from galaxysql_tpu.sql.parameterize import parameterize
         from galaxysql_tpu.meta.statement_summary import digest_key
@@ -506,7 +621,7 @@ class RouterSession:
         last_exc: Optional[Exception] = None
         for i, peer in enumerate(targets):
             try:
-                rs = peer.execute(self._backend(peer), sql)
+                rs = self._peer_exec(peer, sql, digest)
             except TRANSPORT_ERRORS as e:
                 router.mark_down(peer, e)
                 self._drop_backend(peer)

@@ -196,6 +196,10 @@ class Session:
         self.user = "root"
         self.last_trace: List[str] = []
         self.last_spans: List[Any] = []  # last traced query's span tree
+        # router trace hint for the CURRENT statement: (trace_id, parent
+        # span id, origin node, sampled) parsed off the statement prefix by
+        # _execute_one; None for locally-originated statements
+        self._trace_hint: Optional[tuple] = None
         # per-statement MAX_EXECUTION_TIME deadline (absolute seconds, None =
         # unlimited): set at statement entry, threaded into ExecContext and
         # worker RPC headers
@@ -256,8 +260,24 @@ class Session:
         r"^\s*(?:/\*.*?\*/\s*)*select\b", __import__("re").I | __import__("re").S)
     _DML_RE = __import__("re").compile(
         r"^\s*(?:insert|update|delete)\b", __import__("re").I)
+    # cross-coordinator trace hint: `/*trace:<id>:<parent>:<node>:<0|1>*/`
+    # prefixed by RouterSession onto routed statements.  Parsed and STRIPPED
+    # here — before digesting/parameterization — so plan-cache keys and
+    # statement-summary digests never fragment per trace id.
+    _TRACE_HINT_RE = __import__("re").compile(
+        r"^/\*trace:(\d+):(\d+):([^:*]*):([01])\*/\s*")
 
     def _execute_one(self, sql: str, params: Optional[list]) -> ResultSet:
+        # one startswith per statement on the hot path; the regex runs only
+        # for statements that actually carry the router's hint prefix
+        if sql.startswith("/*trace:"):
+            m = self._TRACE_HINT_RE.match(sql)
+            if m is not None:
+                self._trace_hint = (int(m.group(1)), int(m.group(2)),
+                                    m.group(3), m.group(4) == "1")
+                sql = sql[m.end():]
+        elif self._trace_hint is not None:
+            self._trace_hint = None  # hint covers exactly one statement
         # statement deadline: one config lookup; MAX_EXECUTION_TIME=0 (the
         # default) keeps the hot path at a None check everywhere downstream
         ms = self.instance.config.get("MAX_EXECUTION_TIME", self.vars)
@@ -788,8 +808,10 @@ class Session:
                                              self.vars))
 
     def _tracing_enabled(self) -> bool:
-        return bool(self.instance.config.get("ENABLE_QUERY_TRACING",
-                                             self.vars))
+        # always-on by default since ISSUE 20 (collection is host-side ramp
+        # timestamps only); GALAXYSQL_TRACING=0 env or the param kill it
+        return tracing.ALWAYS_ON and bool(
+            self.instance.config.get("ENABLE_QUERY_TRACING", self.vars))
 
     def _digest_of(self, sql: str, schema: str = "") -> str:
         """Statement digest of a raw SQL text (memoized end-to-end: the
@@ -859,16 +881,43 @@ class Session:
             if prof.profiled:
                 prof.op_stats = list(ctx.op_stats)
             prof.trace = list(ctx.trace)
-        if prof.profiled or prof.spans:
+        # compile-phase attribution: process-global compile_ms delta across
+        # this query (host-side dict reads; retraces are rare steady-state,
+        # so the phase usually stays absent)
+        c0 = getattr(self, "_compile_ms0", None)
+        if c0 is not None:
+            from galaxysql_tpu.exec.operators import COMPILE_STATS
+            _cms = COMPILE_STATS["compile_ms"] - c0
+            if _cms > 0.0:
+                prof.phases["compile"] = round(_cms, 3)
+        inst = self.instance
+        slow_ms = inst.config.get("SLOW_SQL_MS", self.vars)
+        # 0 logs every query (MySQL long_query_time=0); negative disables
+        is_slow = (slow_ms is not None and slow_ms >= 0
+                   and elapsed * 1000 >= slow_ms)
+        digest = self._digest_of(sql, prof.schema)
+        # tail-sampled retention: the per-query cost is the sampler's one
+        # dict probe + one compare (slow/error paths are off the fast path)
+        rt = None
+        store = getattr(inst, "trace_store", None)
+        # cheap-path guard: unsampled healthy queries (prof.spans empty,
+        # not slow) never even call offer()
+        if store is not None and prof.traced and (prof.spans or is_slow):
+            if prof.spans and prof.phases:
+                prof.spans[0].attrs["phases"] = dict(prof.phases)
+            hint = self._trace_hint
+            rt = store.offer(prof, digest, slow=bool(is_slow),
+                             forced=bool(hint is not None and hint[3]))
+        if prof.profiled or rt is not None:
             # the RSS high-water syscall is ~70us on virtualized kernels —
-            # worth it only when someone asked for the profile/trace detail
+            # worth it only for profiled or retained queries, never the
+            # always-on fast path
             try:
                 import resource
                 prof.peak_rss_kb = resource.getrusage(
                     resource.RUSAGE_SELF).ru_maxrss
             except Exception:
                 pass  # non-POSIX host: profile lacks the memory datapoint
-        inst = self.instance
         inst.profiles.record(prof)
         m = inst.metrics
         # bound metric handles are cached per (workload, engine): name
@@ -880,64 +929,121 @@ class Session:
         q_eng.inc()
         tracing.GLOBAL_STATS.bump("queries")
         self._summary_record(sql, prof, workload, engine, rows, plan)
-        slow_ms = inst.config.get("SLOW_SQL_MS", self.vars)
-        # 0 logs every query (MySQL long_query_time=0); negative disables
-        if slow_ms is not None and slow_ms >= 0 and elapsed * 1000 >= slow_ms:
+        if is_slow:
             tracing.SLOW_LOG.record(sql or "<stmt>", elapsed, self.conn_id,
                             trace_id=prof.trace_id, workload=workload,
-                            digest=self._digest_of(sql, prof.schema))
+                            digest=digest)
             tracing.GLOBAL_STATS.bump("slow")
             m.counter("slow_queries", "queries over SLOW_SQL_MS").inc()
 
     def _run_query(self, stmt, sql: str, params: Optional[list]) -> ResultSet:
         schema = self._require_schema()
+        _pc = time.perf_counter
         # read-your-writes: this session's own async GSI/replica applies must
         # land before its reads (one int compare when nothing is pending)
+        f0 = _pc()
         self._apply_fence()
+        fence_ms = (_pc() - f0) * 1000.0
         t0 = time.time()
         prof = tracing.QueryProfile(trace_id=self.instance.trace_ids.next(),
                                     sql=(sql or "<stmt>")[:512], schema=schema,
                                     conn_id=self.conn_id, started_at=t0)
+        if fence_ms >= 0.05:  # steady state: fence is one int compare
+            prof.phases["fence_wait"] = round(fence_ms, 3)
         # statement-summary counter bracket: five host-side reads whose
         # deltas attribute compile/cache/filter/retry work to this digest
         from galaxysql_tpu.meta.statement_summary import counters_snapshot
         self._ss0 = counters_snapshot(self.instance)
+        from galaxysql_tpu.exec.operators import COMPILE_STATS
+        self._compile_ms0 = COMPILE_STATS["compile_ms"]
         if "information_schema" in (sql or "").lower() or \
                 schema.lower() == "information_schema":
             from galaxysql_tpu.server import information_schema
             information_schema.refresh(self.instance, self)
+        # trace collection first, so even a shed query leaves a (tiny) tree
+        # with its phase attribution behind
+        tc = None
+        if self._tracing_enabled():
+            prof.traced = True
+            hint = self._trace_hint
+            store = getattr(self.instance, "trace_store", None)
+            if hint is not None:
+                # adopt the routing tier's trace id: the router pulls this
+                # exact id back over the sync wire and grafts our spans
+                # under its route span (one trace per cluster path)
+                prof.trace_id = hint[0]
+                prof.sampled = hint[3]
+                full = True  # the router may pull this id on slow/error
+            else:
+                # the always-on budget: ONE dict probe + ONE compare.
+                # Sampled queries build the full span tree; the rest skip
+                # the span machinery entirely — if they end slow/shed/
+                # errored, the tail ramps synthesize the root span from
+                # the profile's phase breakdown
+                prof.sampled = store is not None and \
+                    store.sampler.decide(self._digest_of(sql, schema))
+                # explicit session opt-in (SET ENABLE_QUERY_TRACING=1)
+                # always builds the full tree: that's SHOW TRACE debugging
+                full = prof.sampled or \
+                    bool(self.vars.get("ENABLE_QUERY_TRACING"))
+            if full:
+                tc = tracing.TraceContext(prof.trace_id,
+                                          node=self.instance.node_id)
+                prof.spans = tc.spans  # alias: ring sees spans as they land
+            else:
+                self.last_spans = []
+        else:
+            self.last_spans = []  # SHOW TRACE must not show a stale tree
         # overload plane first (typed ServerOverloadError shed, lock-free
         # when idle), then the rule-matched CCL gate; both release on the
         # single exit ramp below (idempotent handles — the exception paths
         # may cross release sites)
-        ticket = self.instance.admission.admit(self, sql or "")
+        ticket = None
         admission = None
-        tc = None
         try:
-            admission = GLOBAL_CCL.admit(self, sql or "")
-            if self._tracing_enabled():
-                tc = tracing.TraceContext(prof.trace_id,
-                                          node=self.instance.node_id)
-                prof.spans = tc.spans  # alias: the ring sees spans as they land
-            else:
-                self.last_spans = []  # SHOW TRACE must not show a stale tree
+            a0 = _pc()
+            try:
+                ticket = self.instance.admission.admit(self, sql or "")
+            finally:
+                # shed queries keep their partial attribution: an admission
+                # timeout's wait lands in the phases dict BEFORE the typed
+                # ServerOverloadError propagates (ISSUE 20 satellite)
+                prof.phases["admission"] = round((_pc() - a0) * 1000, 3)
+            q0 = _pc()
+            try:
+                admission = GLOBAL_CCL.admit(self, sql or "")
+            finally:
+                prof.phases["queue"] = round((_pc() - q0) * 1000, 3)
             if tc is None:
                 return self._run_query_admitted(stmt, sql, params, schema,
                                                 t0, prof)
-            with tracing.activate(tc):
-                with tc.span("query", kind="query", sql=prof.sql[:128],
-                             conn=self.conn_id, schema=schema):
-                    rs = self._run_query_admitted(stmt, sql, params, schema,
-                                                  t0, prof)
+            # manual begin/end + swap_active: the two generator context
+            # managers cost ~4us/query — real money on the point path
+            root = tc.begin("query", kind="query", sql=prof.sql[:128],
+                            conn=self.conn_id, schema=schema)
+            prev = tracing.swap_active(tc)
+            try:
+                rs = self._run_query_admitted(stmt, sql, params, schema,
+                                              t0, prof)
+            except BaseException as e:
+                root.attrs["error"] = f"{type(e).__name__}: {e}"[:256]
+                raise
+            finally:
+                tracing.swap_active(prev)
+                tc.end(root)
             self._finish_trace(tc)
             return rs
+        except errors.ServerOverloadError as e:
+            self._record_query_shed(sql, t0, prof, e, tc)
+            raise
         except Exception as e:
             self._record_query_error(sql, t0, prof, e, tc)
             raise
         finally:
             if admission is not None:
                 admission.release()
-            ticket.release(prof)
+            if ticket is not None:
+                ticket.release(prof)
 
     def _finish_trace(self, tc):
         """Close out a traced query: stamp device telemetry on the root span
@@ -948,6 +1054,30 @@ class Session:
             if hbm:
                 tc.spans[0].attrs["hbm_peak_bytes"] = hbm
         self.last_spans = list(tc.spans)
+
+    def _record_query_shed(self, sql, t0, prof, exc, tc):
+        """Admission shed this query before execution.  No error metrics here
+        — the admission plane already counted and published the typed shed —
+        but the phase attribution (how long the admission wait burned) and
+        the trace skeleton are evidence: tail-retain them so a shed storm is
+        diagnosable after the fact."""
+        elapsed = time.time() - t0
+        prof.elapsed_ms = round(elapsed * 1000, 3)
+        prof.error = f"{type(exc).__name__}: {exc}"[:512]
+        if tc is not None:
+            tc.add("shed", kind="error", parent=tc.root_id,
+                   **errors.span_attrs(exc))
+            self._finish_trace(tc)
+        inst = self.instance
+        inst.profiles.record(prof)
+        store = getattr(inst, "trace_store", None)
+        if store is not None and prof.traced:
+            if prof.spans and prof.phases:
+                prof.spans[0].attrs["phases"] = dict(prof.phases)
+            store.offer(prof, self._digest_of(sql, prof.schema), shed=True)
+        self.last_trace = [f"trace-id {prof.trace_id}",
+                           f"shed {prof.error}",
+                           f"elapsed={elapsed:.3f}s"]
 
     def _record_query_error(self, sql, t0, prof, exc, tc):
         """A query that dies mid-execution still owes observability its
@@ -967,6 +1097,13 @@ class Session:
             tc.add("error", kind="error", parent=tc.root_id,
                    **_err.span_attrs(exc))
             self._finish_trace(tc)
+        # tail retention: a failed query's trace is ALWAYS kept (timeouts
+        # carry the partial phases stamped before the raise)
+        store = getattr(inst, "trace_store", None)
+        if store is not None and prof.traced:
+            if prof.spans and prof.phases:
+                prof.spans[0].attrs["phases"] = dict(prof.phases)
+            store.offer(prof, self._digest_of(sql, prof.schema))
         inst.profiles.record(prof)
         tracing.GLOBAL_STATS.bump("errors")
         inst.metrics.counter("query_errors",
@@ -997,10 +1134,14 @@ class Session:
                 rs = self._try_point_exec(sql, params, schema, t0, prof)
                 if rs is not None:
                     return rs
+            p0 = time.perf_counter()
             plan = self.instance.planner.plan_select(sql, schema, params, self)
+            prof.phases["plan"] = round((time.perf_counter() - p0) * 1000, 3)
         else:
+            p0 = time.perf_counter()
             plan = self.instance.planner.bind_statement(stmt, schema, params or [],
                                                         self)
+            prof.phases["plan"] = round((time.perf_counter() - p0) * 1000, 3)
         if stmt is None:
             # SELECT hot path skipped the raw parse; authorize on the plan's
             # (parameterized) AST — same table names, no second parse
@@ -1282,6 +1423,7 @@ class Session:
         if self.instance.archive.files_for(inst_key, None):
             return None  # cold rows live outside the index: full path
         key_col = pp["key_col"]
+        x0 = time.perf_counter()
         if value is None:
             rows = []  # eq NULL matches nothing
         else:
@@ -1330,6 +1472,7 @@ class Session:
                                        tm.dictionaries.get(cname.lower()))
                             out_cols.append(c.to_pylist())
                     rows.extend(zip(*out_cols))
+        prof.phases["execute"] = round((time.perf_counter() - x0) * 1000, 3)
         elapsed = time.time() - t0
         self.last_trace = [f"trace-id {prof.trace_id}",
                            f"point-plan {pp['table']}.{key_col}",
@@ -1436,6 +1579,7 @@ class Session:
         span_scope = SEGMENT_TRACER.scoped(prof.segments) \
             if ctx.collect_stats else contextlib.nullcontext()
         engine_hint = getattr(plan, "hints", {}).get("engine")
+        x0 = time.perf_counter()
         with span_scope:
             batch = self._try_mpp(plan, ctx, count=True)
             mpp_used = batch is not None
@@ -1448,8 +1592,11 @@ class Session:
                     if (plan.workload == "TP" or engine_hint == "TP") else _NULL_CTX
                 with device_ctx:
                     batch = run_to_batch(op)
+        prof.phases["execute"] = round((time.perf_counter() - x0) * 1000, 3)
+        s0 = time.perf_counter()
         batch = batch.compact()
         rows = batch.to_pylist()
+        prof.phases["serialize"] = round((time.perf_counter() - s0) * 1000, 3)
         fields = plan.fields()
         if plan.workload == "TP":
             self._register_point_plan(plan, batch)

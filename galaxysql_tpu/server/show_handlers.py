@@ -284,14 +284,66 @@ def handle(session, stmt: ast.Show):
                 f"SHOW EVENTS severity '{stmt.target}' "
                 "(expected INFO|WARN|CRITICAL)")
         rows = [(e.seq, round(e.at, 3), e.kind, e.severity, e.node, e.detail,
-                 _json.dumps(e.attrs, default=str)[:512])
+                 _json.dumps(e.attrs, default=str)[:512],
+                 e.trace_id, e.digest)
                 for e in reversed(EVENTS.entries(
                     severity=severity or None,
                     kind_like=stmt.like or None))]
         return ResultSet(
-            ["Seq", "At", "Kind", "Severity", "Node", "Detail", "Attrs"],
+            ["Seq", "At", "Kind", "Severity", "Node", "Detail", "Attrs",
+             "Trace_id", "Digest"],
             [dt.BIGINT, dt.DOUBLE, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR,
-             dt.VARCHAR, dt.VARCHAR], rows)
+             dt.VARCHAR, dt.VARCHAR, dt.BIGINT, dt.VARCHAR], rows)
+    if kind == "incidents":
+        # SHOW INCIDENTS [<seq>]: flight-recorder incident bundles
+        # (server/flight_recorder.py), newest first.  With a seq the full
+        # evidence detail renders as Field/Value lines — implicated
+        # digests, metric-history window tails, retained trace trees with
+        # their phase breakdowns, and the event tail around the trigger.
+        import json as _json
+        rec = getattr(inst, "recorder", None)
+        if stmt.target:
+            b = rec.get(stmt.target) if rec is not None else None
+            if b is None:
+                raise errors.TddlError(
+                    f"unknown incident '{stmt.target}' (SHOW INCIDENTS "
+                    "lists retained bundles)")
+            rows = [("incident_id", b.incident_id), ("at", f"{b.at:.3f}"),
+                    ("kind", b.kind), ("severity", b.severity),
+                    ("episode", b.episode), ("node", b.node),
+                    ("detail", b.detail),
+                    ("digests", ",".join(b.digests)),
+                    ("trace_ids", ",".join(str(t) for t in b.trace_ids)),
+                    ("admission",
+                     _json.dumps(b.admission, default=str)[:512]),
+                    ("state", _json.dumps(b.state, default=str)[:512])]
+            for name in sorted(b.metric_window):
+                rows.append((f"metric:{name}", _json.dumps(
+                    b.metric_window[name][-8:], default=str)[:512]))
+            from galaxysql_tpu.utils.tracing import (span_from_dict,
+                                                     span_tree_lines)
+            for tr in b.traces:
+                tid = tr.get("trace_id")
+                rows.append((f"trace:{tid}",
+                             (f"{tr.get('reason')} "
+                              f"{tr.get('elapsed_ms')}ms phases="
+                              f"{_json.dumps(tr.get('phases') or {})}")
+                             [:512]))
+                spans = [span_from_dict(d) for d in tr.get("spans") or []]
+                for ln in span_tree_lines(spans)[:24]:
+                    rows.append((f"trace:{tid}", ln[:512]))
+            for e in b.events[-16:]:
+                rows.append((f"event:{e.get('seq')}",
+                             f"{e.get('kind')} {e.get('detail', '')}"[:256]))
+            return ResultSet(["Field", "Value"], [dt.VARCHAR, dt.VARCHAR],
+                             rows)
+        rows = rec.rows() if rec is not None else []
+        return ResultSet(
+            ["Incident", "At", "Kind", "Severity", "Episode", "Node",
+             "Digests", "Traces", "Events", "Detail"],
+            [dt.VARCHAR, dt.DOUBLE, dt.VARCHAR, dt.VARCHAR, dt.VARCHAR,
+             dt.VARCHAR, dt.VARCHAR, dt.BIGINT, dt.BIGINT, dt.VARCHAR],
+            rows)
     if kind == "rebalance":
         # SHOW REBALANCE: live elastic-rebalance jobs (phase, rows copied,
         # catchup lag, last checkpoint) + bounded finished-job history

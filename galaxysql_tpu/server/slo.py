@@ -39,7 +39,7 @@ from typing import Dict, List, Optional, Tuple
 from galaxysql_tpu.utils import events
 
 _KV_PREFIX = "slo.def."
-_KINDS = ("latency_p99", "error_ratio")
+_KINDS = ("latency_p99", "error_ratio", "columnar_lag")
 
 
 @dataclass
@@ -87,6 +87,10 @@ _DEFAULTS = (
     SloDef("ap_latency_p99", "latency_p99", param="SLO_AP_P99_MS",
            workload="AP", source="default"),
     SloDef("typed_error_ratio", "error_ratio", param="SLO_ERROR_RATIO",
+           source="default"),
+    # HTAP freshness (ISSUE 20 satellite): the columnar replica's apply lag
+    # joins the burn engine — a wedged tailer burns like a latency storm
+    SloDef("columnar_freshness", "columnar_lag", param="SLO_COLUMNAR_LAG_MS",
            source="default"),
 )
 
@@ -209,6 +213,9 @@ class SloEngine:
         if slo.kind == "latency_p99":
             measured = hist.mean(self._latency_metric(slo), samples=window)
             return measured / target, measured
+        if slo.kind == "columnar_lag":
+            measured = hist.mean("columnar_lag_ms", samples=window)
+            return measured / target, measured
         err_name, tot_name = self._error_metrics(slo)
         errs = hist.series(err_name, samples=window)
         tots = hist.series(tot_name, samples=window)
@@ -259,7 +266,7 @@ class SloEngine:
             if not st.burning and fast >= fast_thresh and slow >= slow_thresh:
                 st.burning, st.since = True, now
                 severity = ("critical" if fast >= 2 * fast_thresh else "warn")
-                events.publish(
+                events.publish(  # galaxylint: disable=event-uncorrelated -- a burn implicates a workload/schema, not one statement; the flight recorder resolves digests from tail-retained traces
                     "slo_burn",
                     f"SLO {slo.name} burning: fast={fast:.2f}x "
                     f"slow={slow:.2f}x target={target:g} "
@@ -307,7 +314,7 @@ class SloEngine:
                 if rate > thresh:
                     if not st.firing:
                         st.firing = True
-                        events.publish(
+                        events.publish(  # galaxylint: disable=event-uncorrelated -- a counter-rate anomaly names a metric, not a statement; the flight recorder resolves digests from tail-retained traces
                             "metric_anomaly",
                             f"counter {name} rate {rate:.1f}/s vs baseline "
                             f"{st.mean:.1f}±{st.dev:.1f}/s",

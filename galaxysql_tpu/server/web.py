@@ -18,7 +18,14 @@ resources served by the MPP coordinator's HTTP server).  Endpoints:
 - /trace/<trace_id>  the query's span tree as Chrome-trace/Perfetto JSON
                      (load in chrome://tracing or ui.perfetto.dev: one pid
                      per node — coordinator + each worker — one tid row per
-                     mesh shard, compile/transfer events attributed in place)
+                     mesh shard, compile/transfer events attributed in place;
+                     falls back to the tail-sampled TraceStore, so retained
+                     traces — including router-grafted cluster paths —
+                     outlive the profile ring)
+- /traces            the TraceStore's retained-trace index (id, digest,
+                     reason, elapsed, phases) + store budget stats
+- /incidents         flight-recorder bundle index (newest first)
+- /incidents/<id>    one incident bundle's full evidence JSON
 - /metrics           the typed counter/gauge registry in Prometheus text
                      exposition format (the scrape endpoint)
 - /health            machine-readable liveness/readiness: SLO burn state,
@@ -147,11 +154,49 @@ class WebConsole:
                 return None
             return p.to_dict()  # segments/op_stats serialized there
         if path.startswith("/trace/"):
-            from galaxysql_tpu.utils.tracing import chrome_trace
-            p = inst.profiles.get(path[len("/trace/"):])
-            if p is None or not p.spans:
+            from galaxysql_tpu.utils.tracing import (chrome_trace,
+                                                     span_from_dict)
+            tid = path[len("/trace/"):]
+            p = inst.profiles.get(tid)
+            if p is not None and p.spans:
+                return chrome_trace(p.trace_id, p.spans)
+            # tail-retained traces (slow/shed/errored/sampled, and the
+            # router's grafted cluster paths) outlive the profile ring
+            store = getattr(inst, "trace_store", None)
+            rt = store.get(tid) if store is not None else None
+            if rt is None or not rt.spans:
                 return None  # untraced query: no tree to export
-            return chrome_trace(p.trace_id, p.spans)
+            return chrome_trace(rt.trace_id,
+                                [span_from_dict(d) for d in rt.spans])
+        if path == "/traces":
+            # the retained-trace index: what the tail sampler kept and why
+            store = getattr(inst, "trace_store", None)
+            if store is None:
+                return None
+            return {"stats": store.stats(),
+                    "traces": [{"trace_id": rt.trace_id, "digest": rt.digest,
+                                "reason": rt.reason, "node": rt.node,
+                                "at": round(rt.at, 3),
+                                "elapsed_ms": rt.elapsed_ms,
+                                "error": rt.error, "phases": rt.phases,
+                                "spans": len(rt.spans), "sql": rt.sql}
+                               for rt in store.entries(limit=128)]}
+        if path.startswith("/incidents"):
+            rec = getattr(inst, "recorder", None)
+            if rec is None:
+                return None
+            rest = path[len("/incidents"):].strip("/")
+            if rest:
+                b = rec.get(rest)
+                return b.to_dict() if b is not None else None
+            return {"incidents": [
+                {"incident_id": b.incident_id, "at": round(b.at, 3),
+                 "kind": b.kind, "severity": b.severity,
+                 "episode": b.episode, "node": b.node,
+                 "digests": list(b.digests), "traces": len(b.traces),
+                 "events": len(b.events), "detail": b.detail}
+                for b in rec.bundles()],
+                "captured": rec.captured, "suppressed": rec.suppressed}
         if path == "/health":
             # machine-readable liveness/readiness + SLO burn state + per-
             # worker telemetry; `status` is degraded while any objective
@@ -207,7 +252,8 @@ class WebConsole:
             return {"events": [{"seq": e.seq, "at": round(e.at, 3),
                                 "kind": e.kind, "severity": e.severity,
                                 "node": e.node, "detail": e.detail,
-                                "attrs": e.attrs}
+                                "attrs": e.attrs, "trace_id": e.trace_id,
+                                "digest": e.digest}
                                for e in reversed(evs)]}
         return None
 

@@ -1602,6 +1602,12 @@ class Parser:
             # SHOW METRIC HISTORY [LIKE pattern] (utils/metric_history.py)
             self.expect_kw("HISTORY")
             stmt.kind = "metric_history"
+        elif kind == "INCIDENTS":
+            # SHOW INCIDENTS [<seq>] — flight-recorder bundles
+            # (server/flight_recorder.py); a trailing seq (bare number)
+            # renders one bundle's full evidence detail
+            if self.peek().kind == T.NUMBER:
+                stmt.target = self.next().text
         elif kind == "COLUMNAR":
             # SHOW COLUMNAR REPLICA — per-table tailer state, watermark
             # freshness, and tier shape (storage/columnar.py)

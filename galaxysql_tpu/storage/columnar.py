@@ -386,10 +386,11 @@ class ColumnarReplicaManager:
                 # background plane: a tail fault is published as an error
                 # event and retried next poll; dying silently would freeze
                 # the watermark
-                events.publish(
+                events.publish(  # galaxylint: disable=event-uncorrelated -- background tailer cycle: no query trace or statement digest exists; the flight recorder implicates via replica state
                     "columnar_tail_failed",
                     f"columnar tailer cycle failed: {e}",
-                    severity="error", node=self.instance.node_id)
+                    severity="error", node=self.instance.node_id,
+                    error=f"{type(e).__name__}")
                 time.sleep(self.IDLE_WAIT_S)
 
     def shutdown(self):

@@ -65,6 +65,12 @@ class InstanceEvent:
     node: str                  # publishing node id ("" when unknown)
     detail: str                # one human line
     attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # correlation keys (ISSUE 20): link this event to a retained trace
+    # (utils/tracing.TraceStore) and/or a statement-summary digest so SHOW
+    # EVENTS rows and incident bundles jump straight to their evidence.
+    # Lifted out of **attrs by publish(); 0/"" = uncorrelated.
+    trace_id: int = 0
+    digest: str = ""
 
 
 class EventJournal:
@@ -88,10 +94,17 @@ class EventJournal:
         bumps, but only the FIRST occurrence of a dedupe key lands in the
         ring, so a steady hot workload cannot evict the rare breaker/
         failover/regression events the journal exists to retain."""
+        trace_id = attrs.pop("trace_id", 0)
+        digest = attrs.pop("digest", "")
+        try:
+            trace_id = int(trace_id or 0)
+        except (TypeError, ValueError):
+            trace_id = 0
         ev = InstanceEvent(next(self._seq), time.time(), kind,
                            severity or ("warn" if kind in _WARN_KINDS
                                         else "info"),
-                           node, detail[:512], attrs)
+                           node, detail[:512], attrs,
+                           trace_id=trace_id, digest=str(digest or ""))
         with self._lock:
             self._counts[kind] = self._counts.get(kind, 0) + 1
             if dedupe is not None:

@@ -117,6 +117,21 @@ class MetricHistory:
                     kinds[n] = "gauge"
             except Exception:
                 pass
+        col = getattr(inst, "columnar", None)
+        if col is not None:
+            try:
+                # live freshness: the columnar_lag_ms gauge only moves on
+                # tailer cycles, but lag keeps growing while the tailer is
+                # wedged — recompute from the watermarks at sample time so
+                # the SLO burn engine judges reality (ISSUE 20 satellite)
+                lag = 0.0
+                for rep in col.replicas.values():
+                    if getattr(rep, "state", "") == "READY":
+                        lag = max(lag, float(rep.lag_ms()))
+                vals["columnar_lag_ms"] = round(max(lag, 0.0), 3)
+                kinds["columnar_lag_ms"] = "gauge"
+            except Exception:
+                pass
         ss = getattr(inst, "stmt_summary", None)
         if ss is not None:
             try:

@@ -10,8 +10,14 @@ MPP per-shard stages, device-cache transfers, XLA compile events, and
 worker-process child spans grafted back over the wire with clock-offset
 correction — exported as Chrome-trace/Perfetto JSON from `/trace/<trace_id>`.
 
-Everything here is opt-in: with tracing off, `current()` returns None and no
-code path allocates a span, times a dispatch, or syncs a device.
+Span COLLECTION is always-on (every query builds a lightweight host-side span
+tree — ramp timestamps only, no device syncs); RETENTION is tail-sampled: a
+per-digest head sampler keeps 1-in-N healthy traces, and traces that end slow,
+shed, or errored are always kept, into the byte-budgeted per-node `TraceStore`
+ring.  `GALAXYSQL_TRACING=0` (read once at import) or
+`ENABLE_QUERY_TRACING=false` restores the old fully-opt-in behaviour: with
+collection off, `current()` returns None and no code path allocates a span,
+times a dispatch, or syncs a device.
 """
 
 from __future__ import annotations
@@ -20,10 +26,16 @@ import collections
 import contextlib
 import dataclasses
 import itertools
+import os
 import threading
 import time
 import zlib
 from typing import Any, Deque, Dict, List, Optional, Tuple
+
+# Emergency hatch (same trio convention as GALAXYSQL_PALLAS / _COLUMNAR):
+# env kills always-on collection process-wide, read once at import so the
+# per-query check is one attribute load.
+ALWAYS_ON = os.environ.get("GALAXYSQL_TRACING", "1") != "0"
 
 # -- node-prefixed trace ids ---------------------------------------------------
 #
@@ -374,6 +386,16 @@ def activate(tc: Optional[TraceContext]):
         _ACTIVE.trace = prev
 
 
+def swap_active(tc: Optional[TraceContext]) -> Optional[TraceContext]:
+    """Set the thread's active context, returning the previous one.  The
+    always-on query ramp uses this instead of `activate` — two thread-local
+    ops, no generator frame (the context-manager overhead is measurable at
+    point-serving rates)."""
+    prev = getattr(_ACTIVE, "trace", None)
+    _ACTIVE.trace = tc
+    return prev
+
+
 # -- per-query runtime statistics ---------------------------------------------
 
 
@@ -402,6 +424,19 @@ class QueryProfile:
     # grafted worker-side spans and compile/transfer telemetry events
     spans: List[Span] = dataclasses.field(default_factory=list)
     error: str = ""               # non-empty: the query FAILED mid-execution
+    # phase breakdown (ms) stamped at the session ramps: fence_wait,
+    # admission, queue, plan, compile, execute, serialize.  Shed/failed
+    # queries keep whatever phases completed before the raise — partial
+    # attribution is the point (a shed storm shows WHERE the wait went).
+    phases: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # head-sampling state stamped at query entry (ISSUE 20): `traced` means
+    # collection was enabled for this query (the tail ramps may retain it
+    # even without spans); `sampled` is the head sampler's one-probe verdict
+    # (or the router hint's propagated flag), decided EXACTLY ONCE per query
+    # — the sampler keeps per-digest cadence counters, so the finish ramps
+    # must reuse this bit instead of re-asking
+    traced: bool = False
+    sampled: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -449,6 +484,202 @@ class ProfileRing:
 
     def clear(self):
         self._ring.clear()
+
+
+# -- tail-sampled trace retention ---------------------------------------------
+
+
+@dataclasses.dataclass
+class RetainedTrace:
+    """One retained query trace: the span tree in wire/persistable (dict)
+    form plus the identity needed to correlate it with statement-summary
+    rows, events, and incident bundles."""
+
+    trace_id: int
+    digest: str
+    sql: str
+    schema: str
+    workload: str
+    elapsed_ms: float
+    error: str
+    reason: str                  # sampled | slow | error | shed | remote
+    node: str
+    at: float
+    phases: Dict[str, float] = dataclasses.field(default_factory=dict)
+    spans: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    approx_bytes: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class TraceSampler:
+    """Per-digest head sampler: the per-query decision is one dict probe plus
+    one compare (the hot-path budget ISSUE 20 sets).  Keeps every Nth
+    occurrence of a digest where N = round(1/rate) — the FIRST occurrence
+    always retains, so new digests are never invisible.  rate <= 0 disables
+    head sampling entirely (tail retention still fires)."""
+
+    MAX_DIGESTS = 8192
+
+    def __init__(self, rate: float = 0.01):
+        self.configure(rate)
+
+    def configure(self, rate: float):
+        self.rate = max(0.0, float(rate))
+        self._period = int(round(1.0 / self.rate)) if self.rate > 0 else 0
+        self._counts: Dict[str, int] = {}
+
+    def decide(self, digest: str) -> bool:
+        if not self._period:
+            return False
+        n = self._counts.get(digest, 0)
+        if len(self._counts) > self.MAX_DIGESTS:
+            self._counts.clear()  # epoch reset, bounded (admission idiom)
+        self._counts[digest] = n + 1
+        return n % self._period == 0
+
+
+class TraceStore:
+    """Byte-budgeted per-node ring of retained traces, digest-indexed.
+
+    Healthy traces land via the head sampler; slow/errored/shed traces are
+    ALWAYS retained (tail-based retention — the trace you need is the one
+    the anomaly already marked).  Eviction is oldest-first until the byte
+    budget holds; the estimate is a cheap host-side sum computed only for
+    traces that retain, never on the per-query hot path."""
+
+    def __init__(self, budget_bytes: int = 4 << 20, rate: float = 0.01,
+                 node: str = ""):
+        self.node = node
+        self.sampler = TraceSampler(rate)
+        self._budget = max(1, int(budget_bytes))
+        self._entries: "collections.OrderedDict[int, RetainedTrace]" = \
+            collections.OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.retained = 0
+        self.evicted = 0
+
+    def configure(self, rate: Optional[float] = None,
+                  budget_bytes: Optional[int] = None):
+        if rate is not None and rate != self.sampler.rate:
+            self.sampler.configure(rate)
+        if budget_bytes is not None:
+            self._budget = max(1, int(budget_bytes))
+
+    @staticmethod
+    def _estimate(rt: RetainedTrace) -> int:
+        n = 256 + len(rt.sql) + 24 * len(rt.phases)
+        for d in rt.spans:
+            n += 96 + len(d.get("name", ""))
+            n += sum(len(str(k)) + len(str(v)) + 16
+                     for k, v in (d.get("attrs") or {}).items())
+        return n
+
+    def offer(self, prof: "QueryProfile", digest: str,
+              slow: bool = False, shed: bool = False,
+              forced: bool = False) -> Optional[RetainedTrace]:
+        """Retention decision for a finished (or aborted) query.  Tail
+        conditions (error/slow/shed) always retain; `forced` marks an
+        upstream router's propagated sampling decision (the trace hint's
+        sampled flag — the router will pull this id back by exact match);
+        otherwise `prof.sampled` — the head verdict stamped ONCE at query
+        entry (the sampler keeps cadence counters; re-asking here would
+        double-count the digest).  Returns the retained entry or None."""
+        if prof.error or shed:
+            reason = "shed" if shed else "error"
+        elif slow:
+            reason = "slow"
+        elif forced:
+            reason = "remote"
+        elif prof.sampled:
+            reason = "sampled"
+        else:
+            return None
+        if prof.spans:
+            spans = [s.to_dict() for s in prof.spans]
+            if not spans[0].get("dur_us"):
+                # the root span is still open at the finish ramp (it closes
+                # when the ramp unwinds); stamp the observed elapsed so
+                # retained trees render a closed root
+                spans[0]["dur_us"] = prof.elapsed_ms * 1000.0
+        else:
+            # unsampled query that tail-retained: the hot path skipped the
+            # span machinery, so synthesize the root from the profile — the
+            # phase breakdown is the evidence, the tree is a formality
+            attrs: Dict[str, Any] = {"sql": prof.sql[:128],
+                                     "conn": prof.conn_id,
+                                     "schema": prof.schema,
+                                     "synthesized": True}
+            if prof.phases:
+                attrs["phases"] = dict(prof.phases)
+            if prof.error:
+                attrs["error"] = prof.error[:256]
+            spans = [{"span_id": 1, "parent_id": 0, "name": "query",
+                      "kind": "query", "node": self.node,
+                      "start_us": int(prof.started_at * 1e6),
+                      "dur_us": round(prof.elapsed_ms * 1000.0, 1),
+                      "attrs": attrs}]
+        rt = RetainedTrace(
+            trace_id=prof.trace_id, digest=digest, sql=prof.sql[:512],
+            schema=prof.schema, workload=prof.workload,
+            elapsed_ms=round(prof.elapsed_ms, 3), error=prof.error[:256],
+            reason=reason, node=self.node, at=time.time(),
+            phases=dict(prof.phases), spans=spans)
+        return self.put(rt)
+
+    def put(self, rt: RetainedTrace) -> RetainedTrace:
+        """Insert an already-assembled trace under the byte budget — the
+        router retains its grafted cluster-path trees through here, and
+        offer() lands its retention decisions here too."""
+        rt.approx_bytes = self._estimate(rt)
+        with self._lock:
+            # re-retention of the same id (leader + member finish ramps,
+            # or a router re-grafting a pulled peer trace)
+            prev = self._entries.pop(rt.trace_id, None)
+            if prev is not None:
+                self._bytes -= prev.approx_bytes
+            self._entries[rt.trace_id] = rt
+            self._bytes += rt.approx_bytes
+            self.retained += 1
+            while self._bytes > self._budget and len(self._entries) > 1:
+                _, old = self._entries.popitem(last=False)
+                self._bytes -= old.approx_bytes
+                self.evicted += 1
+        return rt
+
+    def get(self, trace_id) -> Optional[RetainedTrace]:
+        try:
+            tid = int(trace_id)
+        except (TypeError, ValueError):
+            return None
+        with self._lock:
+            return self._entries.get(tid)
+
+    def for_digest(self, digest: str, limit: int = 4) -> List[RetainedTrace]:
+        """Most-recent-first retained traces for one statement digest — the
+        flight recorder's evidence query."""
+        with self._lock:
+            out = [rt for rt in reversed(self._entries.values())
+                   if rt.digest == digest]
+        return out[:limit]
+
+    def entries(self, limit: int = 0) -> List[RetainedTrace]:
+        with self._lock:
+            out = list(reversed(self._entries.values()))
+        return out[:limit] if limit else out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"count": len(self._entries), "bytes": self._bytes,
+                    "budget": self._budget, "retained": self.retained,
+                    "evicted": self.evicted, "rate": self.sampler.rate}
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
 
 
 class MatrixStatistics:

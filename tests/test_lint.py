@@ -291,6 +291,56 @@ class TestHygieneRules:
                    for f in fs)
         assert all(f.rule == "metric-orphan" for f in fs)
 
+    # -- event-uncorrelated: trigger-kind publishes must carry digest/trace_id
+
+    def test_uncorrelated_trigger_event_flagged(self):
+        fs = L.lint_source(
+            "def trip(events, worker):\n"
+            "    events.publish('breaker_open', 'worker tripped',\n"
+            "                   worker=worker)\n",
+            "galaxysql_tpu/server/x.py",
+            test_text="breaker_open")  # kind is test-covered; only the
+        assert rules_of(fs) == ["event-uncorrelated"]  # correlation is missing
+
+    def test_correlated_trigger_event_clean(self):
+        fs = L.lint_source(
+            "def regress(events, d, tid):\n"
+            "    events.publish('plan_regression', 'plan got slower',\n"
+            "                   digest=d)\n"
+            "    events.publish('slo_burn', 'window burning',\n"
+            "                   trace_id=tid)\n",
+            "galaxysql_tpu/server/x.py",
+            test_text="plan_regression slo_burn")
+        assert "event-uncorrelated" not in rules_of(fs)
+
+    def test_trigger_event_splat_unchecked(self):
+        # **kwargs may carry the keys — statically unverifiable, so clean
+        fs = L.lint_source(
+            "def fwd(events, kw):\n"
+            "    events.publish('columnar_tail_failed', 'tail', **kw)\n",
+            "galaxysql_tpu/server/x.py",
+            test_text="columnar_tail_failed")
+        assert "event-uncorrelated" not in rules_of(fs)
+
+    def test_nontrigger_kind_not_checked(self):
+        fs = L.lint_source(
+            "def note(events):\n"
+            "    events.publish('gc_pause', 'background sweep')\n",
+            "galaxysql_tpu/server/x.py",
+            test_text="gc_pause")
+        assert "event-uncorrelated" not in rules_of(fs)
+
+    def test_uncorrelated_pragma_suppresses(self):
+        fs = L.lint_source(
+            "def trip(events):\n"
+            "    events.publish('breaker_open', 'no query context')"
+            "  # galaxylint: disable=event-uncorrelated"
+            " -- background health loop, no statement to implicate\n",
+            "galaxysql_tpu/server/x.py",
+            test_text="breaker_open")
+        assert "event-uncorrelated" not in rules_of(fs)
+        assert "event-uncorrelated" in rules_of(fs, suppressed=True)
+
 
 # -- pragmas -------------------------------------------------------------------
 
@@ -418,7 +468,8 @@ class TestTreeClean:
         assert rules == {"lock-order", "lock-blocking", "jit-raw",
                          "pallas-raw", "jit-device-sync", "swallow",
                          "untyped-raise", "dead-failpoint", "metric-orphan",
-                         "event-untested", "histogram-unsampled"}
+                         "event-untested", "histogram-unsampled",
+                         "event-uncorrelated"}
 
     def test_cli_exits_zero(self, capsys):
         assert L.main([]) == 0

@@ -481,6 +481,7 @@ class TestTracingEquivalenceTpchQ5:
 class TestTracingOffFastPath:
     def test_no_trace_context_when_disabled(self):
         inst = Instance()
+        inst.config.set_instance("ENABLE_QUERY_TRACING", 0)
         s = Session(inst)
         s.execute("CREATE DATABASE off; USE off; CREATE TABLE t (a BIGINT)")
         inst.store("off", "t").insert_pylists(
